@@ -1,0 +1,10 @@
+"""TPU-native LLM training framework with the capabilities of Megatron-LLM.
+
+Built from scratch on JAX/XLA/Pallas: one (dp, pp, cp, tp) device mesh,
+GSPMD sharding for tensor/sequence parallelism, a scanned ppermute pipeline,
+Pallas flash-attention and norm kernels, and a functional train step.
+"""
+
+__version__ = "0.1.0"
+
+from . import config  # noqa: F401

@@ -1,0 +1,485 @@
+"""Configuration dataclasses for the TPU-native Megatron-LLM rebuild.
+
+The reference uses a single argparse namespace with 16 argument groups frozen
+into a global singleton (reference: megatron/arguments.py:15-35,
+megatron/global_vars.py:24-27).  Here configuration is explicit, typed and
+threaded through call sites: a frozen ``ModelConfig`` describing the network,
+a ``ParallelConfig`` describing the device mesh, and a ``TrainConfig`` for the
+runtime.  ``validate()`` performs the same derivations the reference does in
+``validate_args`` (megatron/arguments.py:53-350): data-parallel size from the
+world size, dtype resolution, sequence-parallel gating on TP>1, etc.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# Enums (reference: megatron/model/enums.py:6-28)
+# ---------------------------------------------------------------------------
+
+
+class PositionEmbeddingType:
+    ROTARY = "rotary"
+    ABSOLUTE = "absolute"
+    NONE = "none"
+
+
+class AttnMaskType:
+    CAUSAL = "causal"
+    PADDING = "padding"
+    PREFIX = "prefix"
+
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "fp16": jnp.float16,
+}
+
+
+def resolve_dtype(name: str):
+    return _DTYPES[name]
+
+
+# ---------------------------------------------------------------------------
+# Model configuration
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture description covering the reference model zoo.
+
+    Covers GPT / Llama-1/2 / Code Llama / Falcon variants
+    (reference: megatron/model/{gpt_model,llama_model,falcon_model}.py).
+    """
+
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_attention_heads: int = 32
+    # GQA/MQA: number of distinct KV heads (reference: --num_attention_heads_kv,
+    # megatron/model/transformer.py:441-456).
+    num_kv_heads: Optional[int] = None
+    ffn_hidden_size: Optional[int] = None  # derived: 4*h, or 8/3*h for GLU
+    max_position_embeddings: int = 4096
+    # normalization
+    norm_type: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    norm_eps: float = 1e-5
+    # activations: "swiglu"|"geglu"|"reglu"|"liglu"|"gelu"|"squared_relu"
+    activation: str = "swiglu"
+    # positions
+    position_embedding_type: str = PositionEmbeddingType.ROTARY
+    rope_theta: float = 10000.0
+    # Linear position-interpolation RoPE scaling (Code-Llama long context;
+    # reference: megatron/model/positional_embeddings.py:7-13).
+    rope_scaling_factor: float = 1.0
+    # structure flags
+    use_bias: bool = False  # bias on linear layers (GPT yes, Llama no)
+    qkv_bias: bool = False  # Falcon-7B style attention bias
+    tie_embed_logits: bool = False  # GPT ties; Llama/Falcon untied
+    parallel_attn: bool = False  # Falcon: attn and MLP in parallel
+    parallel_layernorm: bool = False  # Falcon-40B: separate LN for MLP branch
+    # dropout (0 for llama/falcon pretraining)
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    # numerics
+    params_dtype: str = "bfloat16"
+    # softmax/logit scaling
+    apply_query_key_layer_scaling: bool = False
+    attention_softmax_in_fp32: bool = True
+    # embedding
+    make_vocab_size_divisible_by: int = 128
+    # initialization
+    init_method_std: float = 0.02
+    use_scaled_init: bool = True  # scale output-layer init by 1/sqrt(2*layers)
+    # attention impl: "flash" (pallas) | "dot" (XLA einsum path)
+    attention_impl: str = "flash"
+    # recompute: "none" | "selective" | "full"
+    recompute: str = "selective"
+    # Parallel-friendly sequence length used for activation layouts.
+    seq_length: int = 4096
+    # lm head
+    tokentype_size: int = 0  # BERT-style token types (0 = disabled)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_attention_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def ffn_size(self) -> int:
+        if self.ffn_hidden_size is not None:
+            return self.ffn_hidden_size
+        if self.is_glu:
+            # llama convention: 2/3 * 4h rounded to multiple of 256
+            size = int(2 * 4 * self.hidden_size / 3)
+            return 256 * ((size + 255) // 256)
+        return 4 * self.hidden_size
+
+    @property
+    def is_glu(self) -> bool:
+        return self.activation in ("swiglu", "geglu", "reglu", "liglu")
+
+    @property
+    def dtype(self):
+        return resolve_dtype(self.params_dtype)
+
+    def padded_vocab_size(self, tp: int = 1) -> int:
+        """Pad vocab so it divides evenly across TP shards
+        (reference: megatron/tokenizer/tokenizer.py:39-63)."""
+        multiple = self.make_vocab_size_divisible_by * tp
+        return ((self.vocab_size + multiple - 1) // multiple) * multiple
+
+    def validate(self) -> "ModelConfig":
+        assert self.hidden_size % self.num_attention_heads == 0
+        assert self.num_attention_heads % self.kv_heads == 0
+        if self.parallel_layernorm:
+            assert self.parallel_attn, "parallel_layernorm requires parallel_attn"
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Parallelism configuration (reference: megatron/core/parallel_state.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Mesh axes for 4-way parallelism.
+
+    The reference builds NCCL groups for TP/PP/DP (parallel_state.py:51-214);
+    here the same topology is one ``jax.sharding.Mesh`` with named axes.  The
+    mesh is laid out so TP is innermost (fastest-varying — rides ICI), then
+    PP, then DP outermost (can span DCN across slices), mirroring the
+    reference rank order (parallel_state.py docstring).
+    """
+
+    data_parallel: int = 1
+    pipeline_parallel: int = 1
+    tensor_parallel: int = 1
+    # Megatron-style sequence parallelism: shard activations along seq over
+    # the tp axis in norm/dropout regions (reference spread across
+    # core/tensor_parallel/layers.py:225-296 etc.).
+    sequence_parallel: bool = False
+    # virtual pipeline (interleaved 1F1B) chunks per stage
+    virtual_pipeline_stages: int = 1
+    # expert parallelism axis size (MoE; reference has none — extension)
+    expert_parallel: int = 1
+    # context parallelism (ring attention over seq) — extension beyond reference
+    context_parallel: int = 1
+    # number of microbatches for pipeline / grad accumulation
+    num_microbatches: int = 1
+    # ZeRO-1: shard optimizer state over dp
+    # (reference: megatron/optimizer/distrib_optimizer.py)
+    use_distributed_optimizer: bool = False
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.data_parallel
+            * self.pipeline_parallel
+            * self.tensor_parallel
+            * self.context_parallel
+        )
+
+    def validate(self) -> "ParallelConfig":
+        # sequence_parallel with tp == 1 is a harmless no-op (the reference
+        # force-disables it, arguments.py:332-333; here the spec degenerates
+        # to the plain activation layout).
+        if self.pipeline_parallel > 1:
+            assert self.num_microbatches >= 1
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Training configuration (reference: megatron/arguments.py training groups)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    optimizer: str = "adamw"  # "adamw" | "sgd"
+    lr: float = 3e-4
+    min_lr: float = 3e-5
+    weight_decay: float = 0.1
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.95
+    adam_eps: float = 1e-8
+    sgd_momentum: float = 0.9
+    clip_grad: float = 1.0
+    # LR schedule (reference: megatron/optimizer_param_scheduler.py)
+    lr_decay_style: str = "cosine"  # constant|linear|cosine|inverse-square-root
+    lr_warmup_iters: int = 0
+    lr_warmup_fraction: Optional[float] = None
+    lr_decay_iters: Optional[int] = None
+    # weight decay ramp (reference: optimizer_param_scheduler.py:42-64)
+    start_weight_decay: Optional[float] = None
+    end_weight_decay: Optional[float] = None
+    weight_decay_incr_style: str = "constant"
+    # loss scaling for fp16 (bf16 needs none)
+    loss_scale: Optional[float] = None
+    initial_loss_scale: float = 2.0**32
+    min_loss_scale: float = 1.0
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    # master weights dtype
+    main_params_dtype: str = "float32"
+    use_fp32_grad_accum: bool = True
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    train_iters: int = 1000
+    micro_batch_size: int = 1
+    global_batch_size: int = 1
+    # batch-size ramp [start, increment, samples] (reference: microbatches.py)
+    rampup_batch_size: Optional[Sequence[int]] = None
+    seq_length: int = 4096
+    seed: int = 1234
+    # eval
+    eval_interval: int = 1000
+    eval_iters: int = 10
+    # checkpointing
+    save: Optional[str] = None
+    load: Optional[str] = None
+    save_interval: int = 1000
+    # logging
+    log_interval: int = 10
+    tensorboard_dir: Optional[str] = None
+    wandb_project: Optional[str] = None
+    wandb_name: Optional[str] = None
+    # exits
+    exit_interval: Optional[int] = None
+    exit_duration_mins: Optional[float] = None
+    # data
+    data_path: Optional[Sequence[Any]] = None
+    split: str = "969,30,1"
+    # metrics evaluated during validation (reference: megatron/metrics.py)
+    metrics: Sequence[str] = ()
+    # iterations whose fwd/bwd is skipped (fault injection;
+    # reference: --skip_iters, megatron/training.py:397-399)
+    skip_iters: Sequence[int] = ()
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Top-level bundle threaded through the runtime (replaces get_args())."""
+
+    model: ModelConfig = field(default_factory=ModelConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+
+    def validate(self) -> "RuntimeConfig":
+        self.model.validate()
+        self.parallel.validate()
+        mb = self.train.micro_batch_size
+        gb = self.train.global_batch_size
+        dp = self.parallel.data_parallel
+        assert gb % (mb * dp) == 0, (
+            f"global_batch_size {gb} must divide by micro_batch {mb} * dp {dp}"
+        )
+        return self
+
+    @property
+    def grad_accum_steps(self) -> int:
+        return self.train.global_batch_size // (
+            self.train.micro_batch_size * self.parallel.data_parallel
+        )
+
+    # -- (de)serialization for checkpoints (args-in-checkpoint parity;
+    #     reference: megatron/checkpointing.py:267-285,476-559) --
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        d = self.to_dict()
+        return json.dumps(d, indent=2, default=str)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RuntimeConfig":
+        return cls(
+            model=ModelConfig(**d.get("model", {})),
+            parallel=ParallelConfig(**{k: tuple(v) if isinstance(v, list) else v
+                                       for k, v in d.get("parallel", {}).items()}),
+            optimizer=OptimizerConfig(**d.get("optimizer", {})),
+            train=TrainConfig(**{k: tuple(v) if isinstance(v, list) else v
+                                 for k, v in d.get("train", {}).items()}),
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "RuntimeConfig":
+        return cls.from_dict(json.loads(s))
+
+
+# ---------------------------------------------------------------------------
+# Model presets (reference model zoo: docs + finetune.py model size args)
+# ---------------------------------------------------------------------------
+
+
+def llama2_config(size: str = "7b", **overrides) -> ModelConfig:
+    base = dict(
+        norm_type="rmsnorm",
+        norm_eps=1e-5,
+        activation="swiglu",
+        position_embedding_type=PositionEmbeddingType.ROTARY,
+        use_bias=False,
+        tie_embed_logits=False,
+        vocab_size=32000,
+        max_position_embeddings=4096,
+        seq_length=4096,
+    )
+    sizes = {
+        "7b": dict(hidden_size=4096, num_layers=32, num_attention_heads=32,
+                   ffn_hidden_size=11008),
+        "13b": dict(hidden_size=5120, num_layers=40, num_attention_heads=40,
+                    ffn_hidden_size=13824),
+        "70b": dict(hidden_size=8192, num_layers=80, num_attention_heads=64,
+                    num_kv_heads=8, ffn_hidden_size=28672),
+    }
+    base.update(sizes[size])
+    base.update(overrides)
+    return ModelConfig(**base).validate()
+
+
+def llama1_config(size: str = "7b", **overrides) -> ModelConfig:
+    cfg = dict(max_position_embeddings=2048, seq_length=2048, norm_eps=1e-6)
+    llama1_sizes = {
+        "30b": dict(hidden_size=6656, num_layers=60, num_attention_heads=52,
+                    ffn_hidden_size=17920),
+        "65b": dict(hidden_size=8192, num_layers=80, num_attention_heads=64,
+                    ffn_hidden_size=22016),
+    }
+    if size in llama1_sizes:
+        cfg.update(llama1_sizes[size])
+        cfg.update(overrides)
+        return llama2_config("7b", **cfg)
+    if size not in ("7b", "13b"):
+        raise KeyError(f"unknown llama-1 size {size!r}")
+    cfg.update(overrides)
+    return llama2_config(size, **cfg)
+
+
+def codellama_config(size: str = "34b", **overrides) -> ModelConfig:
+    base = dict(
+        vocab_size=32016,
+        rope_theta=1000000.0,
+        max_position_embeddings=16384,
+        seq_length=16384,
+    )
+    sizes = {
+        "7b": dict(hidden_size=4096, num_layers=32, num_attention_heads=32,
+                   ffn_hidden_size=11008),
+        "13b": dict(hidden_size=5120, num_layers=40, num_attention_heads=40,
+                    ffn_hidden_size=13824),
+        "34b": dict(hidden_size=8192, num_layers=48, num_attention_heads=64,
+                    num_kv_heads=8, ffn_hidden_size=22016),
+    }
+    base.update(sizes[size])
+    base.update(overrides)
+    return llama2_config("7b", **base)
+
+
+def falcon_config(size: str = "7b", **overrides) -> ModelConfig:
+    """Falcon: MQA/GQA, parallel attention, LayerNorm, gelu, rotary
+    (reference: megatron/model/falcon_model.py:18-29)."""
+    base = dict(
+        norm_type="layernorm",
+        norm_eps=1e-5,
+        # HF Falcon uses exact (erf) GELU; matching it keeps logit parity
+        # within verify_correctness tolerances.
+        activation="gelu_exact",
+        position_embedding_type=PositionEmbeddingType.ROTARY,
+        use_bias=False,
+        tie_embed_logits=True,
+        parallel_attn=True,
+        vocab_size=65024,
+        max_position_embeddings=2048,
+        seq_length=2048,
+    )
+    sizes = {
+        "7b": dict(hidden_size=4544, num_layers=32, num_attention_heads=71,
+                   num_kv_heads=1, ffn_hidden_size=4 * 4544),
+        "40b": dict(hidden_size=8192, num_layers=60, num_attention_heads=128,
+                    num_kv_heads=8, ffn_hidden_size=4 * 8192,
+                    parallel_layernorm=True),
+    }
+    base.update(sizes[size])
+    base.update(overrides)
+    return ModelConfig(**base).validate()
+
+
+def gpt_config(size: str = "345m", **overrides) -> ModelConfig:
+    """GPT-2/3 style: learned absolute positions, LayerNorm, gelu, tied
+    embeddings, biases (reference: megatron/model/gpt_model.py)."""
+    base = dict(
+        norm_type="layernorm",
+        norm_eps=1e-5,
+        activation="gelu",
+        position_embedding_type=PositionEmbeddingType.ABSOLUTE,
+        use_bias=True,
+        tie_embed_logits=True,
+        vocab_size=50257,
+        max_position_embeddings=1024,
+        seq_length=1024,
+    )
+    sizes = {
+        "125m": dict(hidden_size=768, num_layers=12, num_attention_heads=12),
+        "345m": dict(hidden_size=1024, num_layers=24, num_attention_heads=16),
+        "1.3b": dict(hidden_size=2048, num_layers=24, num_attention_heads=32),
+    }
+    base.update(sizes[size])
+    base.update(overrides)
+    return ModelConfig(**base).validate()
+
+
+def tiny_config(**overrides) -> ModelConfig:
+    """Small llama-style config for tests."""
+    base = dict(
+        vocab_size=256,
+        hidden_size=64,
+        num_layers=2,
+        num_attention_heads=4,
+        num_kv_heads=2,
+        ffn_hidden_size=128,
+        max_position_embeddings=128,
+        seq_length=32,
+        params_dtype="float32",
+        attention_impl="dot",
+        recompute="none",
+        make_vocab_size_divisible_by=8,
+    )
+    base.update(overrides)
+    return ModelConfig(**base).validate()
+
+
+PRESETS = {
+    "llama2-7b": lambda: llama2_config("7b"),
+    "llama2-13b": lambda: llama2_config("13b"),
+    "llama2-70b": lambda: llama2_config("70b"),
+    "llama1-7b": lambda: llama1_config("7b"),
+    "codellama-7b": lambda: codellama_config("7b"),
+    "codellama-34b": lambda: codellama_config("34b"),
+    "falcon-7b": lambda: falcon_config("7b"),
+    "falcon-40b": lambda: falcon_config("40b"),
+    "gpt-345m": lambda: gpt_config("345m"),
+    "tiny": tiny_config,
+}
+
+
+def get_preset(name: str) -> ModelConfig:
+    return PRESETS[name]()

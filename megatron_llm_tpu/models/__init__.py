@@ -1,0 +1,2 @@
+from . import families, model, sharding, transformer  # noqa: F401
+from .families import CausalLM, code_llama, falcon, gpt, llama  # noqa: F401

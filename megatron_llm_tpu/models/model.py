@@ -1,0 +1,162 @@
+"""Top-level causal language model: embedding → decoder stack → lm head.
+
+Parity with the reference's ``TransformerLanguageModel`` + ``GPTModel``
+(megatron/model/language_model.py:56-638, megatron/model/gpt_model.py:18-124):
+vocab(-parallel) word embedding, optional learned absolute positions, the
+decoder stack, final norm, and an untied lm_head or tied-embedding logits.
+The loss (vocab-parallel cross entropy) lives in
+``megatron_llm_tpu.parallel.cross_entropy``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig, PositionEmbeddingType
+from .transformer import (
+    AttnSideInputs,
+    Params,
+    _dropout,
+    init_stack_params,
+    norm_init,
+    rope_tables,
+    stack_forward,
+)
+from ..ops.norms import norm_apply
+
+
+def init_params(key: jax.Array, cfg: ModelConfig, tp: int = 1) -> Params:
+    """Full model parameter pytree.
+
+    The vocab is padded to divide the TP axis
+    (reference: megatron/tokenizer/tokenizer.py:39-63).
+    """
+    h = cfg.hidden_size
+    dtype = cfg.dtype
+    v = cfg.padded_vocab_size(tp)
+    k_embed, k_pos, k_stack, k_head = jax.random.split(key, 4)
+
+    params: Params = {
+        "embedding": {
+            "word": (cfg.init_method_std
+                     * jax.random.normal(k_embed, (v, h), jnp.float32)
+                     ).astype(dtype),
+        },
+        "layers": init_stack_params(k_stack, cfg),
+        "final_norm": norm_init(cfg.norm_type, h, dtype),
+    }
+    if cfg.position_embedding_type == PositionEmbeddingType.ABSOLUTE:
+        params["embedding"]["position"] = (
+            cfg.init_method_std
+            * jax.random.normal(k_pos, (cfg.max_position_embeddings, h),
+                                jnp.float32)
+        ).astype(dtype)
+    if cfg.tokentype_size:
+        params["embedding"]["tokentype"] = (
+            cfg.init_method_std
+            * jax.random.normal(jax.random.fold_in(k_pos, 1),
+                                (cfg.tokentype_size, h), jnp.float32)
+        ).astype(dtype)
+    if not cfg.tie_embed_logits:
+        # untied lm_head Parameter (reference:
+        # megatron/model/language_model.py:437-457)
+        params["lm_head"] = (
+            cfg.init_method_std
+            * jax.random.normal(k_head, (h, v), jnp.float32)
+        ).astype(dtype)
+    return params
+
+
+def embed(cfg: ModelConfig, params: Params, tokens: jax.Array,
+          position_ids: Optional[jax.Array] = None,
+          tokentype_ids: Optional[jax.Array] = None,
+          dropout_rng=None, deterministic: bool = True) -> jax.Array:
+    """Token (+position, +tokentype) embedding with embedding dropout
+    (reference: megatron/model/language_model.py:133-327)."""
+    x = params["embedding"]["word"][tokens]
+    if "position" in params["embedding"]:
+        if position_ids is None:
+            position_ids = jnp.arange(tokens.shape[1])[None, :]
+        x = x + params["embedding"]["position"][position_ids]
+    if tokentype_ids is not None and "tokentype" in params["embedding"]:
+        x = x + params["embedding"]["tokentype"][tokentype_ids]
+    x = _dropout(x, cfg.hidden_dropout, dropout_rng, deterministic)
+    return x
+
+
+def unembed(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
+    """Project hidden states to (padded-)vocab logits
+    (reference: parallel_lm_logits, megatron/model/language_model.py:24-53)."""
+    if cfg.tie_embed_logits:
+        logits = x @ params["embedding"]["word"].T
+    else:
+        logits = x @ params["lm_head"]
+    return logits
+
+
+def forward(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [b, s] int32
+    *,
+    position_ids: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+    tokentype_ids: Optional[jax.Array] = None,
+    rng: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    rope: Optional[tuple] = None,
+) -> jax.Array:
+    """Full forward to logits [b, s, padded_vocab] (fp32)."""
+    if rope is None:
+        cos, sin = rope_tables(cfg)
+    else:
+        cos, sin = rope
+
+    embed_rng = stack_rng = None
+    if not deterministic:
+        if rng is None and (cfg.hidden_dropout > 0 or cfg.attention_dropout > 0):
+            raise ValueError(
+                "deterministic=False with dropout enabled requires an rng key"
+            )
+        if rng is not None:
+            embed_rng, stack_rng = jax.random.split(rng)
+
+    x = embed(cfg, params, tokens, position_ids, tokentype_ids,
+              embed_rng, deterministic)
+    side = AttnSideInputs(
+        rope_cos=cos, rope_sin=sin,
+        position_ids=position_ids, segment_ids=segment_ids,
+        deterministic=deterministic,
+    )
+    x = stack_forward(cfg, params["layers"], x, side, stack_rng)
+    x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps)
+    logits = unembed(cfg, params, x)
+    return logits.astype(jnp.float32)
+
+
+def num_params(params: Params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))
+
+
+def flops_per_token(cfg: ModelConfig, seq_len: int) -> float:
+    """Analytic FLOPs/token for MFU reporting (reference FLOP estimate:
+    megatron/model/language_model.py:370-384)."""
+    h = cfg.hidden_size
+    L = cfg.num_layers
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    nkv = cfg.kv_heads
+    ffn = cfg.ffn_size
+    n_mlp_mat = 3 if cfg.is_glu else 2
+    per_layer = (
+        2 * h * (nq * d)  # wq
+        + 2 * h * (nkv * d) * 2  # wk, wv
+        + 2 * (nq * d) * h  # wo
+        + 2 * 2 * nq * d * seq_len  # attention scores + context (causal ÷2 *2)
+        + n_mlp_mat * 2 * h * ffn  # mlp matmuls
+    )
+    head = 2 * h * cfg.padded_vocab_size()
+    return float(L * per_layer + head)

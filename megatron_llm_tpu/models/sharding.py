@@ -1,0 +1,135 @@
+"""PartitionSpec trees: Megatron's Column/Row/Vocab parallel layout as specs.
+
+The reference implements tensor parallelism as module classes that hand-code
+collectives (ColumnParallelLinear / RowParallelLinear / VocabParallelEmbedding,
+megatron/core/tensor_parallel/layers.py:128,410,566).  On TPU the same layout
+is a ``PartitionSpec`` per parameter; GSPMD derives the identical comm
+pattern (all-reduce after row-parallel matmuls, all-gather/reduce-scatter for
+sequence parallelism) from the specs.  Mapping:
+
+- ColumnParallelLinear weight [in, out]      → P(None, 'tp')
+- RowParallelLinear weight [in, out]         → P('tp', None)
+- VocabParallelEmbedding [vocab, hidden]     → P('tp', None)
+- untied lm_head [hidden, vocab]             → P(None, 'tp')
+- norms / biases of row-parallel outputs     → replicated
+
+Layer parameters are stacked on a leading layer axis; that axis is sharded
+over 'pp' when pipeline parallelism is active (each stage owns a contiguous
+slab of layers — the spec equivalent of the reference's layer-offset logic in
+megatron/model/transformer.py:1015-1060).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import ModelConfig, ParallelConfig
+from .transformer import Params
+
+TP = "tp"
+PP = "pp"
+DP = "dp"
+CP = "cp"
+
+
+def _layer_specs(cfg: ModelConfig, layer_axis: Optional[str],
+                 tp_size: int) -> Params:
+    """Specs for one (stacked) layer pytree; leading dim = layer axis."""
+    L = layer_axis  # None (scan only) or 'pp'
+    # K/V projections: shard over tp only if the kv heads divide evenly —
+    # MQA (Falcon-7B kv=1) keeps K/V replicated on every tp shard, which is
+    # what the reference does implicitly by tiling (transformer.py:449-456).
+    kv_tp = TP if cfg.kv_heads % max(tp_size, 1) == 0 else None
+    attn = {
+        "wq": P(L, None, TP),
+        "wk": P(L, None, kv_tp),
+        "wv": P(L, None, kv_tp),
+        "wo": P(L, TP, None),
+    }
+    if cfg.use_bias or cfg.qkv_bias:
+        attn["bq"] = P(L, TP)
+        attn["bk"] = P(L, kv_tp)
+        attn["bv"] = P(L, kv_tp)
+    if cfg.use_bias:
+        attn["bo"] = P(L, None)
+
+    mlp = {}
+    if cfg.is_glu:
+        mlp["w_gate"] = P(L, None, TP)
+    mlp["w_up"] = P(L, None, TP)
+    mlp["w_down"] = P(L, TP, None)
+    if cfg.use_bias:
+        if cfg.is_glu:
+            mlp["b_gate"] = P(L, TP)
+        mlp["b_up"] = P(L, TP)
+        mlp["b_down"] = P(L, None)
+
+    def norm_spec():
+        s = {"scale": P(L, None)}
+        if cfg.norm_type == "layernorm":
+            s["bias"] = P(L, None)
+        return s
+
+    layer = {"input_norm": norm_spec(), "attn": attn, "mlp": mlp}
+    if cfg.parallel_attn:
+        if cfg.parallel_layernorm:
+            layer["mlp_norm"] = norm_spec()
+    else:
+        layer["post_attn_norm"] = norm_spec()
+    return layer
+
+
+def param_specs(cfg: ModelConfig, parallel: ParallelConfig) -> Params:
+    """PartitionSpec pytree matching ``models.model.init_params`` output."""
+    layer_axis = PP if parallel.pipeline_parallel > 1 else None
+    specs: Params = {
+        "embedding": {"word": P(TP, None)},
+        "layers": _layer_specs(cfg, layer_axis, parallel.tensor_parallel),
+        "final_norm": {"scale": P(None)},
+    }
+    if cfg.norm_type == "layernorm":
+        specs["final_norm"]["bias"] = P(None)
+    if cfg.position_embedding_type == "absolute":
+        specs["embedding"]["position"] = P(None, None)
+    if cfg.tokentype_size:
+        specs["embedding"]["tokentype"] = P(None, None)
+    if not cfg.tie_embed_logits:
+        specs["lm_head"] = P(None, TP)
+    return specs
+
+
+def shard_params(params: Params, specs: Params, mesh: Mesh) -> Params:
+    """Place a param pytree onto the mesh according to the spec tree."""
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def activation_spec(parallel: ParallelConfig) -> P:
+    """[batch, seq, hidden] activation layout: batch over dp, seq over cp."""
+    return P(DP, CP, None)
+
+
+def sequence_parallel_spec(parallel: ParallelConfig) -> P:
+    """Megatron sequence parallelism: in norm/dropout regions activations are
+    sharded 1/tp along the sequence dim (reference:
+    core/tensor_parallel/layers.py:225-296).  Expressed as a constraint the
+    model applies around norms when ``parallel.sequence_parallel``."""
+    if parallel.sequence_parallel and parallel.tensor_parallel > 1:
+        return P(DP, (CP, TP), None)
+    return activation_spec(parallel)
+
+
+def logits_spec(parallel: ParallelConfig) -> P:
+    return P(DP, CP, TP)
+
+
+def constrain(x, spec: P):
+    """``with_sharding_constraint`` that is a no-op outside jit/mesh."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except Exception:
+        return x

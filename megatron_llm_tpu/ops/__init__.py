@@ -1,0 +1,1 @@
+from . import activations, attention, norms, rope  # noqa: F401

@@ -1,0 +1,76 @@
+"""Activation zoo: GLU variants and fused bias-gelu.
+
+Parity with the reference GLU family (megatron/model/glu_activations.py:44-49:
+liglu/geglu/reglu/swiglu over a doubled-width projection split in half) and
+the jit-scripted bias_gelu (megatron/model/fused_bias_gelu.py:14-43 — on TPU
+XLA fuses bias+gelu into the matmul epilogue, so plain composition is the
+fused path).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _split_glu(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    # Reference splits the doubled projection in half along the last dim
+    # (glu_activations.py:14-21).
+    return jnp.split(x, 2, axis=-1)
+
+
+def liglu(x):
+    a, b = _split_glu(x)
+    return a * b
+
+
+def geglu(x):
+    a, b = _split_glu(x)
+    return jax.nn.gelu(a, approximate=True) * b
+
+
+def reglu(x):
+    a, b = _split_glu(x)
+    return jax.nn.relu(a) * b
+
+
+def swiglu(x):
+    a, b = _split_glu(x)
+    return jax.nn.silu(a) * b
+
+
+def gelu(x):
+    # The reference's bias_gelu uses the tanh approximation
+    # (fused_bias_gelu.py:14-20); HF Falcon/GPT2 use the same.
+    return jax.nn.gelu(x, approximate=True)
+
+
+def gelu_exact(x):
+    return jax.nn.gelu(x, approximate=False)
+
+
+def squared_relu(x):
+    return jnp.square(jax.nn.relu(x))
+
+
+ACTIVATIONS = {
+    "liglu": liglu,
+    "geglu": geglu,
+    "reglu": reglu,
+    "swiglu": swiglu,
+    "gelu": gelu,
+    "gelu_exact": gelu_exact,
+    "squared_relu": squared_relu,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+}
+
+GLU_ACTIVATIONS = {"liglu", "geglu", "reglu", "swiglu"}
+
+
+def get_activation(name: str):
+    return ACTIVATIONS[name]
+
+
+def is_glu(name: str) -> bool:
+    return name in GLU_ACTIVATIONS

@@ -1,0 +1,137 @@
+"""Attention math: GQA/MQA scaled dot-product with causal / padding masks.
+
+This is the XLA path corresponding to the reference's ``CoreAttention``
+(baddbmm → FusedScaleMaskSoftmax → bmm, megatron/model/transformer.py:191-277)
+and its FlashAttention-2 fast path (transformer.py:508-523).  The Pallas
+flash kernel lives in ``megatron_llm_tpu.kernels.flash_attention``; this
+module provides the reference einsum implementation (always available, used
+on CPU test meshes and as the fallback mirroring fused_softmax.py:152-172)
+and the dispatcher.
+
+Conventions: activations are [batch, seq, heads, head_dim] throughout (the
+reference's [s, b, h] layout is a CUDA-kernel artifact; batch-major is the
+natural TPU layout).  GQA groups are expressed by reshaping Q to
+[batch, seq, kv_heads, group, head_dim] so the K/V broadcast never
+materializes (the reference instead tiles K/V up to the Q head count,
+transformer.py:449-456 — wasteful; on TPU the einsum contraction keeps K/V
+at kv_heads).
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_flash_fallback_warned = False
+
+
+def _warn_flash_fallback():
+    global _flash_fallback_warned
+    if not _flash_fallback_warned:
+        _flash_fallback_warned = True
+        warnings.warn(
+            "attention_impl='flash' requested but the Pallas kernel is "
+            "unavailable; falling back to the XLA einsum path "
+            "(O(s^2) score materialization).",
+            stacklevel=3,
+        )
+
+
+def make_causal_mask(seq_q: int, seq_k: int, dtype=jnp.float32) -> jax.Array:
+    """Additive causal mask [1, 1, seq_q, seq_k] (0 keep / -inf drop)."""
+    i = jnp.arange(seq_q)[:, None]
+    j = jnp.arange(seq_k)[None, :]
+    offset = seq_k - seq_q
+    keep = j <= (i + offset)
+    return jnp.where(keep, 0.0, -np.inf).astype(dtype)[None, None]
+
+
+def dot_product_attention(
+    q: jax.Array,  # [b, sq, n_heads, d]
+    k: jax.Array,  # [b, sk, kv_heads, d]
+    v: jax.Array,  # [b, sk, kv_heads, d]
+    *,
+    causal: bool = True,
+    bias: jax.Array | None = None,  # additive [b or 1, 1 or h, sq, sk]
+    segment_ids: jax.Array | None = None,  # [b, s] packed-seq boundaries
+    softmax_scale: float | None = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+    softmax_in_fp32: bool = True,
+) -> jax.Array:
+    b, sq, n_heads, d = q.shape
+    _, sk, kv_heads, _ = k.shape
+    group = n_heads // kv_heads
+    if softmax_scale is None:
+        softmax_scale = 1.0 / float(np.sqrt(d))
+
+    qg = q.reshape(b, sq, kv_heads, group, d)
+    # scores: [b, kv_heads, group, sq, sk]
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * softmax_scale
+
+    if causal:
+        scores = scores + make_causal_mask(sq, sk, scores.dtype)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :sq, None] == segment_ids[:, None, :sk]
+        scores = jnp.where(seg_mask[:, None, None], scores, -np.inf)
+    if bias is not None:
+        # bias comes in as [b,h,sq,sk]; fold h into (kv_heads, group)
+        bias_ = bias
+        if bias_.shape[1] == n_heads:
+            bias_ = bias_.reshape(b, kv_heads, group, sq, sk)
+        else:
+            bias_ = bias_[:, :, None]
+        scores = scores + bias_
+
+    if softmax_in_fp32:
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
+    # Guard fully-masked rows (padding-only segments) against NaN.
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    probs = probs.astype(v.dtype)
+
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(b, sq, n_heads, d)
+
+
+def attention(
+    q, k, v, *,
+    impl: str = "dot",
+    causal: bool = True,
+    segment_ids=None,
+    softmax_scale=None,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
+    bias=None,
+) -> jax.Array:
+    """Dispatcher: 'flash' → Pallas kernel (TPU), 'dot' → XLA einsum path."""
+    if impl == "flash" and bias is None and dropout_rate == 0.0:
+        try:
+            from ..kernels.flash_attention import flash_attention
+        except ImportError:
+            # Kernel module genuinely unavailable → einsum fallback (the
+            # availability-fallback pattern of fused_softmax.py:152-172).
+            # Errors *inside* an available kernel propagate — silent numeric
+            # fallback would mask kernel bugs.
+            _warn_flash_fallback()
+        else:
+            return flash_attention(
+                q, k, v, causal=causal, segment_ids=segment_ids,
+                softmax_scale=softmax_scale,
+            )
+    return dot_product_attention(
+        q, k, v, causal=causal, segment_ids=segment_ids,
+        softmax_scale=softmax_scale, dropout_rate=dropout_rate,
+        dropout_rng=dropout_rng, bias=bias,
+    )

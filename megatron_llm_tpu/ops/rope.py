@@ -1,0 +1,86 @@
+"""Rotary position embeddings with linear position-interpolation scaling.
+
+The reference precomputes complex ``freqs_cis`` and applies them by complex
+multiplication (megatron/model/positional_embeddings.py:7-51); the scaling
+factor divides positions (``t / scaling_factor``) for Code-Llama style long
+context.  Here the same math is expressed in real arithmetic over interleaved
+pairs — the layout matches the reference/Meta convention (pairs are adjacent
+elements x[..., 0::2], x[..., 1::2]), which is also what the HF checkpoint
+permutation in the weight converter assumes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def precompute_rope_freqs(
+    head_dim: int,
+    max_positions: int,
+    theta: float = 10000.0,
+    scaling_factor: float = 1.0,
+    dtype=jnp.float32,
+) -> tuple[jax.Array, jax.Array]:
+    """Return (cos, sin), each [max_positions, head_dim//2].
+
+    Parity: megatron/model/positional_embeddings.py:7-13 — including the
+    linear position interpolation ``t / scaling_factor`` used for 16k/32k
+    Code-Llama contexts.
+    """
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_positions, dtype=jnp.float32) / scaling_factor
+    freqs = jnp.outer(t, inv_freq)  # [pos, dim/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    position_ids: jax.Array | None = None,
+) -> jax.Array:
+    """Rotate ``x`` [..., seq, heads, head_dim] by the precomputed tables.
+
+    Interleaved-pair convention (x0,x1 adjacent), matching the complex-mult
+    formulation of megatron/model/positional_embeddings.py:24-51.  Supports
+    non-monotonic ``position_ids`` [batch, seq] for packed sequences /
+    inference with KV caches (reference ``position_ids`` arg, :33-44).
+    """
+    seq_axis = x.ndim - 3
+    if position_ids is None:
+        seq = x.shape[seq_axis]
+        cos_t = cos[:seq]
+        sin_t = sin[:seq]
+        # [seq, dim/2] -> broadcast to [..., seq, 1, dim/2]
+        shape = [1] * x.ndim
+        shape[seq_axis] = seq
+        shape[-1] = cos.shape[-1]
+        cos_t = cos_t.reshape(shape)
+        sin_t = sin_t.reshape(shape)
+    else:
+        # position_ids: [batch, seq] → tables [batch, seq, 1, dim/2]
+        cos_t = cos[position_ids][..., None, :]
+        sin_t = sin[position_ids][..., None, :]
+
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    cos_t = cos_t.astype(jnp.float32)
+    sin_t = sin_t.astype(jnp.float32)
+    x1f = x1.astype(jnp.float32)
+    x2f = x2.astype(jnp.float32)
+    r1 = x1f * cos_t - x2f * sin_t
+    r2 = x2f * cos_t + x1f * sin_t
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def apply_rope_single(x, cos, sin, position: int):
+    """Single-position variant for incremental decoding."""
+    pos = jnp.full(x.shape[:1] + x.shape[1:2], position, dtype=jnp.int32)
+    return apply_rope(x, cos, sin, position_ids=pos)

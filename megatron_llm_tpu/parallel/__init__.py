@@ -1,0 +1,1 @@
+from . import cross_entropy, mesh  # noqa: F401

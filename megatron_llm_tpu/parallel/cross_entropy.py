@@ -1,0 +1,141 @@
+"""Vocab-parallel cross entropy.
+
+The reference computes a numerically-stable CE over vocab-sharded logits
+with three all-reduces (max, predicted-logit, sum-exp) and a custom backward
+(megatron/core/tensor_parallel/cross_entropy.py:14-130).  On TPU there are
+two equivalent expressions, both provided here:
+
+- ``cross_entropy``: plain stable jnp log-softmax CE.  Under GSPMD with the
+  logits sharded P(dp, None, tp) on the vocab axis, XLA lowers the max /
+  take / logsumexp reductions into exactly the psum trio the reference hand
+  codes — this is the default path.
+- ``vocab_parallel_cross_entropy_shardmap``: explicit shard_map version with
+  the psums written out, for use inside manually-partitioned regions (the
+  pipeline loop) and as an executable spec of the math.
+
+Both support label smoothing (reference :83-116) and return per-token losses
+so callers apply their own loss masks (finetune.py:196-213).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def cross_entropy(
+    logits: jax.Array,  # [..., vocab] (may be padded)
+    targets: jax.Array,  # [...] int
+    label_smoothing: float = 0.0,
+    vocab_size: int | None = None,
+) -> jax.Array:
+    """Stable per-token CE.  ``vocab_size`` masks padded vocab columns."""
+    logits = logits.astype(jnp.float32)
+    width = logits.shape[-1]
+    valid = None
+    if vocab_size is not None and vocab_size < width:
+        valid = jnp.arange(width) < vocab_size
+        logits = jnp.where(valid, logits, -1e30)
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    shifted = logits - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
+    target_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1
+    )[..., 0]
+    loss = lse - target_logit
+    if label_smoothing > 0.0:
+        # Reference smoothing (cross_entropy.py:71-86):
+        #   s = ls * K / (K - 1);  loss = (1-s)*nll - s*mean(log_probs)
+        # computed over the K *real* vocab columns only (padded columns are
+        # excluded — they carry the -1e30 sentinel).
+        n = vocab_size if vocab_size is not None else width
+        smoothing = label_smoothing * n / (n - 1)
+        logits_for_sum = logits if valid is None else jnp.where(valid, logits, 0.0)
+        sum_log_probs = jnp.sum(logits_for_sum, axis=-1) - n * lse
+        loss = (1.0 - smoothing) * loss - smoothing * (sum_log_probs / n)
+    return loss
+
+
+def _ce_shard(logits_shard, targets, axis_name, label_smoothing, vocab_size):
+    """Per-shard body: the psum trio of the reference custom autograd
+    (cross_entropy.py:14-95) expressed with differentiable collectives."""
+    tp = jax.lax.psum(1, axis_name)
+    shard_v = logits_shard.shape[-1]
+    rank = jax.lax.axis_index(axis_name)
+    vocab_start = rank * shard_v
+    full_v = shard_v * tp
+
+    logits_shard = logits_shard.astype(jnp.float32)
+    # Mask padded vocab columns (global column index >= vocab_size) so both
+    # CE implementations agree on padded vocabs.
+    valid = None
+    if vocab_size is not None:
+        valid = (vocab_start + jnp.arange(shard_v)) < vocab_size
+        logits_shard = jnp.where(valid, logits_shard, -1e30)
+
+    # all-reduce #1: global max
+    local_max = jnp.max(logits_shard, axis=-1)
+    global_max = jax.lax.pmax(jax.lax.stop_gradient(local_max), axis_name)
+    shifted = logits_shard - global_max[..., None]
+
+    # all-reduce #2: predicted (target) logit — mask targets outside shard
+    local_t = targets - vocab_start
+    in_shard = (local_t >= 0) & (local_t < shard_v)
+    local_t = jnp.clip(local_t, 0, shard_v - 1)
+    tl = jnp.take_along_axis(shifted, local_t[..., None], axis=-1)[..., 0]
+    target_logit = jax.lax.psum(jnp.where(in_shard, tl, 0.0), axis_name)
+
+    # all-reduce #3: sum of exp
+    sum_exp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
+    loss = jnp.log(sum_exp) - target_logit
+    if label_smoothing > 0.0:
+        # Same formula as ``cross_entropy`` (reference cross_entropy.py:71-86),
+        # over real vocab columns only; shifted is relative to global_max so
+        # the lse used here must be too.
+        n = vocab_size if vocab_size is not None else full_v
+        smoothing = label_smoothing * n / (n - 1)
+        lse = jnp.log(sum_exp)
+        shifted_for_sum = shifted if valid is None else jnp.where(valid, shifted, 0.0)
+        sum_log_probs = (
+            jax.lax.psum(jnp.sum(shifted_for_sum, axis=-1), axis_name) - n * lse
+        )
+        loss = (1.0 - smoothing) * loss - smoothing * (sum_log_probs / n)
+    return loss
+
+
+def vocab_parallel_cross_entropy_shardmap(
+    logits: jax.Array,  # [b, s, vocab] sharded on vocab over 'tp'
+    targets: jax.Array,  # [b, s]
+    mesh,
+    axis_name: str = "tp",
+    label_smoothing: float = 0.0,
+    vocab_size: int | None = None,
+) -> jax.Array:
+    from jax import shard_map
+
+    fn = shard_map(
+        partial(_ce_shard, axis_name=axis_name,
+                label_smoothing=label_smoothing, vocab_size=vocab_size),
+        mesh=mesh,
+        in_specs=(P(None, None, axis_name), P(None, None)),
+        out_specs=P(None, None),
+    )
+    return fn(logits, targets)
+
+
+def vocab_parallel_max_indices(logits: jax.Array) -> jax.Array:
+    """Greedy argmax over (possibly sharded) vocab logits
+    (reference: cross_entropy.py:146-175).  Under GSPMD a plain argmax
+    lowers to the shard-local argmax + cross-shard reduce."""
+    return jnp.argmax(logits, axis=-1)
+
+
+def masked_mean_loss(per_token_loss: jax.Array, loss_mask: jax.Array):
+    """Loss-mask weighted mean (reference: finetune.py:196-213)."""
+    loss_mask = loss_mask.astype(per_token_loss.dtype)
+    total = jnp.sum(per_token_loss * loss_mask)
+    denom = jnp.maximum(jnp.sum(loss_mask), 1.0)
+    return total / denom

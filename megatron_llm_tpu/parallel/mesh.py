@@ -1,0 +1,194 @@
+"""Device-mesh topology — the TPU-native ``parallel_state`` equivalent.
+
+The reference builds NCCL process subgroups for data/tensor/pipeline/model/
+embedding parallelism from a flat world, with TP innermost and DP strided
+(reference: megatron/core/parallel_state.py:51-214 and group getters
+:217-481).  On TPU the whole topology is one ``jax.sharding.Mesh`` with named
+axes; collectives are expressed against axis names and placement against
+``PartitionSpec``s, so the group-getter zoo becomes pure functions of the
+mesh.  Axis order is (dp, pp, cp, tp): tp fastest-varying so TP collectives
+ride ICI neighbors; dp outermost so multi-slice deployments put dp on DCN
+(reference rank-order parity: parallel_state.py docstring example).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import ParallelConfig
+
+# Canonical axis names.
+DATA_AXIS = "dp"
+PIPELINE_AXIS = "pp"
+CONTEXT_AXIS = "cp"
+TENSOR_AXIS = "tp"
+AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+
+
+def build_mesh(
+    parallel: ParallelConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create the (dp, pp, cp, tp) mesh.
+
+    Replaces ``mpu.initialize_model_parallel(tp, pp, vpp, split_rank)``
+    (reference: megatron/core/parallel_state.py:51).  Uses
+    ``mesh_utils.create_device_mesh`` when the requested shape covers all
+    devices so the assignment respects the physical ICI topology.
+    """
+    if devices is None:
+        devices = jax.devices()
+    shape = (
+        parallel.data_parallel,
+        parallel.pipeline_parallel,
+        parallel.context_parallel,
+        parallel.tensor_parallel,
+    )
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {n} devices, have {len(devices)}"
+        )
+    if n == len(devices):
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:
+            dev_array = np.asarray(devices).reshape(shape)
+    else:
+        dev_array = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    if device is None:
+        device = jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), AXIS_ORDER)
+
+
+# ---------------------------------------------------------------------------
+# Topology queries (group getters, reference parallel_state.py:217-481)
+# ---------------------------------------------------------------------------
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def tensor_parallel_size(mesh: Mesh) -> int:
+    return axis_size(mesh, TENSOR_AXIS)
+
+
+def pipeline_parallel_size(mesh: Mesh) -> int:
+    return axis_size(mesh, PIPELINE_AXIS)
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    return axis_size(mesh, DATA_AXIS)
+
+
+def context_parallel_size(mesh: Mesh) -> int:
+    return axis_size(mesh, CONTEXT_AXIS)
+
+
+def pipeline_stage_layers(num_layers: int, pp: int, vpp: int = 1) -> list[int]:
+    """Layers per pipeline stage (must divide evenly, like the reference's
+    num_layers // transformer_pipeline_model_parallel_size at
+    megatron/model/transformer.py:845-895)."""
+    chunks = pp * vpp
+    assert num_layers % chunks == 0, (
+        f"num_layers {num_layers} must divide pipeline stages {chunks}"
+    )
+    return [num_layers // chunks] * chunks
+
+
+def is_first_stage(stage: int) -> bool:
+    return stage == 0
+
+
+def is_last_stage(stage: int, pp: int) -> bool:
+    return stage == pp - 1
+
+
+def prev_stage(stage: int, pp: int) -> int:
+    """Reference: get_pipeline_model_parallel_prev_rank
+    (parallel_state.py:463-471) — cyclic neighbor on the pp axis."""
+    return (stage - 1) % pp
+
+
+def next_stage(stage: int, pp: int) -> int:
+    return (stage + 1) % pp
+
+
+# ---------------------------------------------------------------------------
+# Sharding helpers
+# ---------------------------------------------------------------------------
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+_MESH_STACK: list[Mesh] = []
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Context manager establishing the active mesh (and jax's own
+    ``jax.sharding.use_mesh`` scope when available)."""
+    _MESH_STACK.append(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _MESH_STACK.pop()
+
+
+def current_mesh() -> Optional[Mesh]:
+    if _MESH_STACK:
+        return _MESH_STACK[-1]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Deterministic RNG (replaces the CUDA rng-state tracker,
+# reference: megatron/core/tensor_parallel/random.py:64-172)
+# ---------------------------------------------------------------------------
+
+# The reference forks CUDA RNG state so TP ranks share the data-parallel
+# dropout stream but differ inside TP regions (seed = base + 2718 + tp_rank).
+# In JAX, randomness is functional: fold the axis index into the key inside
+# shard_map/vmap when per-shard streams are needed, otherwise keys are global
+# and XLA generates identical streams on replicated program text.
+
+TP_SALT = 2718  # parity with reference seed offset (random.py:160-172)
+PP_SALT = 100  # per-stage seed offset (reference: initialize.py:179-193)
+
+
+def fold_in_axis(key: jax.Array, axis_name: str, salt: int = TP_SALT) -> jax.Array:
+    """Inside shard_map: derive a per-shard key along ``axis_name``."""
+    idx = jax.lax.axis_index(axis_name)
+    return jax.random.fold_in(jax.random.fold_in(key, salt), idx)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxes:
+    """Bundle of logical-axis → mesh-axis assignments used by the sharding
+    rules in models/sharding.py.  Kept as a dataclass so alternative layouts
+    (e.g. 2D tp×ep) can be introduced without touching model code."""
+
+    dp: str = DATA_AXIS
+    pp: str = PIPELINE_AXIS
+    cp: str = CONTEXT_AXIS
+    tp: str = TENSOR_AXIS

@@ -1,0 +1,326 @@
+"""HuggingFace ↔ native weight conversion.
+
+Parity with the reference's ``weights_conversion/hf_to_megatron.py`` and
+``megatron_to_hf.py`` (incl. the QKV rotary permutation semantics of
+``weights_conversion/utils/permute_qkv.py``): HF Llama checkpoints store Q/K
+projections in the "rotate-half" layout, while this framework (like
+Meta/Megatron) applies RoPE to interleaved even/odd pairs — so Q/K weights
+are (un)permuted on the way in/out.
+
+All conversion happens on host numpy (no device memory); outputs are the
+native parameter pytree of ``models/model.py`` with layers stacked on the
+leading axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+import numpy as np
+
+from ..config import ModelConfig
+
+Array = np.ndarray
+
+
+# ---------------------------------------------------------------------------
+# Rotary layout permutation (reference: weights_conversion/utils/permute_qkv.py)
+# ---------------------------------------------------------------------------
+
+
+def hf_to_interleaved(w: Array, n_heads: int, head_dim: int) -> Array:
+    """Invert HF's rotate-half permutation on a [n*d, in] projection weight.
+
+    HF stores ``w_hf = w.view(n, d//2, 2, in).transpose(1, 2).reshape(...)``
+    of the interleaved original; this inverts it.
+    """
+    out_dim, in_dim = w.shape
+    assert out_dim == n_heads * head_dim
+    w = w.reshape(n_heads, 2, head_dim // 2, in_dim)
+    w = np.transpose(w, (0, 2, 1, 3))
+    return w.reshape(out_dim, in_dim)
+
+
+def interleaved_to_hf(w: Array, n_heads: int, head_dim: int) -> Array:
+    out_dim, in_dim = w.shape
+    assert out_dim == n_heads * head_dim
+    w = w.reshape(n_heads, head_dim // 2, 2, in_dim)
+    w = np.transpose(w, (0, 2, 1, 3))
+    return w.reshape(out_dim, in_dim)
+
+
+def _pad_rows(w: Array, rows: int) -> Array:
+    if w.shape[0] == rows:
+        return w
+    pad = np.zeros((rows - w.shape[0],) + w.shape[1:], dtype=w.dtype)
+    return np.concatenate([w, pad], axis=0)
+
+
+def _np(t) -> Array:
+    """torch tensor / numpy → float32 numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu")
+        try:
+            import torch
+
+            if t.dtype == torch.bfloat16:
+                t = t.float()
+        except Exception:
+            pass
+        t = t.numpy()
+    return np.asarray(t)
+
+
+# ---------------------------------------------------------------------------
+# Llama / Code Llama  (reference: hf_to_megatron.py llama_to_megatron)
+# ---------------------------------------------------------------------------
+
+
+def llama_from_hf(
+    state_dict: Mapping[str, "Array"],
+    cfg: ModelConfig,
+    tp: int = 1,
+    dtype=np.float32,
+) -> dict:
+    """HF LlamaForCausalLM state dict → native param pytree."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    h = cfg.hidden_size
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    nkv = cfg.kv_heads
+    v_padded = cfg.padded_vocab_size(tp)
+
+    def stack(fn: Callable[[int], Array]) -> Array:
+        return np.stack([fn(i) for i in range(cfg.num_layers)]).astype(dtype)
+
+    def pfx(i: int) -> str:
+        return f"model.layers.{i}."
+
+    params = {
+        "embedding": {
+            "word": _pad_rows(sd["model.embed_tokens.weight"], v_padded
+                              ).astype(dtype),
+        },
+        "layers": {
+            "input_norm": {
+                "scale": stack(lambda i: sd[pfx(i) + "input_layernorm.weight"]),
+            },
+            "post_attn_norm": {
+                "scale": stack(
+                    lambda i: sd[pfx(i) + "post_attention_layernorm.weight"]),
+            },
+            "attn": {
+                "wq": stack(lambda i: hf_to_interleaved(
+                    sd[pfx(i) + "self_attn.q_proj.weight"], nq, d).T),
+                "wk": stack(lambda i: hf_to_interleaved(
+                    sd[pfx(i) + "self_attn.k_proj.weight"], nkv, d).T),
+                "wv": stack(lambda i: sd[pfx(i) + "self_attn.v_proj.weight"].T),
+                "wo": stack(lambda i: sd[pfx(i) + "self_attn.o_proj.weight"].T),
+            },
+            "mlp": {
+                "w_gate": stack(lambda i: sd[pfx(i) + "mlp.gate_proj.weight"].T),
+                "w_up": stack(lambda i: sd[pfx(i) + "mlp.up_proj.weight"].T),
+                "w_down": stack(lambda i: sd[pfx(i) + "mlp.down_proj.weight"].T),
+            },
+        },
+        "final_norm": {"scale": sd["model.norm.weight"].astype(dtype)},
+        "lm_head": _pad_rows(sd["lm_head.weight"], v_padded).T.astype(dtype),
+    }
+    return params
+
+
+def llama_to_hf(params: dict, cfg: ModelConfig) -> dict:
+    """Native param pytree → HF LlamaForCausalLM state dict (numpy values).
+
+    Inverse of ``llama_from_hf`` (reference: megatron_to_hf.py:80-197).
+    """
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    nkv = cfg.kv_heads
+    v = cfg.vocab_size
+    to_np = lambda x: np.asarray(x, dtype=np.float32)
+
+    sd = {
+        "model.embed_tokens.weight": to_np(params["embedding"]["word"])[:v],
+        "model.norm.weight": to_np(params["final_norm"]["scale"]),
+        "lm_head.weight": to_np(params["lm_head"]).T[:v],
+    }
+    L = params["layers"]
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = to_np(
+            L["input_norm"]["scale"][i])
+        sd[p + "post_attention_layernorm.weight"] = to_np(
+            L["post_attn_norm"]["scale"][i])
+        sd[p + "self_attn.q_proj.weight"] = interleaved_to_hf(
+            to_np(L["attn"]["wq"][i]).T, nq, d)
+        sd[p + "self_attn.k_proj.weight"] = interleaved_to_hf(
+            to_np(L["attn"]["wk"][i]).T, nkv, d)
+        sd[p + "self_attn.v_proj.weight"] = to_np(L["attn"]["wv"][i]).T
+        sd[p + "self_attn.o_proj.weight"] = to_np(L["attn"]["wo"][i]).T
+        sd[p + "mlp.gate_proj.weight"] = to_np(L["mlp"]["w_gate"][i]).T
+        sd[p + "mlp.up_proj.weight"] = to_np(L["mlp"]["w_up"][i]).T
+        sd[p + "mlp.down_proj.weight"] = to_np(L["mlp"]["w_down"][i]).T
+    return sd
+
+
+# ---------------------------------------------------------------------------
+# Falcon  (reference: hf_to_megatron.py falcon_to_megatron)
+# ---------------------------------------------------------------------------
+
+
+def _split_falcon_qkv(fused: Array, cfg: ModelConfig):
+    """Falcon HF fuses QKV as [kv_heads, group_q + 1 k + 1 v, d, in]."""
+    h = cfg.hidden_size
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    nkv = cfg.kv_heads
+    group = nq // nkv
+    w = fused.reshape(nkv, group + 2, d, -1)
+    q = w[:, :group].reshape(nq * d, -1)
+    k = w[:, group].reshape(nkv * d, -1)
+    v = w[:, group + 1].reshape(nkv * d, -1)
+    return q, k, v
+
+
+def falcon_from_hf(
+    state_dict: Mapping[str, "Array"],
+    cfg: ModelConfig,
+    tp: int = 1,
+    dtype=np.float32,
+) -> dict:
+    """HF FalconForCausalLM state dict → native param pytree.
+
+    Handles both falcon-7b (single input_layernorm) and falcon-40b
+    (ln_attn + ln_mlp parallel layernorms).
+    """
+    sd = {k.replace("transformer.", ""): _np(v) for k, v in state_dict.items()}
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    nkv = cfg.kv_heads
+    v_padded = cfg.padded_vocab_size(tp)
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(cfg.num_layers)]).astype(dtype)
+
+    def pfx(i):
+        return f"h.{i}."
+
+    def ln_name(i, which):
+        # 7b: input_layernorm; 40b: ln_attn / ln_mlp
+        if pfx(i) + "ln_attn.weight" in sd:
+            return pfx(i) + ("ln_attn" if which == "attn" else "ln_mlp")
+        return pfx(i) + "input_layernorm"
+
+    def qkv(i, idx):
+        q, k, v = _split_falcon_qkv(
+            sd[pfx(i) + "self_attention.query_key_value.weight"], cfg)
+        # HF Falcon uses rotate-half RoPE → unpermute to interleaved.
+        q = hf_to_interleaved(q, nq, d)
+        k = hf_to_interleaved(k, nkv, d)
+        return (q, k, v)[idx]
+
+    layers = {
+        "input_norm": {
+            "scale": stack(lambda i: sd[ln_name(i, "attn") + ".weight"]),
+            "bias": stack(lambda i: sd[ln_name(i, "attn") + ".bias"]),
+        },
+        "attn": {
+            "wq": stack(lambda i: qkv(i, 0).T),
+            "wk": stack(lambda i: qkv(i, 1).T),
+            "wv": stack(lambda i: qkv(i, 2).T),
+            "wo": stack(lambda i: sd[pfx(i) + "self_attention.dense.weight"].T),
+        },
+        "mlp": {
+            "w_up": stack(
+                lambda i: sd[pfx(i) + "mlp.dense_h_to_4h.weight"].T),
+            "w_down": stack(
+                lambda i: sd[pfx(i) + "mlp.dense_4h_to_h.weight"].T),
+        },
+    }
+    if cfg.parallel_layernorm:
+        layers["mlp_norm"] = {
+            "scale": stack(lambda i: sd[ln_name(i, "mlp") + ".weight"]),
+            "bias": stack(lambda i: sd[ln_name(i, "mlp") + ".bias"]),
+        }
+    params = {
+        "embedding": {
+            "word": _pad_rows(sd["word_embeddings.weight"], v_padded
+                              ).astype(dtype),
+        },
+        "layers": layers,
+        "final_norm": {
+            "scale": sd["ln_f.weight"].astype(dtype),
+            "bias": sd["ln_f.bias"].astype(dtype),
+        },
+    }
+    return params
+
+
+# ---------------------------------------------------------------------------
+# GPT-2  (inherited family; HF GPT2LMHeadModel uses Conv1D = transposed linear)
+# ---------------------------------------------------------------------------
+
+
+def gpt2_from_hf(state_dict, cfg: ModelConfig, tp: int = 1,
+                 dtype=np.float32) -> dict:
+    sd = {k.replace("transformer.", ""): _np(v) for k, v in state_dict.items()}
+    h = cfg.hidden_size
+    v_padded = cfg.padded_vocab_size(tp)
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(cfg.num_layers)]).astype(dtype)
+
+    def pfx(i):
+        return f"h.{i}."
+
+    def qkv_w(i, idx):  # Conv1D weight [in, 3h]
+        return np.split(sd[pfx(i) + "attn.c_attn.weight"], 3, axis=1)[idx]
+
+    def qkv_b(i, idx):
+        return np.split(sd[pfx(i) + "attn.c_attn.bias"], 3, axis=0)[idx]
+
+    params = {
+        "embedding": {
+            "word": _pad_rows(sd["wte.weight"], v_padded).astype(dtype),
+            "position": sd["wpe.weight"].astype(dtype),
+        },
+        "layers": {
+            "input_norm": {
+                "scale": stack(lambda i: sd[pfx(i) + "ln_1.weight"]),
+                "bias": stack(lambda i: sd[pfx(i) + "ln_1.bias"]),
+            },
+            "post_attn_norm": {
+                "scale": stack(lambda i: sd[pfx(i) + "ln_2.weight"]),
+                "bias": stack(lambda i: sd[pfx(i) + "ln_2.bias"]),
+            },
+            "attn": {
+                "wq": stack(lambda i: qkv_w(i, 0)),
+                "wk": stack(lambda i: qkv_w(i, 1)),
+                "wv": stack(lambda i: qkv_w(i, 2)),
+                "wo": stack(lambda i: sd[pfx(i) + "attn.c_proj.weight"]),
+                "bq": stack(lambda i: qkv_b(i, 0)),
+                "bk": stack(lambda i: qkv_b(i, 1)),
+                "bv": stack(lambda i: qkv_b(i, 2)),
+                "bo": stack(lambda i: sd[pfx(i) + "attn.c_proj.bias"]),
+            },
+            "mlp": {
+                "w_up": stack(lambda i: sd[pfx(i) + "mlp.c_fc.weight"]),
+                "b_up": stack(lambda i: sd[pfx(i) + "mlp.c_fc.bias"]),
+                "w_down": stack(lambda i: sd[pfx(i) + "mlp.c_proj.weight"]),
+                "b_down": stack(lambda i: sd[pfx(i) + "mlp.c_proj.bias"]),
+            },
+        },
+        "final_norm": {
+            "scale": sd["ln_f.weight"].astype(dtype),
+            "bias": sd["ln_f.bias"].astype(dtype),
+        },
+    }
+    return params
+
+
+CONVERTERS_FROM_HF = {
+    "llama": llama_from_hf,
+    "falcon": falcon_from_hf,
+    "gpt2": gpt2_from_hf,
+}

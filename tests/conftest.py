@@ -1,0 +1,73 @@
+"""Test bootstrap: hermetic 8-device CPU mesh.
+
+The reference's distributed tests need real GPUs under torchrun
+(tests/test_utilities.py:6-30 in the reference).  Here every parallelism
+test runs on CPU with 8 virtual XLA devices, so the full tp/pp/dp/sp test
+matrix is hermetic (SURVEY.md §4).
+"""
+
+import os
+
+# The suite needs an 8-device CPU mesh.  XLA_FLAGS is read at backend
+# initialization (first jax.devices()), so setting it here is early enough
+# even when a sitecustomize module (axon TPU tunnel) imported jax at
+# interpreter startup; the platform itself must then be forced through
+# jax.config because such environments pin jax_platforms programmatically.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+# ---------------------------------------------------------------------------
+# Incremental marker: later steps of a pipeline test skip after an earlier
+# failure (parity with reference tests/conftest.py:23-60).
+# ---------------------------------------------------------------------------
+
+_incremental_failures: dict = {}
+
+
+def pytest_runtest_makereport(item, call):
+    if "incremental" in item.keywords and call.excinfo is not None:
+        cls = item.getparent(pytest.Class)
+        if cls is not None:
+            _incremental_failures.setdefault(cls.name, item.name)
+
+
+def pytest_runtest_setup(item):
+    if "incremental" in item.keywords:
+        cls = item.getparent(pytest.Class)
+        if cls is not None and cls.name in _incremental_failures:
+            pytest.xfail(
+                f"previous step failed ({_incremental_failures[cls.name]})"
+            )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "incremental: xfail-chain steps within a test class"
+    )
+    config.addinivalue_line("markers", "tpu: requires real TPU hardware")

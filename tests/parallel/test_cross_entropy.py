@@ -1,0 +1,111 @@
+"""Vocab-parallel cross entropy tests (parity: reference
+tests/tensor_parallel/test_cross_entropy.py + mpu/tests/test_cross_entropy.py
+— there the check is TP-sharded CE vs serial torch CE after identical
+seeding; here shard_map CE vs the plain stable CE, plus grads)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu.parallel.cross_entropy import (
+    cross_entropy,
+    masked_mean_loss,
+    vocab_parallel_cross_entropy_shardmap,
+)
+
+
+def _ref_ce(logits, targets):
+    logits = np.asarray(logits, np.float64)
+    m = logits.max(-1, keepdims=True)
+    lse = np.log(np.exp(logits - m).sum(-1)) + m[..., 0]
+    tl = np.take_along_axis(logits, targets[..., None], -1)[..., 0]
+    return lse - tl
+
+
+@pytest.fixture
+def tp_mesh(devices):
+    return Mesh(np.asarray(devices).reshape(1, 1, 1, 8), ("dp", "pp", "cp", "tp"))
+
+
+def test_cross_entropy_matches_numpy(rng):
+    logits = jnp.asarray(rng.normal(size=(2, 8, 40)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, 40, (2, 8)), jnp.int32)
+    got = cross_entropy(logits, targets)
+    np.testing.assert_allclose(got, _ref_ce(logits, targets), rtol=1e-5)
+
+
+def test_padded_vocab_masking(rng):
+    """Padded columns must not contribute, with or without smoothing."""
+    logits = jnp.asarray(rng.normal(size=(2, 8, 40)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, 32, (2, 8)), jnp.int32)
+    # huge logits in padded region must be ignored
+    poisoned = logits.at[..., 32:].set(100.0)
+    got = cross_entropy(poisoned, targets, vocab_size=32)
+    want = _ref_ce(logits[..., :32], targets)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    # label smoothing over padded vocab stays finite and equals the
+    # unpadded-computed value
+    sm_pad = cross_entropy(poisoned, targets, label_smoothing=0.1, vocab_size=32)
+    sm_ref = cross_entropy(logits[..., :32], targets, label_smoothing=0.1)
+    np.testing.assert_allclose(sm_pad, sm_ref, rtol=1e-5)
+    assert float(jnp.max(jnp.abs(sm_pad))) < 1e3
+
+
+def test_label_smoothing_reference_formula(rng):
+    """loss = (1-s)*nll - s*mean(log_probs), s = ls*K/(K-1)
+    (reference cross_entropy.py:71-86)."""
+    K = 16
+    ls = 0.1
+    logits = jnp.asarray(rng.normal(size=(4, K)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, K, (4,)), jnp.int32)
+    log_probs = np.asarray(jax.nn.log_softmax(logits, -1), np.float64)
+    nll = -np.take_along_axis(log_probs, np.asarray(targets)[:, None], -1)[:, 0]
+    s = ls * K / (K - 1)
+    want = (1 - s) * nll - s * log_probs.mean(-1)
+    got = cross_entropy(logits, targets, label_smoothing=ls)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("smoothing,vocab_size", [(0.0, None), (0.1, 56)])
+def test_shardmap_matches_plain(tp_mesh, rng, smoothing, vocab_size):
+    logits = jnp.asarray(rng.normal(size=(2, 4, 64)) * 3, jnp.float32)
+    targets = jnp.asarray(rng.integers(0, vocab_size or 64, (2, 4)), jnp.int32)
+    sharded = jax.device_put(
+        logits, NamedSharding(tp_mesh, P(None, None, "tp")))
+    got = vocab_parallel_cross_entropy_shardmap(
+        sharded, targets, tp_mesh, label_smoothing=smoothing,
+        vocab_size=vocab_size)
+    want = cross_entropy(logits, targets, label_smoothing=smoothing,
+                         vocab_size=vocab_size)
+    np.testing.assert_allclose(got, want, rtol=2e-5)
+
+
+def test_shardmap_gradients_match(tp_mesh, rng):
+    """The custom-backward parity check: d loss / d logits must agree."""
+    logits = jnp.asarray(rng.normal(size=(2, 4, 64)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, 64, (2, 4)), jnp.int32)
+
+    def loss_plain(lg):
+        return jnp.mean(cross_entropy(lg, targets))
+
+    def loss_sm(lg):
+        return jnp.mean(
+            vocab_parallel_cross_entropy_shardmap(lg, targets, tp_mesh))
+
+    g1 = jax.grad(loss_plain)(logits)
+    sharded = jax.device_put(
+        logits, NamedSharding(tp_mesh, P(None, None, "tp")))
+    g2 = jax.grad(loss_sm)(sharded)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-6)
+
+
+def test_masked_mean_loss(rng):
+    loss = jnp.asarray(rng.normal(size=(2, 8)) ** 2, jnp.float32)
+    mask = jnp.zeros((2, 8)).at[:, :4].set(1.0)
+    got = masked_mean_loss(loss, mask)
+    np.testing.assert_allclose(got, np.asarray(loss)[:, :4].mean(), rtol=1e-6)
+    # all-masked → finite zero, no NaN
+    assert float(masked_mean_loss(loss, jnp.zeros((2, 8)))) == 0.0

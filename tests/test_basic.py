@@ -1,0 +1,34 @@
+"""Import smoke tests (parity: reference tests/test_basic.py)."""
+
+
+def test_imports():
+    import megatron_llm_tpu
+    from megatron_llm_tpu import config
+    from megatron_llm_tpu.models import families, model, sharding, transformer
+    from megatron_llm_tpu.ops import activations, attention, norms, rope
+    from megatron_llm_tpu.parallel import cross_entropy, mesh
+
+    assert megatron_llm_tpu.__version__
+
+
+def test_presets():
+    from megatron_llm_tpu.config import PRESETS, get_preset
+
+    for name in PRESETS:
+        cfg = get_preset(name)
+        assert cfg.hidden_size % cfg.num_attention_heads == 0
+
+
+def test_tiny_forward():
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.config import tiny_config
+    from megatron_llm_tpu.models import model
+
+    cfg = tiny_config()
+    params = model.init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((2, 16), dtype=jnp.int32)
+    logits = jax.jit(lambda p, t: model.forward(cfg, p, t))(params, tokens)
+    assert logits.shape == (2, 16, cfg.padded_vocab_size())
+    assert bool(jnp.all(jnp.isfinite(logits)))
