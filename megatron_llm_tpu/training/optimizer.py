@@ -1,0 +1,294 @@
+"""Mixed-precision AdamW/SGD with fp32 master weights, grad clipping, loss
+scaling, and ZeRO-1-style optimizer-state sharding.
+
+Reference mapping:
+- ``Float16OptimizerWithFloat16Params`` (megatron/optimizer/optimizer.py:469)
+  → fp32 ``master`` copies held in the optimizer state; model params stay
+  bf16/fp16 and are refreshed from the master after each step.
+- apex ``FusedAdam`` → the update is plain jnp math inside the jitted step;
+  XLA fuses the whole chain (no multi-tensor-apply needed on TPU).
+- ``clip_grad_norm_fp32`` (megatron/optimizer/clip_grads.py:16) →
+  ``global_norm``/``clip_by_global_norm`` as a single fused reduction over
+  the grad tree.  TP-duplicate exclusion is unnecessary: logical arrays are
+  never duplicated across shards under GSPMD.
+- ``DynamicGradScaler`` (megatron/optimizer/grad_scaler.py:53) →
+  ``ScalerState`` carried in the train state, pure-functional update.
+- ``DistributedOptimizer`` ZeRO-1 (megatron/optimizer/distrib_optimizer.py)
+  → ``zero1_specs``: optimizer-state leaves get an extra 'dp' sharding axis,
+  so master+moments are sharded across data-parallel ranks; GSPMD turns the
+  grad all-reduce + local update + param all-gather into reduce-scatter /
+  all-gather automatically.  The Range bookkeeping (distrib_optimizer.py:62-
+  118) has no equivalent — logical arrays subsume it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..config import OptimizerConfig, ParallelConfig
+
+PyTree = Any
+
+
+class ScalerState(NamedTuple):
+    """Dynamic loss scaler (reference: grad_scaler.py:53-121)."""
+
+    scale: jax.Array  # f32 scalar
+    growth_tracker: jax.Array  # i32: consecutive good steps
+    hysteresis: jax.Array  # i32: remaining bad steps before backoff
+
+
+class OptState(NamedTuple):
+    step: jax.Array  # i32 scalar
+    mu: PyTree  # first moment (fp32)
+    nu: Optional[PyTree]  # second moment (fp32) — None for sgd
+    master: Optional[PyTree]  # fp32 master params (None if params are fp32)
+    scaler: Optional[ScalerState]
+
+
+def _needs_master(params) -> bool:
+    return any(
+        p.dtype in (jnp.bfloat16, jnp.float16) for p in jax.tree.leaves(params)
+    )
+
+
+def init_scaler(cfg: OptimizerConfig) -> Optional[ScalerState]:
+    if cfg.loss_scale is not None:
+        # constant scaler: represented as dynamic state that never updates
+        return ScalerState(
+            scale=jnp.asarray(cfg.loss_scale, jnp.float32),
+            growth_tracker=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.asarray(-1, jnp.int32),  # -1 = constant
+        )
+    return None
+
+
+def init_dynamic_scaler(cfg: OptimizerConfig) -> ScalerState:
+    return ScalerState(
+        scale=jnp.asarray(cfg.initial_loss_scale, jnp.float32),
+        growth_tracker=jnp.zeros((), jnp.int32),
+        hysteresis=jnp.asarray(cfg.hysteresis, jnp.int32),
+    )
+
+
+def init_opt_state(params: PyTree, cfg: OptimizerConfig,
+                   use_fp16_scaler: bool = False) -> OptState:
+    f32_zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+    master = None
+    if _needs_master(params):
+        master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+    scaler = init_dynamic_scaler(cfg) if use_fp16_scaler else init_scaler(cfg)
+    return OptState(
+        step=jnp.zeros((), jnp.int32),
+        mu=jax.tree.map(f32_zeros, params),
+        # second moment only exists for adam-family optimizers
+        nu=jax.tree.map(f32_zeros, params) if cfg.optimizer == "adamw" else None,
+        master=master,
+        scaler=scaler,
+    )
+
+
+def global_grad_norm(grads: PyTree) -> jax.Array:
+    """Single fused L2 reduction (replaces apex multi_tensor_l2norm,
+    reference clip_grads.py:16-107)."""
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in jax.tree.leaves(grads)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+def clip_by_global_norm(grads: PyTree, max_norm: float, norm=None):
+    if norm is None:
+        norm = global_grad_norm(grads)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: g * factor.astype(g.dtype), grads), norm
+
+
+def count_zeros(grads: PyTree) -> jax.Array:
+    """Zero-grad diagnostic (reference clip_grads.py:110-136)."""
+    leaves = [jnp.sum(g == 0) for g in jax.tree.leaves(grads)]
+    return jnp.sum(jnp.stack(leaves))
+
+
+def _wd_mask(params: PyTree) -> PyTree:
+    """Weight decay applies to matmul weights only — biases and norm scales
+    (ndim<=1 in their per-layer form; <=2 when layer-stacked with a leading
+    layer axis handled below) are excluded (reference:
+    megatron/optimizer/__init__.py _get_params_for_weight_decay_optimization)."""
+
+    def mask(path, p):
+        names = [getattr(k, "key", getattr(k, "name", "")) for k in path]
+        if any("norm" in str(n) for n in names):
+            return 0.0
+        leaf_name = str(names[-1]) if names else ""
+        if leaf_name.startswith("b"):  # biases: bq/bk/bv/bo/b_up/...
+            return 0.0
+        return 1.0
+
+    return jax.tree_util.tree_map_with_path(mask, params)
+
+
+def adamw_step(
+    cfg: OptimizerConfig,
+    params: PyTree,
+    grads: PyTree,  # fp32, already unscaled & clipped
+    state: OptState,
+    lr: jax.Array,
+    wd: jax.Array,
+):
+    """One fused AdamW update on fp32 master params; returns (params, state).
+
+    The step-increment → bias-correction → moment update → param update chain
+    mirrors FusedAdam's math (what apex does in one kernel, XLA fuses here).
+    """
+    assert state.nu is not None, "adamw requires a second-moment tree"
+    step = state.step + 1
+    b1, b2, eps = cfg.adam_beta1, cfg.adam_beta2, cfg.adam_eps
+    c1 = 1.0 - b1 ** step.astype(jnp.float32)
+    c2 = 1.0 - b2 ** step.astype(jnp.float32)
+    wd_mask = _wd_mask(params)
+    masters = state.master if state.master is not None else params
+
+    def upd(m, g, mu, nu, wdm):
+        g = g.astype(jnp.float32)
+        mf = m.astype(jnp.float32)
+        mu = b1 * mu + (1.0 - b1) * g
+        nu = b2 * nu + (1.0 - b2) * jnp.square(g)
+        update = (mu / c1) / (jnp.sqrt(nu / c2) + eps)
+        update = update + wd * wdm * mf
+        return mf - lr * update, mu, nu
+
+    flat_m, treedef = jax.tree.flatten(masters)
+    flat_g = jax.tree.leaves(grads)
+    flat_mu = jax.tree.leaves(state.mu)
+    flat_nu = jax.tree.leaves(state.nu)
+    flat_wdm = jax.tree.leaves(wd_mask)
+    out = [upd(*t) for t in zip(flat_m, flat_g, flat_mu, flat_nu, flat_wdm)]
+    new_master = treedef.unflatten([o[0] for o in out])
+    new_mu = treedef.unflatten([o[1] for o in out])
+    new_nu = treedef.unflatten([o[2] for o in out])
+
+    if state.master is not None:
+        new_params = jax.tree.map(
+            lambda mp, p: mp.astype(p.dtype), new_master, params)
+        master_out = new_master
+    else:
+        new_params = new_master
+        master_out = None
+    return new_params, OptState(step, new_mu, new_nu, master_out, state.scaler)
+
+
+def sgd_step(cfg: OptimizerConfig, params, grads, state: OptState, lr, wd):
+    """Momentum SGD (reference optimizer choice 'sgd',
+    megatron/optimizer/__init__.py:81-86)."""
+    step = state.step + 1
+    wd_mask = _wd_mask(params)
+    masters = state.master if state.master is not None else params
+
+    def upd(m, g, mu, wdm):
+        g = g.astype(jnp.float32) + wd * wdm * m.astype(jnp.float32)
+        mu = cfg.sgd_momentum * mu + g
+        return m.astype(jnp.float32) - lr * mu, mu
+
+    new = jax.tree.map(upd, masters, grads, state.mu, wd_mask)
+    new_master = jax.tree.map(lambda t: t[0], new,
+                              is_leaf=lambda t: isinstance(t, tuple))
+    new_mu = jax.tree.map(lambda t: t[1], new,
+                          is_leaf=lambda t: isinstance(t, tuple))
+    if state.master is not None:
+        new_params = jax.tree.map(
+            lambda mp, p: mp.astype(p.dtype), new_master, params)
+        master_out = new_master
+    else:
+        new_params = new_master
+        master_out = None
+    return new_params, OptState(step, new_mu, state.nu, master_out, state.scaler)
+
+
+def optimizer_step(cfg: OptimizerConfig, params, grads, state, lr, wd):
+    if cfg.optimizer == "adamw":
+        return adamw_step(cfg, params, grads, state, lr, wd)
+    if cfg.optimizer == "sgd":
+        return sgd_step(cfg, params, grads, state, lr, wd)
+    raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+
+
+def scaler_update(s: ScalerState, found_inf: jax.Array,
+                  cfg: OptimizerConfig) -> ScalerState:
+    """Dynamic loss-scale growth/backoff — exact transcription of the
+    reference update semantics (grad_scaler.py:86-106): on inf the growth
+    tracker resets and hysteresis decrements (backoff at <=0); hysteresis is
+    restored ONLY when the scale grows after a full clean window, so
+    intermittent overflows accumulate toward backoff."""
+    is_constant = s.hysteresis < 0
+
+    # found_inf branch
+    hysteresis_inf = s.hysteresis - 1
+    backoff = (~is_constant) & found_inf & (hysteresis_inf <= 0)
+    scale_inf = jnp.where(
+        backoff, jnp.maximum(s.scale * 0.5, cfg.min_loss_scale), s.scale)
+
+    # clean branch
+    growth_tracker_ok = s.growth_tracker + 1
+    grow = (~is_constant) & (growth_tracker_ok >= cfg.loss_scale_window)
+    scale_ok = jnp.where(grow, s.scale * 2.0, s.scale)
+    growth_tracker_ok = jnp.where(grow, 0, growth_tracker_ok)
+    hysteresis_ok = jnp.where(grow & ~is_constant, cfg.hysteresis, s.hysteresis)
+
+    new_scale = jnp.where(found_inf, scale_inf, scale_ok)
+    new_growth = jnp.where(found_inf, 0, growth_tracker_ok)
+    new_hyst = jnp.where(is_constant, s.hysteresis,
+                         jnp.where(found_inf, hysteresis_inf, hysteresis_ok))
+    return ScalerState(new_scale, new_growth, new_hyst)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1: optimizer-state sharding specs
+# ---------------------------------------------------------------------------
+
+
+def zero1_specs(param_specs: PyTree, params: PyTree,
+                parallel: ParallelConfig) -> PyTree:
+    """Add a 'dp' axis to each optimizer-state leaf's PartitionSpec.
+
+    The dp axis is placed on the first dimension that is unsharded and
+    divisible by the dp size; leaves with no such dimension stay with the
+    param's own spec (replicated over dp).  This is the logical-array
+    equivalent of the reference's flat-grad-buffer Range sharding
+    (distrib_optimizer.py:62-118) — per-parameter rather than
+    buffer-offset-based, which GSPMD turns into the same reduce-scatter /
+    all-gather traffic.
+    """
+    dp = parallel.data_parallel
+    if dp <= 1 or not parallel.use_distributed_optimizer:
+        return param_specs
+
+    def add_dp(spec: P, p) -> P:
+        parts = list(spec) + [None] * (p.ndim - len(spec))
+        for i, (axis, dim) in enumerate(zip(parts, p.shape)):
+            if axis is None and dim % dp == 0:
+                parts[i] = "dp"
+                return P(*parts)
+        return spec
+
+    return jax.tree.map(add_dp, param_specs, params,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def opt_state_specs(param_specs: PyTree, params: PyTree,
+                    parallel: ParallelConfig, state: OptState) -> OptState:
+    """Spec tree matching ``OptState`` (for jit out_shardings / checkpoint)."""
+    leaf_specs = zero1_specs(param_specs, params, parallel)
+    scaler_spec = None
+    if state.scaler is not None:
+        scaler_spec = ScalerState(P(), P(), P())
+    return OptState(
+        step=P(),
+        mu=leaf_specs,
+        nu=leaf_specs if state.nu is not None else None,
+        master=leaf_specs if state.master is not None else None,
+        scaler=scaler_spec,
+    )

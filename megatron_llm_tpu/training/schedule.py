@@ -1,0 +1,71 @@
+"""Learning-rate and weight-decay schedules.
+
+Parity with the reference ``OptimizerParamScheduler``
+(megatron/optimizer_param_scheduler.py:10-228): constant / linear / cosine /
+inverse-square-root decay with linear warmup, plus the weight-decay increment
+schedule.  Expressed as pure functions of the iteration so they can be traced
+inside the jitted train step (the reference mutates python state per step).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..config import OptimizerConfig
+
+
+def learning_rate(cfg: OptimizerConfig, it, train_iters: int):
+    """lr at iteration ``it`` (0-based, traceable)."""
+    it = jnp.asarray(it, jnp.float32)
+    warmup = float(cfg.lr_warmup_iters)
+    if cfg.lr_warmup_fraction is not None:
+        warmup = float(cfg.lr_warmup_fraction) * (
+            cfg.lr_decay_iters or train_iters
+        )
+    decay_iters = float(cfg.lr_decay_iters or train_iters)
+    max_lr = cfg.lr
+    min_lr = cfg.min_lr
+
+    warm_lr = max_lr * (it + 1.0) / jnp.maximum(warmup, 1.0)
+
+    # progress through the decay window (post-warmup), clipped to [0, 1]
+    progress = jnp.clip(
+        (it - warmup) / jnp.maximum(decay_iters - warmup, 1.0), 0.0, 1.0
+    )
+    style = cfg.lr_decay_style
+    if style == "constant":
+        decayed = jnp.asarray(max_lr, jnp.float32)
+    elif style == "linear":
+        decayed = max_lr + (min_lr - max_lr) * progress
+    elif style == "cosine":
+        decayed = min_lr + 0.5 * (max_lr - min_lr) * (
+            1.0 + jnp.cos(jnp.pi * progress)
+        )
+    elif style == "inverse-square-root":
+        # reference: lr = max_lr * sqrt(warmup) / sqrt(it+1)
+        # (optimizer_param_scheduler.py:96-104)
+        decayed = max_lr * jnp.sqrt(jnp.maximum(warmup, 1.0)) / jnp.sqrt(it + 1.0)
+        decayed = jnp.maximum(decayed, min_lr)
+    else:
+        raise ValueError(f"unknown lr_decay_style {style!r}")
+
+    return jnp.where(it < warmup, warm_lr, decayed).astype(jnp.float32)
+
+
+def weight_decay(cfg: OptimizerConfig, it, train_iters: int):
+    """Weight decay at iteration ``it`` (reference:
+    optimizer_param_scheduler.py:42-64)."""
+    if cfg.weight_decay_incr_style == "constant" or cfg.start_weight_decay is None:
+        return jnp.asarray(cfg.weight_decay, jnp.float32)
+    it = jnp.asarray(it, jnp.float32)
+    start = cfg.start_weight_decay
+    end = cfg.end_weight_decay if cfg.end_weight_decay is not None else cfg.weight_decay
+    frac = jnp.clip(it / max(train_iters, 1), 0.0, 1.0)
+    if cfg.weight_decay_incr_style == "linear":
+        return (start + (end - start) * frac).astype(jnp.float32)
+    if cfg.weight_decay_incr_style == "cosine":
+        return (end + (start - end) * 0.5 * (1 + jnp.cos(jnp.pi * frac))
+                ).astype(jnp.float32)
+    raise ValueError(
+        f"unknown weight_decay_incr_style {cfg.weight_decay_incr_style!r}"
+    )

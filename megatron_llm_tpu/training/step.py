@@ -1,0 +1,202 @@
+"""The jitted train step: fwd/bwd with microbatch accumulation, mixed
+precision, clipping, NaN-skip, and the optimizer update.
+
+Reference mapping (megatron/training.py:393-459 ``train_step``):
+- zero grad buffer → fp32 grad accumulator initialized per step
+- forward_backward schedule (no pipelining) → ``lax.scan`` over microbatches
+  accumulating fp32 grads (the schedule variants live in parallel/pipeline.py)
+- ``optimizer.reduce_model_grads``'s DP all-reduce → implicit: the batch is
+  sharded over 'dp', params are replicated over 'dp', so GSPMD emits the
+  gradient psum (or reduce-scatter under ZeRO-1 state sharding)
+- unscale → check inf → clip → adam → copy params
+  (optimizer/optimizer.py:407-466) → explicit jnp chain below, with the
+  skipped-iteration semantics on non-finite grads
+- loss averaging across DP for logging (megatron/utils.py:70) → jnp.mean on
+  the dp-sharded per-microbatch losses
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import RuntimeConfig
+from ..models import model as model_lib
+from ..models.transformer import rope_tables
+from ..parallel.cross_entropy import cross_entropy, masked_mean_loss
+from . import optimizer as opt_lib
+from . import schedule
+
+PyTree = Any
+
+
+class TrainState(NamedTuple):
+    params: PyTree
+    opt: opt_lib.OptState
+    iteration: jax.Array  # i32: completed train steps (incl. skipped)
+    skipped: jax.Array  # i32: iterations skipped due to non-finite grads
+    consumed_samples: jax.Array  # i64-ish i32 counter for resumable sampling
+
+
+def init_train_state(cfg: RuntimeConfig, params: PyTree) -> TrainState:
+    use_scaler = cfg.model.params_dtype in ("float16", "fp16")
+    return TrainState(
+        params=params,
+        opt=opt_lib.init_opt_state(params, cfg.optimizer,
+                                   use_fp16_scaler=use_scaler),
+        iteration=jnp.zeros((), jnp.int32),
+        skipped=jnp.zeros((), jnp.int32),
+        consumed_samples=jnp.zeros((), jnp.int32),
+    )
+
+
+def compute_loss(cfg: RuntimeConfig, params, batch: dict, rng=None,
+                 deterministic: bool = True, rope=None):
+    """Forward + masked LM loss for one microbatch.
+
+    ``batch``: tokens [b,s], labels [b,s], loss_mask [b,s] (float weights —
+    supports the instruction-tuning scalar-weighted masks of
+    finetune.py:148-161), optional position_ids/segment_ids.
+    """
+    logits = model_lib.forward(
+        cfg.model, params, batch["tokens"],
+        position_ids=batch.get("position_ids"),
+        segment_ids=batch.get("segment_ids"),
+        rng=rng, deterministic=deterministic, rope=rope,
+    )
+    per_token = cross_entropy(
+        logits, batch["labels"], vocab_size=cfg.model.vocab_size
+    )
+    loss = masked_mean_loss(per_token, batch["loss_mask"])
+    return loss
+
+
+def _accumulate_grads(cfg: RuntimeConfig, params, batch, rng, rope,
+                      loss_scale):
+    """Scan microbatches, accumulating fp32 grads and the mean loss.
+
+    ``batch`` leaves are [accum, micro_batch, ...].
+    """
+    accum = jax.tree.leaves(batch)[0].shape[0]
+
+    def scaled_loss_fn(p, mb, mb_rng):
+        loss = compute_loss(cfg, p, mb, rng=mb_rng,
+                            deterministic=(mb_rng is None), rope=rope)
+        return loss * loss_scale, loss
+
+    grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
+
+    def body(carry, mb_and_idx):
+        grads_acc, loss_acc = carry
+        mb, idx = mb_and_idx
+        mb_rng = jax.random.fold_in(rng, idx) if rng is not None else None
+        (_, loss), grads = grad_fn(params, mb, mb_rng)
+        grads_acc = jax.tree.map(
+            lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
+        return (grads_acc, loss_acc + loss), None
+
+    zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    (grads, loss_sum), _ = jax.lax.scan(
+        body, (zeros, jnp.zeros((), jnp.float32)),
+        (batch, jnp.arange(accum)),
+    )
+    inv = 1.0 / accum
+    grads = jax.tree.map(lambda g: g * inv, grads)
+    return grads, loss_sum * inv
+
+
+def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
+               base_rng: Optional[jax.Array] = None, rope=None):
+    """One optimizer step over ``grad_accum`` microbatches.
+
+    Returns (new_state, metrics).  Donate ``state`` when jitting.
+    """
+    train_iters = cfg.train.train_iters
+    it = state.iteration
+    rng = None
+    if base_rng is not None:
+        rng = jax.random.fold_in(base_rng, it)
+
+    scaler = state.opt.scaler
+    loss_scale = scaler.scale if scaler is not None else jnp.float32(1.0)
+
+    grads, loss = _accumulate_grads(cfg, state.params, batch, rng, rope,
+                                    loss_scale)
+    # unscale (reference: optimizer.py:384-404 unscale-and-check-inf)
+    grads = jax.tree.map(lambda g: g / loss_scale, grads)
+    grad_norm = opt_lib.global_grad_norm(grads)
+    found_inf = ~jnp.isfinite(grad_norm)
+
+    if cfg.optimizer.clip_grad > 0:
+        grads, _ = opt_lib.clip_by_global_norm(
+            grads, cfg.optimizer.clip_grad, norm=grad_norm)
+
+    # Schedules advance with *successful* updates only (reference steps the
+    # opt_param_scheduler inside `if update_successful`, training.py:439-446),
+    # so warmup is not consumed by loss-scale-overflow skips.
+    sched_it = state.opt.step
+    lr = schedule.learning_rate(cfg.optimizer, sched_it, train_iters)
+    wd = schedule.weight_decay(cfg.optimizer, sched_it, train_iters)
+
+    new_params, new_opt = opt_lib.optimizer_step(
+        cfg.optimizer, state.params, grads, state.opt, lr, wd)
+
+    # Skipped-iteration semantics on non-finite grads
+    # (reference: optimizer/optimizer.py:418-432): keep params & moments.
+    def pick(new, old):
+        return jax.tree.map(
+            lambda n, o: jnp.where(found_inf, o, n), new, old)
+
+    new_params = pick(new_params, state.params)
+    new_opt = opt_lib.OptState(
+        step=jnp.where(found_inf, state.opt.step, new_opt.step),
+        mu=pick(new_opt.mu, state.opt.mu),
+        nu=pick(new_opt.nu, state.opt.nu),
+        master=(pick(new_opt.master, state.opt.master)
+                if state.opt.master is not None else None),
+        scaler=(opt_lib.scaler_update(scaler, found_inf, cfg.optimizer)
+                if scaler is not None else None),
+    )
+
+    # batch leaves are [accum, global_batch, seq]: dim 1 is already the
+    # dp-sharded *global* batch, so no extra dp factor.
+    samples = jax.tree.leaves(batch)[0].shape[0] * \
+        jax.tree.leaves(batch)[0].shape[1]
+    new_state = TrainState(
+        params=new_params,
+        opt=new_opt,
+        iteration=it + 1,
+        skipped=state.skipped + found_inf.astype(jnp.int32),
+        consumed_samples=state.consumed_samples + samples,
+    )
+    metrics = {
+        "loss": loss,
+        "grad_norm": grad_norm,
+        "lr": lr,
+        "weight_decay": wd,
+        "skipped": found_inf.astype(jnp.int32),
+        "loss_scale": loss_scale,
+    }
+    return new_state, metrics
+
+
+def make_train_step(cfg: RuntimeConfig, mesh=None, state_sharding=None,
+                    batch_sharding=None):
+    """jit-compile ``train_step`` with donated state.
+
+    RoPE tables are closed over as constants (computed once, not per step —
+    the reference precomputes freqs_cis at model build,
+    megatron/model/positional_embeddings.py).
+    """
+    rope = rope_tables(cfg.model)
+
+    def step(state, batch, base_rng):
+        return train_step(cfg, state, batch, base_rng, rope=rope)
+
+    kwargs = {}
+    if state_sharding is not None:
+        kwargs["in_shardings"] = (state_sharding, batch_sharding, None)
+        kwargs["out_shardings"] = (state_sharding, None)
+    return jax.jit(step, donate_argnums=(0,), **kwargs)

@@ -1,0 +1,199 @@
+"""Train-step tests: optimizer math vs optax, schedules vs reference
+formulas, loss decreases, NaN-skip semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from megatron_llm_tpu.config import (
+    ModelConfig,
+    OptimizerConfig,
+    ParallelConfig,
+    RuntimeConfig,
+    TrainConfig,
+    tiny_config,
+)
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.training import optimizer as opt_lib
+from megatron_llm_tpu.training import schedule
+from megatron_llm_tpu.training.step import (
+    TrainState,
+    init_train_state,
+    make_train_step,
+)
+
+
+def _toy_cfg(**model_overrides):
+    return RuntimeConfig(
+        model=tiny_config(**model_overrides),
+        parallel=ParallelConfig(),
+        optimizer=OptimizerConfig(
+            lr=1e-3, min_lr=1e-4, lr_warmup_iters=2, lr_decay_style="cosine",
+            clip_grad=1.0, weight_decay=0.1,
+        ),
+        train=TrainConfig(train_iters=20, micro_batch_size=2,
+                          global_batch_size=4, seq_length=16),
+    ).validate()
+
+
+def _toy_batch(cfg, accum=2, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (accum, cfg.train.micro_batch_size, cfg.train.seq_length)
+    tokens = rng.integers(0, cfg.model.vocab_size, shape)
+    return {
+        "tokens": jnp.asarray(tokens, jnp.int32),
+        "labels": jnp.asarray(np.roll(tokens, -1, axis=-1), jnp.int32),
+        "loss_mask": jnp.ones(shape, jnp.float32),
+    }
+
+
+def test_adamw_matches_optax():
+    """Our fused AdamW == optax.adamw on an fp32 param tree."""
+    cfg = OptimizerConfig(lr=1e-2, weight_decay=0.0, adam_beta1=0.9,
+                          adam_beta2=0.95, adam_eps=1e-8, clip_grad=0.0)
+    key = jax.random.key(0)
+    params = {"w": jax.random.normal(key, (4, 8)),
+              "attn": {"wq": jax.random.normal(jax.random.fold_in(key, 1), (8, 8))}}
+    grads = jax.tree.map(lambda p: jnp.ones_like(p) * 0.1, params)
+
+    state = opt_lib.init_opt_state(params, cfg)
+    ours = params
+    ref_opt = optax.adamw(1e-2, b1=0.9, b2=0.95, eps=1e-8, weight_decay=0.0)
+    ref_state = ref_opt.init(params)
+    theirs = params
+    for _ in range(5):
+        ours, state = opt_lib.adamw_step(
+            cfg, ours, grads, state, jnp.float32(1e-2), jnp.float32(0.0))
+        updates, ref_state = ref_opt.update(grads, ref_state, theirs)
+        theirs = optax.apply_updates(theirs, updates)
+    for a, b in zip(jax.tree.leaves(ours), jax.tree.leaves(theirs)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_weight_decay_mask():
+    """Norm scales and biases are excluded from decay (reference:
+    optimizer/__init__.py _get_params_for_weight_decay_optimization)."""
+    params = {
+        "layers": {
+            "input_norm": {"scale": jnp.ones((4,))},
+            "attn": {"wq": jnp.ones((4, 4)), "bq": jnp.ones((4,))},
+            "mlp": {"w_up": jnp.ones((4, 4)), "b_up": jnp.ones((4,))},
+        },
+    }
+    mask = opt_lib._wd_mask(params)
+    assert mask["layers"]["input_norm"]["scale"] == 0.0
+    assert mask["layers"]["attn"]["bq"] == 0.0
+    assert mask["layers"]["attn"]["wq"] == 1.0
+    assert mask["layers"]["mlp"]["b_up"] == 0.0
+    assert mask["layers"]["mlp"]["w_up"] == 1.0
+
+
+def test_lr_schedules():
+    cfg = OptimizerConfig(lr=1.0, min_lr=0.1, lr_warmup_iters=10,
+                          lr_decay_style="cosine")
+    # warmup: linear ramp
+    np.testing.assert_allclose(
+        float(schedule.learning_rate(cfg, 4, 100)), 0.5, rtol=1e-6)
+    # end of decay: min_lr
+    np.testing.assert_allclose(
+        float(schedule.learning_rate(cfg, 99, 100)), 0.1, rtol=1e-2)
+    # midpoint of cosine: (max+min)/2
+    np.testing.assert_allclose(
+        float(schedule.learning_rate(cfg, 55, 100)), 0.55, rtol=1e-2)
+    lin = OptimizerConfig(lr=1.0, min_lr=0.0, lr_warmup_iters=0,
+                          lr_decay_style="linear")
+    np.testing.assert_allclose(
+        float(schedule.learning_rate(lin, 50, 101)), 0.5, rtol=2e-2)
+    isr = OptimizerConfig(lr=1.0, min_lr=0.0, lr_warmup_iters=4,
+                          lr_decay_style="inverse-square-root")
+    np.testing.assert_allclose(
+        float(schedule.learning_rate(isr, 15, 100)), 2.0 / 4.0, rtol=1e-6)
+
+
+def test_loss_decreases():
+    cfg = _toy_cfg()
+    params = model_lib.init_params(jax.random.key(0), cfg.model)
+    state = init_train_state(cfg, params)
+    step = make_train_step(cfg)
+    batch = _toy_batch(cfg)
+    rng = jax.random.key(42)
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, batch, rng)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert int(state.iteration) == 10
+    assert int(state.skipped) == 0
+
+
+def test_bf16_params_fp32_master():
+    cfg = _toy_cfg(params_dtype="bfloat16")
+    params = model_lib.init_params(jax.random.key(0), cfg.model)
+    state = init_train_state(cfg, params)
+    assert state.opt.master is not None
+    assert jax.tree.leaves(state.opt.master)[0].dtype == jnp.float32
+    step = make_train_step(cfg)
+    batch = _toy_batch(cfg)
+    state2, metrics = step(state, batch, jax.random.key(0))
+    # params remain bf16, master stays fp32
+    assert jax.tree.leaves(state2.params)[0].dtype == jnp.bfloat16
+    assert jax.tree.leaves(state2.opt.master)[0].dtype == jnp.float32
+    assert np.isfinite(metrics["loss"])
+
+
+def test_nan_grad_skips_update():
+    cfg = _toy_cfg()
+    params = model_lib.init_params(jax.random.key(0), cfg.model)
+    state = init_train_state(cfg, params)
+    step = make_train_step(cfg)
+    batch = _toy_batch(cfg)
+    # poison the tokens' loss mask with inf so grads go non-finite
+    bad = dict(batch)
+    bad["loss_mask"] = batch["loss_mask"] * jnp.inf
+    before = jax.tree.map(lambda x: np.asarray(x), state.params)
+    state2, metrics = step(state, bad, jax.random.key(0))
+    assert int(metrics["skipped"]) == 1
+    assert int(state2.skipped) == 1
+    after = jax.tree.map(lambda x: np.asarray(x), state2.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(a, b)
+    # optimizer step counter did not advance
+    assert int(state2.opt.step) == 0
+
+
+def test_grad_clipping_applied():
+    cfg = _toy_cfg()
+    params = model_lib.init_params(jax.random.key(0), cfg.model)
+    grads = jax.tree.map(lambda p: jnp.full(p.shape, 10.0, jnp.float32), params)
+    clipped, norm = opt_lib.clip_by_global_norm(grads, 1.0)
+    new_norm = opt_lib.global_grad_norm(clipped)
+    assert float(norm) > 1.0
+    np.testing.assert_allclose(float(new_norm), 1.0, rtol=1e-4)
+
+
+def test_dynamic_scaler_intermittent_overflow_backs_off():
+    """Hysteresis accumulates across intermittent overflows and is restored
+    only on growth (reference grad_scaler.py:86-106)."""
+    import jax.numpy as jnp
+
+    cfg = OptimizerConfig(initial_loss_scale=2.0**16, hysteresis=2,
+                          loss_scale_window=1000, min_loss_scale=1.0)
+    s = opt_lib.init_dynamic_scaler(cfg)
+    t, f = jnp.asarray(True), jnp.asarray(False)
+    # alternating inf/ok: hysteresis must reach 0 on the 2nd inf → backoff
+    s = opt_lib.scaler_update(s, t, cfg)     # hyst 2→1
+    s = opt_lib.scaler_update(s, f, cfg)     # clean, no growth → hyst stays 1
+    assert int(s.hysteresis) == 1
+    s = opt_lib.scaler_update(s, t, cfg)     # hyst 1→0 → backoff
+    assert float(s.scale) == 2.0**15
+    # growth after a full clean window restores hysteresis
+    cfg2 = OptimizerConfig(initial_loss_scale=2.0**8, hysteresis=2,
+                           loss_scale_window=3, min_loss_scale=1.0)
+    s = opt_lib.init_dynamic_scaler(cfg2)
+    s = opt_lib.scaler_update(s, t, cfg2)    # hyst → 1
+    for _ in range(3):
+        s = opt_lib.scaler_update(s, f, cfg2)
+    assert float(s.scale) == 2.0**9          # grew
+    assert int(s.hysteresis) == 2            # restored on growth only
