@@ -62,6 +62,8 @@ def main() -> None:
         seq_length=seq,
         max_position_embeddings=seq,
         params_dtype="bfloat16",
+        # "flash" falls back to the einsum path until the Pallas kernel
+        # lands; request it so the bench picks the kernel up automatically.
         attention_impl="flash",
         recompute="selective",
     )

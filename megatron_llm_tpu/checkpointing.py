@@ -1,0 +1,163 @@
+"""Sharding-agnostic checkpointing with reference-compatible semantics.
+
+Replaces the reference's rank-layout-encoded torch checkpoints
+(megatron/checkpointing.py:77-731: ``iter_%07d/mp_rank_{tp}[_{pp}]/...`` +
+``latest_checkpointed_iteration.txt``) with orbax/tensorstore global-array
+checkpoints.  What is kept, by design (SURVEY.md §5):
+
+- tracker-file semantics: ``latest_checkpointed_iteration.txt`` holding the
+  iteration number or ``release``
+- args-in-checkpoint: the full RuntimeConfig is stored as config.json and
+  ``load_config_from_checkpoint`` mirrors ``load_args_from_checkpoint``
+- resumable data order: TrainState.consumed_samples rides in the state
+- reshard-on-load: checkpoints are logical arrays, so loading under a
+  different mesh/PartitionSpec layout just works — the offline
+  ``tools/checkpoint_util.py`` TP×PP resharding tool is obsolete by design
+
+Layout: <root>/iter_0000010/{state/ (orbax), config.json}
+        <root>/latest_checkpointed_iteration.txt
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from .config import RuntimeConfig
+
+TRACKER_FILENAME = "latest_checkpointed_iteration.txt"
+RELEASE = "release"
+
+
+def checkpoint_dir(root: str, iteration: int | str) -> Path:
+    """Reference naming: iter_%07d, or 'release' for conversion outputs
+    (checkpointing.py:77-95)."""
+    if iteration == RELEASE:
+        return Path(root) / RELEASE
+    return Path(root) / f"iter_{int(iteration):07d}"
+
+
+def read_tracker(root: str) -> Optional[int | str]:
+    tracker = Path(root) / TRACKER_FILENAME
+    if not tracker.exists():
+        return None
+    content = tracker.read_text().strip()
+    if content == RELEASE:
+        return RELEASE
+    return int(content)
+
+
+def write_tracker(root: str, iteration: int | str) -> None:
+    (Path(root) / TRACKER_FILENAME).write_text(str(iteration))
+
+
+def save_checkpoint(
+    root: str,
+    state: Any,  # TrainState (or any pytree)
+    cfg: Optional[RuntimeConfig] = None,
+    iteration: Optional[int | str] = None,
+    meta: Optional[dict] = None,
+) -> Path:
+    """Write state + config (+ host-side metadata like consumed_samples,
+    which lives outside the device state to avoid int32 limits) and advance
+    the tracker (reference save_checkpoint, checkpointing.py:243-333)."""
+    if iteration is None:
+        iteration = int(jax.device_get(state.iteration))
+    path = checkpoint_dir(root, iteration)
+    path.mkdir(parents=True, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save((path / "state").absolute(), state, force=True)
+    if cfg is not None:
+        (path / "config.json").write_text(cfg.to_json())
+    if meta is not None:
+        (path / "meta.json").write_text(json.dumps(meta))
+    write_tracker(root, iteration)
+    return path
+
+
+def load_meta(root: str, iteration: Optional[int | str] = None) -> dict:
+    if iteration is None:
+        iteration = read_tracker(root)
+    meta_file = checkpoint_dir(root, iteration) / "meta.json"
+    if not meta_file.exists():
+        return {}
+    return json.loads(meta_file.read_text())
+
+
+def load_checkpoint(
+    root: str,
+    template: Any,
+    iteration: Optional[int | str] = None,
+) -> tuple[Any, int | str]:
+    """Restore state shaped/sharded like ``template`` (abstract arrays with
+    shardings welcome) — resharding on load is implicit.
+
+    Reference load_checkpoint (checkpointing.py:562-678): reads the tracker
+    to find the newest iteration unless one is pinned.
+    """
+    if iteration is None:
+        iteration = read_tracker(root)
+        if iteration is None:
+            raise FileNotFoundError(
+                f"no {TRACKER_FILENAME} under {root}; nothing to load")
+    path = checkpoint_dir(root, iteration)
+    if iteration == RELEASE or not (path / "state").exists():
+        # 'release' checkpoints are params-only (conversion output): restore
+        # the params subtree, keep the template's fresh optimizer state —
+        # the reference's --finetune-from-release semantics
+        # (checkpointing.py:414-473).
+        params = load_release_params(root, template.params)
+        return template._replace(params=params), iteration
+    abstract = jax.tree.map(_as_abstract, template)
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore((path / "state").absolute(), abstract)
+    return state, iteration
+
+
+def _as_abstract(x):
+    if isinstance(x, jax.Array):
+        sharding = x.sharding if hasattr(x, "sharding") else None
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    if isinstance(x, np.ndarray):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    return x
+
+
+def load_config_from_checkpoint(
+    root: str, iteration: Optional[int | str] = None
+) -> RuntimeConfig:
+    """Reference --use_checkpoint_args (checkpointing.py:476-559)."""
+    if iteration is None:
+        iteration = read_tracker(root)
+        if iteration is None:
+            raise FileNotFoundError(f"no checkpoint tracker under {root}")
+    cfg_file = checkpoint_dir(root, iteration) / "config.json"
+    return RuntimeConfig.from_json(cfg_file.read_text())
+
+
+def save_release_params(root: str, params: Any,
+                        cfg: Optional[RuntimeConfig] = None) -> Path:
+    """Write a params-only 'release' checkpoint (the output of weight
+    conversion; reference hf_to_megatron.py writes tracker='release')."""
+    path = checkpoint_dir(root, RELEASE)
+    path.mkdir(parents=True, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save((path / "params").absolute(), params, force=True)
+    if cfg is not None:
+        (path / "config.json").write_text(cfg.to_json())
+    write_tracker(root, RELEASE)
+    return path
+
+
+def load_release_params(root: str, template: Any) -> Any:
+    path = checkpoint_dir(root, RELEASE)
+    abstract = jax.tree.map(_as_abstract, template)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore((path / "params").absolute(), abstract)

@@ -102,8 +102,10 @@ class ModelConfig:
     # initialization
     init_method_std: float = 0.02
     use_scaled_init: bool = True  # scale output-layer init by 1/sqrt(2*layers)
-    # attention impl: "flash" (pallas) | "dot" (XLA einsum path)
-    attention_impl: str = "flash"
+    # attention impl: "flash" (pallas kernel) | "dot" (XLA einsum path).
+    # "dot" is the default until the Pallas kernel covers all shapes; "flash"
+    # falls back to "dot" with a warning when the kernel is unavailable.
+    attention_impl: str = "dot"
     # recompute: "none" | "selective" | "full"
     recompute: str = "selective"
     # Parallel-friendly sequence length used for activation layouts.

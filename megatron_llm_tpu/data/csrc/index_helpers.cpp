@@ -1,0 +1,103 @@
+/* Native index-building helpers for the data pipeline.
+ *
+ * TPU-native counterpart of the reference's pybind11 extension
+ * (megatron/data/helpers.cpp:696-701: build_sample_idx,
+ * build_blending_indices, build_mapping, build_blocks_mapping).  Exposed as
+ * a plain C ABI consumed via ctypes (this image has no pybind11); callers
+ * allocate the output arrays, so no ownership crosses the boundary.
+ *
+ * Build: g++ -O3 -shared -fPIC -o libindex_helpers.so index_helpers.cpp
+ */
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <random>
+
+extern "C" {
+
+/* Number of (doc, offset) rows build_sample_idx will write: num_samples+1. */
+int64_t sample_idx_rows(int32_t seq_length, int32_t num_epochs,
+                        int64_t tokens_per_epoch) {
+  return (num_epochs * tokens_per_epoch - 1) / seq_length + 1;
+}
+
+/* GPT sample index: rows of (index into doc_idx, token offset in that doc)
+ * such that row i .. row i+1 spans seq_length+1 tokens; samples may span
+ * document boundaries (behavioral spec: megatron/data/helpers.cpp:84-171,
+ * consumed by gpt_dataset.py:235-268). */
+void build_sample_idx(const int32_t* sizes, const int32_t* doc_idx,
+                      int32_t seq_length, int32_t num_epochs,
+                      int64_t tokens_per_epoch, int32_t* out) {
+  const int64_t num_samples = (num_epochs * tokens_per_epoch - 1) / seq_length;
+  int64_t sample_index = 0;
+  int64_t doc_idx_index = 0;
+  int32_t doc_offset = 0;
+
+  out[0] = static_cast<int32_t>(doc_idx_index);
+  out[1] = doc_offset;
+  ++sample_index;
+
+  while (sample_index <= num_samples) {
+    int32_t remaining = seq_length + 1;
+    while (remaining != 0) {
+      const int32_t doc_id = doc_idx[doc_idx_index];
+      const int32_t doc_length = sizes[doc_id] - doc_offset;
+      remaining -= doc_length;
+      if (remaining <= 0) {
+        /* Sample ends inside this document; next sample re-reads the
+         * boundary token (the -1), sharing it as label/input. */
+        doc_offset += remaining + doc_length - 1;
+        remaining = 0;
+      } else {
+        ++doc_idx_index;
+        doc_offset = 0;
+      }
+    }
+    out[2 * sample_index] = static_cast<int32_t>(doc_idx_index);
+    out[2 * sample_index + 1] = doc_offset;
+    ++sample_index;
+  }
+}
+
+/* Multi-corpus weighted interleave by greatest-sampling-error
+ * (behavioral spec: megatron/data/helpers.cpp:20-81, consumed by
+ * blendable_dataset.py:38-41). */
+void build_blending_indices(uint8_t* dataset_index,
+                            int64_t* dataset_sample_index,
+                            const double* weights, int32_t num_datasets,
+                            int64_t size) {
+  int64_t* current = new int64_t[num_datasets];
+  for (int32_t i = 0; i < num_datasets; ++i) current[i] = 0;
+
+  for (int64_t s = 0; s < size; ++s) {
+    const double s_d = std::max(static_cast<double>(s), 1.0);
+    int32_t best = 0;
+    double max_error = weights[0] * s_d - static_cast<double>(current[0]);
+    for (int32_t d = 1; d < num_datasets; ++d) {
+      const double err = weights[d] * s_d - static_cast<double>(current[d]);
+      if (err > max_error) {
+        max_error = err;
+        best = d;
+      }
+    }
+    dataset_index[s] = static_cast<uint8_t>(best);
+    dataset_sample_index[s] = current[best];
+    current[best] += 1;
+  }
+  delete[] current;
+}
+
+/* Epoch-blocked shuffle: permute [0, n_first) and [n_first, n_total)
+ * independently with a deterministic PRNG.  Covers the reference's
+ * separate-last-epoch shuffle construction (gpt_dataset.py _build_shuffle_idx)
+ * in native code; python passes n_first == n_total for the simple case. */
+void build_shuffle_idx(uint32_t seed, int64_t n_first, int64_t n_total,
+                       int32_t* out) {
+  for (int64_t i = 0; i < n_total; ++i) out[i] = static_cast<int32_t>(i);
+  std::mt19937 gen(seed);
+  std::shuffle(out, out + n_first, gen);
+  if (n_total > n_first) std::shuffle(out + n_first, out + n_total, gen);
+}
+
+}  /* extern "C" */

@@ -1,0 +1,151 @@
+"""ctypes bridge to the native index helpers, with pure-numpy fallbacks.
+
+The reference JIT-compiles a pybind11 module on first use
+(megatron/data/Makefile, compile_helper at dataset_utils.py:82-92); here the
+shared library is built once with g++ into the package cache and loaded via
+ctypes.  Every entry point has a numpy fallback so the pipeline works without
+a toolchain; tests assert native == fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_SRC = Path(__file__).parent / "csrc" / "index_helpers.cpp"
+_LIB_DIR = Path(__file__).parent / "csrc"
+_LIB = _LIB_DIR / "libindex_helpers.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _compile_library() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", str(_LIB), str(_SRC)],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (compiling on demand) the native helper library, or None."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+        if not _compile_library():
+            return None
+    try:
+        lib = ctypes.CDLL(str(_LIB))
+        lib.sample_idx_rows.restype = ctypes.c_int64
+        lib.sample_idx_rows.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int64]
+        lib.build_sample_idx.restype = None
+        lib.build_sample_idx.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.build_blending_indices.restype = None
+        lib.build_blending_indices.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int32, ctypes.c_int64]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def _as_ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---------------------------------------------------------------------------
+# build_sample_idx
+# ---------------------------------------------------------------------------
+
+
+def build_sample_idx_py(sizes: np.ndarray, doc_idx: np.ndarray,
+                        seq_length: int, num_epochs: int,
+                        tokens_per_epoch: int) -> np.ndarray:
+    """Pure-numpy fallback; same semantics as the native version."""
+    num_samples = (num_epochs * tokens_per_epoch - 1) // seq_length
+    out = np.zeros((num_samples + 1, 2), dtype=np.int32)
+    doc_idx_index = 0
+    doc_offset = 0
+    out[0] = (doc_idx_index, doc_offset)
+    for i in range(1, num_samples + 1):
+        remaining = seq_length + 1
+        while remaining != 0:
+            doc_id = doc_idx[doc_idx_index]
+            doc_length = int(sizes[doc_id]) - doc_offset
+            remaining -= doc_length
+            if remaining <= 0:
+                doc_offset += remaining + doc_length - 1
+                remaining = 0
+            else:
+                doc_idx_index += 1
+                doc_offset = 0
+        out[i] = (doc_idx_index, doc_offset)
+    return out
+
+
+def build_sample_idx(sizes: np.ndarray, doc_idx: np.ndarray, seq_length: int,
+                     num_epochs: int, tokens_per_epoch: int) -> np.ndarray:
+    sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+    doc_idx = np.ascontiguousarray(doc_idx, dtype=np.int32)
+    lib = get_lib()
+    if lib is None:
+        return build_sample_idx_py(sizes, doc_idx, seq_length, num_epochs,
+                                   tokens_per_epoch)
+    rows = lib.sample_idx_rows(seq_length, num_epochs, tokens_per_epoch)
+    out = np.empty((rows, 2), dtype=np.int32)
+    lib.build_sample_idx(
+        _as_ptr(sizes, ctypes.c_int32), _as_ptr(doc_idx, ctypes.c_int32),
+        seq_length, num_epochs, tokens_per_epoch,
+        _as_ptr(out, ctypes.c_int32))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# build_blending_indices
+# ---------------------------------------------------------------------------
+
+
+def build_blending_indices_py(weights: np.ndarray, size: int):
+    num = len(weights)
+    dataset_index = np.zeros(size, dtype=np.uint8)
+    dataset_sample_index = np.zeros(size, dtype=np.int64)
+    current = np.zeros(num, dtype=np.int64)
+    for s in range(size):
+        s_d = max(float(s), 1.0)
+        errors = weights * s_d - current
+        best = int(np.argmax(errors))
+        dataset_index[s] = best
+        dataset_sample_index[s] = current[best]
+        current[best] += 1
+    return dataset_index, dataset_sample_index
+
+
+def build_blending_indices(weights: np.ndarray, size: int):
+    weights = np.ascontiguousarray(weights, dtype=np.float64)
+    lib = get_lib()
+    if lib is None:
+        return build_blending_indices_py(weights, size)
+    dataset_index = np.empty(size, dtype=np.uint8)
+    dataset_sample_index = np.empty(size, dtype=np.int64)
+    lib.build_blending_indices(
+        _as_ptr(dataset_index, ctypes.c_uint8),
+        _as_ptr(dataset_sample_index, ctypes.c_int64),
+        _as_ptr(weights, ctypes.c_double), len(weights), size)
+    return dataset_index, dataset_sample_index

@@ -51,9 +51,12 @@ def rmsnorm(x: jax.Array, weight: jax.Array, eps: float = 1e-5,
     (mirrors the availability-fallback pattern of
     megatron/model/fused_softmax.py:152-172)."""
     if use_kernel:
-        from ..kernels.rmsnorm import rmsnorm_pallas
-
-        return rmsnorm_pallas(x, weight, eps=eps)
+        try:
+            from ..kernels.rmsnorm import rmsnorm_pallas
+        except ImportError:
+            pass  # kernel not built yet → XLA-fused reference path
+        else:
+            return rmsnorm_pallas(x, weight, eps=eps)
     return rmsnorm_ref(x, weight, eps)
 
 

@@ -1,0 +1,187 @@
+"""Tokenizer dispatch with vocab padding.
+
+Parity with the reference (megatron/tokenizer/tokenizer.py:12-497):
+``build_tokenizer`` dispatches on type — SentencePiece (Llama),
+HF AutoTokenizer wrap (Falcon), GPT-2 BPE — and pads the vocab to a multiple
+of ``make_vocab_size_divisible_by × tp`` (:39-63).  SentencePiece loads via
+the `sentencepiece` package when present, else through HF's
+LlamaTokenizer(Fast) which reads the same .model files; special
+ChatML-style tokens can be appended via ``vocab_extra_ids_list`` (:326-497).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional, Sequence
+
+
+def pad_vocab_size(orig_vocab_size: int, make_divisible_by: int = 128,
+                   tp: int = 1) -> int:
+    multiple = make_divisible_by * tp
+    return ((orig_vocab_size + multiple - 1) // multiple) * multiple
+
+
+class Tokenizer(abc.ABC):
+    """Minimal interface the pipeline needs (reference AbstractTokenizer)."""
+
+    @property
+    @abc.abstractmethod
+    def vocab_size(self) -> int: ...
+
+    @abc.abstractmethod
+    def tokenize(self, text: str) -> list[int]: ...
+
+    @abc.abstractmethod
+    def detokenize(self, ids: Sequence[int]) -> str: ...
+
+    @property
+    def eod(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def pad(self) -> int:
+        return 0
+
+    @property
+    def bos(self) -> Optional[int]:
+        return None
+
+
+class HFTokenizer(Tokenizer):
+    """Wrap any HF tokenizer (reference _FalconTokenizer pattern,
+    tokenizer.py:288-323)."""
+
+    def __init__(self, name_or_path: str,
+                 vocab_extra_ids_list: Optional[Sequence[str]] = None):
+        from transformers import AutoTokenizer
+
+        self._t = AutoTokenizer.from_pretrained(name_or_path)
+        if vocab_extra_ids_list:
+            self._t.add_special_tokens(
+                {"additional_special_tokens": list(vocab_extra_ids_list)})
+
+    @property
+    def inner(self):
+        return self._t
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._t)
+
+    def tokenize(self, text: str) -> list[int]:
+        return self._t.encode(text, add_special_tokens=False)
+
+    def detokenize(self, ids) -> str:
+        return self._t.decode(ids)
+
+    @property
+    def eod(self) -> int:
+        t = self._t
+        if t.eos_token_id is not None:
+            return t.eos_token_id
+        return t.pad_token_id or 0
+
+    @property
+    def bos(self):
+        return self._t.bos_token_id
+
+    @property
+    def pad(self) -> int:
+        if self._t.pad_token_id is not None:
+            return self._t.pad_token_id
+        return self.eod
+
+
+class SentencePieceTokenizer(Tokenizer):
+    """Llama .model tokenizer (reference _SentencePieceTokenizer,
+    tokenizer.py:326-497)."""
+
+    def __init__(self, model_file: str,
+                 vocab_extra_ids_list: Optional[Sequence[str]] = None):
+        try:
+            import sentencepiece
+
+            self._sp = sentencepiece.SentencePieceProcessor(
+                model_file=model_file)
+            self._hf = None
+        except ImportError:
+            from transformers import LlamaTokenizerFast
+
+            self._hf = LlamaTokenizerFast(vocab_file=model_file)
+            self._sp = None
+        self._extra: dict[str, int] = {}
+        base = self.base_vocab_size
+        for i, tok in enumerate(vocab_extra_ids_list or []):
+            self._extra[tok] = base + i
+
+    @property
+    def base_vocab_size(self) -> int:
+        if self._sp is not None:
+            return self._sp.vocab_size()
+        return len(self._hf)
+
+    @property
+    def vocab_size(self) -> int:
+        return self.base_vocab_size + len(self._extra)
+
+    def tokenize(self, text: str) -> list[int]:
+        if self._sp is not None:
+            return self._sp.encode(text)
+        return self._hf.encode(text, add_special_tokens=False)
+
+    def detokenize(self, ids) -> str:
+        ids = [i for i in ids if i < self.base_vocab_size]
+        if self._sp is not None:
+            return self._sp.decode(ids)
+        return self._hf.decode(ids)
+
+    @property
+    def eod(self) -> int:
+        if self._sp is not None:
+            return self._sp.eos_id()
+        return self._hf.eos_token_id
+
+    @property
+    def bos(self):
+        if self._sp is not None:
+            return self._sp.bos_id()
+        return self._hf.bos_token_id
+
+
+class NullTokenizer(Tokenizer):
+    """Integer passthrough for tests / pre-tokenized corpora."""
+
+    def __init__(self, vocab_size: int = 256):
+        self._n = vocab_size
+
+    @property
+    def vocab_size(self) -> int:
+        return self._n
+
+    def tokenize(self, text: str) -> list[int]:
+        return [int(t) % self._n for t in text.split()]
+
+    def detokenize(self, ids) -> str:
+        return " ".join(str(i) for i in ids)
+
+    @property
+    def eod(self) -> int:
+        return self._n - 1
+
+
+def build_tokenizer(tokenizer_type: str, tokenizer_model: Optional[str] = None,
+                    vocab_extra_ids_list: Optional[Sequence[str]] = None,
+                    vocab_size: int = 256) -> Tokenizer:
+    """Dispatch (reference tokenizer.py:12-37)."""
+    t = tokenizer_type.lower()
+    if t in ("sentencepiece", "sentencepiecetokenizer", "llama"):
+        assert tokenizer_model, "SentencePiece tokenizer needs a model file"
+        return SentencePieceTokenizer(tokenizer_model, vocab_extra_ids_list)
+    if t in ("falcon", "hf", "huggingface", "falcontokenizer"):
+        assert tokenizer_model, "HF tokenizer needs a name or path"
+        return HFTokenizer(tokenizer_model, vocab_extra_ids_list)
+    if t in ("gpt2", "gpt2bpetokenizer"):
+        return HFTokenizer(tokenizer_model or "gpt2")
+    if t in ("null", "nulltokenizer"):
+        return NullTokenizer(vocab_size)
+    raise ValueError(f"unknown tokenizer type {tokenizer_type!r}")

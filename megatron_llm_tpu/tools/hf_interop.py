@@ -212,13 +212,18 @@ def falcon_from_hf(
             return pfx(i) + ("ln_attn" if which == "attn" else "ln_mlp")
         return pfx(i) + "input_layernorm"
 
-    def qkv(i, idx):
+    # Split + unpermute the fused QKV once per layer (these are the largest
+    # tensors in the checkpoint).
+    qkv_cache = []
+    for i in range(cfg.num_layers):
         q, k, v = _split_falcon_qkv(
             sd[pfx(i) + "self_attention.query_key_value.weight"], cfg)
         # HF Falcon uses rotate-half RoPE → unpermute to interleaved.
-        q = hf_to_interleaved(q, nq, d)
-        k = hf_to_interleaved(k, nkv, d)
-        return (q, k, v)[idx]
+        qkv_cache.append((hf_to_interleaved(q, nq, d),
+                          hf_to_interleaved(k, nkv, d), v))
+
+    def qkv(i, idx):
+        return qkv_cache[i][idx]
 
     layers = {
         "input_norm": {

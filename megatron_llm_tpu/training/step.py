@@ -37,7 +37,10 @@ class TrainState(NamedTuple):
     opt: opt_lib.OptState
     iteration: jax.Array  # i32: completed train steps (incl. skipped)
     skipped: jax.Array  # i32: iterations skipped due to non-finite grads
-    consumed_samples: jax.Array  # i64-ish i32 counter for resumable sampling
+    # NOTE: consumed_samples (the resumable-sampling counter) is NOT part of
+    # the device state: it can exceed int32 on long pretraining runs, so the
+    # training driver keeps it as a python int (like the reference's
+    # args.consumed_train_samples) and persists it via checkpoint metadata.
 
 
 def init_train_state(cfg: RuntimeConfig, params: PyTree) -> TrainState:
@@ -48,7 +51,6 @@ def init_train_state(cfg: RuntimeConfig, params: PyTree) -> TrainState:
                                    use_fp16_scaler=use_scaler),
         iteration=jnp.zeros((), jnp.int32),
         skipped=jnp.zeros((), jnp.int32),
-        consumed_samples=jnp.zeros((), jnp.int32),
     )
 
 
@@ -160,16 +162,11 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
                 if scaler is not None else None),
     )
 
-    # batch leaves are [accum, global_batch, seq]: dim 1 is already the
-    # dp-sharded *global* batch, so no extra dp factor.
-    samples = jax.tree.leaves(batch)[0].shape[0] * \
-        jax.tree.leaves(batch)[0].shape[1]
     new_state = TrainState(
         params=new_params,
         opt=new_opt,
         iteration=it + 1,
         skipped=state.skipped + found_inf.astype(jnp.int32),
-        consumed_samples=state.consumed_samples + samples,
     )
     metrics = {
         "loss": loss,

@@ -1,0 +1,221 @@
+"""Data pipeline tests: .bin/.idx format, index helpers (native == python),
+GPT dataset semantics, blending, samplers, instruction masks."""
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.data import index_helpers
+from megatron_llm_tpu.data.blendable_dataset import (
+    BlendableDataset,
+    parse_data_paths,
+)
+from megatron_llm_tpu.data.gpt_dataset import (
+    GPTDataset,
+    build_gpt_datasets,
+    get_train_valid_test_split,
+)
+from megatron_llm_tpu.data.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    write_dataset,
+)
+from megatron_llm_tpu.data.samplers import BatchIterator, PretrainingSampler
+from megatron_llm_tpu.data.instruction_dataset import InstructionDataset, Role
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    rng = np.random.default_rng(0)
+    docs = [rng.integers(0, 1000, rng.integers(5, 60)).astype(np.int32)
+            for _ in range(50)]
+    prefix = str(tmp_path / "corpus")
+    write_dataset(prefix, docs, dtype=np.int32)
+    return prefix, docs
+
+
+def test_mmap_roundtrip(corpus):
+    prefix, docs = corpus
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == len(docs)
+    for i in [0, 7, 49]:
+        np.testing.assert_array_equal(ds[i], docs[i])
+    # partial reads
+    np.testing.assert_array_equal(ds.get(3, offset=2, length=3),
+                                  docs[3][2:5])
+
+
+def test_format_is_reference_compatible(corpus):
+    """Parse the .idx with the reference's documented byte layout."""
+    import struct
+
+    prefix, docs = corpus
+    with open(prefix + ".idx", "rb") as f:
+        assert f.read(9) == b"MMIDIDX\x00\x00"
+        assert struct.unpack("<Q", f.read(8)) == (1,)
+        (code,) = struct.unpack("<B", f.read(1))
+        assert code == 4  # int32 (reference dtype table)
+        (n,) = struct.unpack("<Q", f.read(8))
+        (dc,) = struct.unpack("<Q", f.read(8))
+        assert n == len(docs)
+        assert dc == len(docs) + 1
+        sizes = np.frombuffer(f.read(4 * n), np.int32)
+        np.testing.assert_array_equal(sizes, [len(d) for d in docs])
+        pointers = np.frombuffer(f.read(8 * n), np.int64)
+        assert pointers[0] == 0
+        assert pointers[1] == sizes[0] * 4
+
+
+def test_builder_merge(tmp_path):
+    a = [np.arange(5, dtype=np.int32), np.arange(3, dtype=np.int32)]
+    b = [np.arange(7, dtype=np.int32)]
+    write_dataset(str(tmp_path / "a"), a)
+    write_dataset(str(tmp_path / "b"), b)
+    m = MMapIndexedDatasetBuilder(str(tmp_path / "m"), np.int32)
+    m.merge_file(str(tmp_path / "a"))
+    m.merge_file(str(tmp_path / "b"))
+    m.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "m"))
+    assert len(ds) == 3
+    np.testing.assert_array_equal(ds[2], b[0])
+
+
+def test_native_helpers_match_python():
+    lib = index_helpers.get_lib()
+    assert lib is not None, "native helper library failed to build"
+    rng = np.random.default_rng(1)
+    sizes = rng.integers(3, 50, 40).astype(np.int32)
+    doc_idx = np.tile(np.arange(40, dtype=np.int32), 3)
+    rng.shuffle(doc_idx)
+    tokens_per_epoch = int(sizes.sum())
+    for seq in (16, 31):
+        native = index_helpers.build_sample_idx(
+            sizes, doc_idx, seq, 3, tokens_per_epoch)
+        py = index_helpers.build_sample_idx_py(
+            sizes, doc_idx, seq, 3, tokens_per_epoch)
+        np.testing.assert_array_equal(native, py)
+
+    w = np.asarray([0.3, 0.5, 0.2])
+    di_n, si_n = index_helpers.build_blending_indices(w, 500)
+    di_p, si_p = index_helpers.build_blending_indices_py(w, 500)
+    np.testing.assert_array_equal(di_n, di_p)
+    np.testing.assert_array_equal(si_n, si_p)
+    # achieved ratios ≈ weights
+    counts = np.bincount(di_n, minlength=3) / 500
+    np.testing.assert_allclose(counts, w, atol=0.01)
+
+
+def test_gpt_dataset_samples(corpus, tmp_path):
+    prefix, docs = corpus
+    indexed = MMapIndexedDataset(prefix)
+    documents = np.arange(len(docs), dtype=np.int32)
+    seq = 32
+    ds = GPTDataset("train", indexed, documents, num_samples=40,
+                    seq_length=seq, seed=5, cache_dir=str(tmp_path / "cache"))
+    assert len(ds) >= 40
+    flat = {}
+    # every sample has seq+1 tokens drawn from the flattened shuffled corpus
+    s0 = ds[0]["text"]
+    assert s0.shape == (seq + 1,)
+    # adjacent samples share the boundary token: sample i's tokens are a
+    # contiguous window; verify against a manual flattening of doc_idx
+    concat = np.concatenate([docs[d] for d in np.asarray(ds.doc_idx)])
+    for i in range(5):
+        idx = int(ds.shuffle_idx[i])
+        start_tok = idx * seq
+        np.testing.assert_array_equal(
+            ds[np.where(np.asarray(ds.shuffle_idx) == idx)[0][0]]["text"],
+            concat[start_tok:start_tok + seq + 1])
+
+
+def test_gpt_dataset_cache_reused(corpus, tmp_path):
+    prefix, docs = corpus
+    indexed = MMapIndexedDataset(prefix)
+    documents = np.arange(len(docs), dtype=np.int32)
+    cache = str(tmp_path / "cache2")
+    ds1 = GPTDataset("t", indexed, documents, 20, 16, 7, cache)
+    ds2 = GPTDataset("t", indexed, documents, 20, 16, 7, cache)
+    np.testing.assert_array_equal(np.asarray(ds1.shuffle_idx),
+                                  np.asarray(ds2.shuffle_idx))
+    np.testing.assert_array_equal(ds1[3]["text"], ds2[3]["text"])
+
+
+def test_split_string():
+    assert get_train_valid_test_split("969,30,1", 1000) == [0, 969, 999, 1000]
+    assert get_train_valid_test_split("100,0,0", 50) == [0, 50, 50, 50]
+
+
+def test_build_gpt_datasets(corpus, tmp_path):
+    prefix, docs = corpus
+    train, valid, test = build_gpt_datasets(
+        prefix, "8,1,1", (30, 5, 5), seq_length=16, seed=3,
+        cache_dir=str(tmp_path / "c3"))
+    assert train is not None and valid is not None and test is not None
+    assert len(train) >= 30
+
+
+def test_blendable(corpus, tmp_path):
+    prefix, docs = corpus
+    indexed = MMapIndexedDataset(prefix)
+    documents = np.arange(len(docs), dtype=np.int32)
+    a = GPTDataset("a", indexed, documents, 20, 16, 1, str(tmp_path / "ca"))
+    b = GPTDataset("b", indexed, documents, 20, 16, 2, str(tmp_path / "cb"))
+    blend = BlendableDataset([a, b], [0.7, 0.3], size=30)
+    assert len(blend) == 30
+    sample = blend[0]
+    assert sample["text"].shape == (17,)
+    counts = np.bincount(blend.dataset_index, minlength=2) / 30
+    assert abs(counts[0] - 0.7) < 0.1
+    assert parse_data_paths(["0.3", "x", "0.7", "y"]) == ([0.3, 0.7], ["x", "y"])
+
+
+def test_pretraining_sampler_resumes():
+    s = PretrainingSampler(total_samples=100, consumed_samples=0,
+                           batch_size=10)
+    batches = list(s)
+    assert len(batches) == 10
+    s2 = PretrainingSampler(total_samples=100, consumed_samples=30,
+                            batch_size=10)
+    batches2 = list(s2)
+    assert batches2[0] == batches[3]
+
+
+def test_batch_iterator_shapes(corpus, tmp_path):
+    prefix, docs = corpus
+    indexed = MMapIndexedDataset(prefix)
+    documents = np.arange(len(docs), dtype=np.int32)
+    ds = GPTDataset("bi", indexed, documents, 24, 16, 1, str(tmp_path / "cc"))
+    it = BatchIterator(ds, global_batch_size=8, grad_accum=2, seq_length=16,
+                       eod_token=999)
+    batch = next(iter(it))
+    assert batch["tokens"].shape == (2, 4, 16)
+    assert batch["labels"].shape == (2, 4, 16)
+    assert batch["loss_mask"].shape == (2, 4, 16)
+    np.testing.assert_array_equal(
+        batch["labels"][..., :-1], batch["tokens"][..., 1:])
+    # eod labels are masked out of the loss
+    assert np.all(batch["loss_mask"][batch["labels"] == 999] == 0)
+
+
+def test_instruction_dataset(tmp_path):
+    text_docs, role_docs = [], []
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        n_sys, n_user, n_asst = rng.integers(2, 6, 3)
+        text_docs.append(rng.integers(5, 100, n_sys + n_user + n_asst))
+        role_docs.append(np.concatenate([
+            np.full(n_sys, Role.system), np.full(n_user, Role.prompter),
+            np.full(n_asst, Role.assistant)]))
+    write_dataset(str(tmp_path / "i_text_document"), text_docs, np.int32)
+    write_dataset(str(tmp_path / "i_role_document"), role_docs, np.int64)
+    from megatron_llm_tpu.data.instruction_dataset import (
+        build_instruction_datasets,
+    )
+
+    train, valid, test = build_instruction_datasets(
+        str(tmp_path / "i"), "8,1,1", seq_length=12, seed=0, pad_token=0,
+        scalar_loss_mask=0.25)
+    s = train[0]
+    assert s["tokens"].shape == (12,)
+    assert s["loss_mask"].shape == (12,)
+    # mask values ∈ {1.0 (assistant), 0.25 (context), 0.0 (pad)}
+    assert set(np.unique(s["loss_mask"])) <= {0.0, 0.25, 1.0}
