@@ -9,7 +9,8 @@ checkpoints.  What is kept, by design (SURVEY.md §5):
   iteration number or ``release``
 - args-in-checkpoint: the full RuntimeConfig is stored as config.json and
   ``load_config_from_checkpoint`` mirrors ``load_args_from_checkpoint``
-- resumable data order: TrainState.consumed_samples rides in the state
+- resumable data order: consumed_samples is saved in the checkpoint's
+  meta.json and re-seeds the sampler on resume
 - reshard-on-load: checkpoints are logical arrays, so loading under a
   different mesh/PartitionSpec layout just works — the offline
   ``tools/checkpoint_util.py`` TP×PP resharding tool is obsolete by design
@@ -83,6 +84,8 @@ def save_checkpoint(
 def load_meta(root: str, iteration: Optional[int | str] = None) -> dict:
     if iteration is None:
         iteration = read_tracker(root)
+        if iteration is None:
+            return {}
     meta_file = checkpoint_dir(root, iteration) / "meta.json"
     if not meta_file.exists():
         return {}
@@ -106,13 +109,18 @@ def load_checkpoint(
             raise FileNotFoundError(
                 f"no {TRACKER_FILENAME} under {root}; nothing to load")
     path = checkpoint_dir(root, iteration)
-    if iteration == RELEASE or not (path / "state").exists():
+    if iteration == RELEASE:
         # 'release' checkpoints are params-only (conversion output): restore
         # the params subtree, keep the template's fresh optimizer state —
         # the reference's --finetune-from-release semantics
         # (checkpointing.py:414-473).
         params = load_release_params(root, template.params)
         return template._replace(params=params), iteration
+    if not (path / "state").exists():
+        raise FileNotFoundError(
+            f"checkpoint {path} has no state/ directory — the save was "
+            "interrupted or the directory was lost; refusing to fall back "
+            "silently (pin iteration='release' to load base weights)")
     abstract = jax.tree.map(_as_abstract, template)
     with ocp.StandardCheckpointer() as ckptr:
         state = ckptr.restore((path / "state").absolute(), abstract)

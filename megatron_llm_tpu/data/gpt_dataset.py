@@ -16,6 +16,7 @@ Behavioral parity with the reference (megatron/data/gpt_dataset.py:20-513):
 from __future__ import annotations
 
 import hashlib
+import os
 import time
 from pathlib import Path
 from typing import Optional, Sequence
@@ -143,9 +144,15 @@ def _build_index_mappings(
         shuffle_idx = _build_shuffle_idx(
             num_first, sample_idx.shape[0] - 1, rng)
         base.mkdir(parents=True, exist_ok=True)
-        np.save(doc_file, doc_idx, allow_pickle=False)
-        np.save(sample_file, sample_idx, allow_pickle=False)
-        np.save(shuffle_file, shuffle_idx, allow_pickle=False)
+        # Atomic publish (tmp + rename): concurrent builders on shared
+        # storage may redo work but can never mmap a torn file (the
+        # reference instead gates the build on rank 0 + barrier,
+        # gpt_dataset.py:272-310).
+        for f, arr in ((doc_file, doc_idx), (sample_file, sample_idx),
+                       (shuffle_file, shuffle_idx)):
+            tmp = f.with_suffix(f".tmp{os.getpid()}.npy")
+            np.save(tmp, arr, allow_pickle=False)
+            os.replace(tmp, f)
 
     doc_idx = np.load(doc_file, mmap_mode="r", allow_pickle=False)
     sample_idx = np.load(sample_file, mmap_mode="r", allow_pickle=False)

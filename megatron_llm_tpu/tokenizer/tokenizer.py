@@ -2,8 +2,9 @@
 
 Parity with the reference (megatron/tokenizer/tokenizer.py:12-497):
 ``build_tokenizer`` dispatches on type — SentencePiece (Llama),
-HF AutoTokenizer wrap (Falcon), GPT-2 BPE — and pads the vocab to a multiple
-of ``make_vocab_size_divisible_by × tp`` (:39-63).  SentencePiece loads via
+HF AutoTokenizer wrap (Falcon), GPT-2 BPE.  Vocab padding to a multiple of
+``make_vocab_size_divisible_by × tp`` lives in
+``ModelConfig.padded_vocab_size`` (config.py).  SentencePiece loads via
 the `sentencepiece` package when present, else through HF's
 LlamaTokenizer(Fast) which reads the same .model files; special
 ChatML-style tokens can be appended via ``vocab_extra_ids_list`` (:326-497).
@@ -12,13 +13,8 @@ ChatML-style tokens can be appended via ``vocab_extra_ids_list`` (:326-497).
 from __future__ import annotations
 
 import abc
+import re
 from typing import Optional, Sequence
-
-
-def pad_vocab_size(orig_vocab_size: int, make_divisible_by: int = 128,
-                   tp: int = 1) -> int:
-    multiple = make_divisible_by * tp
-    return ((orig_vocab_size + multiple - 1) // multiple) * multiple
 
 
 class Tokenizer(abc.ABC):
@@ -113,6 +109,11 @@ class SentencePieceTokenizer(Tokenizer):
         base = self.base_vocab_size
         for i, tok in enumerate(vocab_extra_ids_list or []):
             self._extra[tok] = base + i
+        self._extra_by_id = {v: k for k, v in self._extra.items()}
+        self._extra_re = (
+            re.compile("(" + "|".join(map(re.escape, self._extra)) + ")")
+            if self._extra else None
+        )
 
     @property
     def base_vocab_size(self) -> int:
@@ -124,16 +125,46 @@ class SentencePieceTokenizer(Tokenizer):
     def vocab_size(self) -> int:
         return self.base_vocab_size + len(self._extra)
 
-    def tokenize(self, text: str) -> list[int]:
+    def _encode_plain(self, text: str) -> list[int]:
         if self._sp is not None:
             return self._sp.encode(text)
         return self._hf.encode(text, add_special_tokens=False)
 
-    def detokenize(self, ids) -> str:
-        ids = [i for i in ids if i < self.base_vocab_size]
+    def _decode_plain(self, ids: list[int]) -> str:
         if self._sp is not None:
             return self._sp.decode(ids)
         return self._hf.decode(ids)
+
+    def tokenize(self, text: str) -> list[int]:
+        """Split on registered special tokens, each emitted as its reserved
+        id (reference _SentencePieceTokenizer.tokenize splits the text on
+        special tokens the same way, tokenizer.py:418-441)."""
+        if self._extra_re is None:
+            return self._encode_plain(text)
+        out: list[int] = []
+        for part in self._extra_re.split(text):
+            if not part:
+                continue
+            if part in self._extra:
+                out.append(self._extra[part])
+            else:
+                out.extend(self._encode_plain(part))
+        return out
+
+    def detokenize(self, ids) -> str:
+        pieces: list[str] = []
+        run: list[int] = []
+        for i in ids:
+            if i in self._extra_by_id:
+                if run:
+                    pieces.append(self._decode_plain(run))
+                    run = []
+                pieces.append(self._extra_by_id[i])
+            elif i < self.base_vocab_size:
+                run.append(int(i))
+        if run:
+            pieces.append(self._decode_plain(run))
+        return "".join(pieces)
 
     @property
     def eod(self) -> int:

@@ -225,17 +225,22 @@ def falcon_from_hf(
     def qkv(i, idx):
         return qkv_cache[i][idx]
 
+    # Stack the attention weights first, then drop the per-layer cache so
+    # peak host memory holds only one copy of the QKV tensors.
+    attn = {
+        "wq": stack(lambda i: qkv(i, 0).T),
+        "wk": stack(lambda i: qkv(i, 1).T),
+        "wv": stack(lambda i: qkv(i, 2).T),
+        "wo": stack(lambda i: sd[pfx(i) + "self_attention.dense.weight"].T),
+    }
+    qkv_cache.clear()
+
     layers = {
         "input_norm": {
             "scale": stack(lambda i: sd[ln_name(i, "attn") + ".weight"]),
             "bias": stack(lambda i: sd[ln_name(i, "attn") + ".bias"]),
         },
-        "attn": {
-            "wq": stack(lambda i: qkv(i, 0).T),
-            "wk": stack(lambda i: qkv(i, 1).T),
-            "wv": stack(lambda i: qkv(i, 2).T),
-            "wo": stack(lambda i: sd[pfx(i) + "self_attention.dense.weight"].T),
-        },
+        "attn": attn,
         "mlp": {
             "w_up": stack(
                 lambda i: sd[pfx(i) + "mlp.dense_h_to_4h.weight"].T),
