@@ -106,6 +106,10 @@ class ModelConfig:
     # "dot" is the default until the Pallas kernel covers all shapes; "flash"
     # falls back to "dot" with a warning when the kernel is unavailable.
     attention_impl: str = "dot"
+    # norm impl: "pallas" (fused RMSNorm/LayerNorm kernel) | "xla" (jnp
+    # math XLA fuses into neighbors; the default — XLA's fusion is already
+    # near-bandwidth-bound for norms).
+    norm_impl: str = "xla"
     # recompute: "none" | "selective" | "full"
     recompute: str = "selective"
     # Parallel-friendly sequence length used for activation layouts.

@@ -18,6 +18,7 @@ from __future__ import annotations
 import hashlib
 import os
 import time
+import uuid
 from pathlib import Path
 from typing import Optional, Sequence
 
@@ -150,7 +151,7 @@ def _build_index_mappings(
         # gpt_dataset.py:272-310).
         for f, arr in ((doc_file, doc_idx), (sample_file, sample_idx),
                        (shuffle_file, shuffle_idx)):
-            tmp = f.with_suffix(f".tmp{os.getpid()}.npy")
+            tmp = f.with_suffix(f".tmp{os.getpid()}.{uuid.uuid4().hex}.npy")
             np.save(tmp, arr, allow_pickle=False)
             os.replace(tmp, f)
 

@@ -132,7 +132,8 @@ def forward(
         deterministic=deterministic,
     )
     x = stack_forward(cfg, params["layers"], x, side, stack_rng)
-    x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps)
+    x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps,
+                   impl=cfg.norm_impl)
     logits = unembed(cfg, params, x)
     return logits.astype(jnp.float32)
 

@@ -223,13 +223,15 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
     (ParallelTransformerLayer.forward).
     """
     residual = x
-    h1 = norm_apply(cfg.norm_type, x, p["input_norm"], cfg.norm_eps)
+    h1 = norm_apply(cfg.norm_type, x, p["input_norm"], cfg.norm_eps,
+                    impl=cfg.norm_impl)
     attn_out = attention_block(cfg, p["attn"], h1, side, layer_rng)
 
     det = side.deterministic
     if cfg.parallel_attn:
         if cfg.parallel_layernorm:
-            mlp_in = norm_apply(cfg.norm_type, x, p["mlp_norm"], cfg.norm_eps)
+            mlp_in = norm_apply(cfg.norm_type, x, p["mlp_norm"],
+                                cfg.norm_eps, impl=cfg.norm_impl)
         else:
             mlp_in = h1
         mlp_out = mlp_block(cfg, p["mlp"], mlp_in)
@@ -244,7 +246,8 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
             a = _dropout(a, cfg.hidden_dropout,
                          jax.random.fold_in(layer_rng, 2), det)
         x = residual + a
-        h2 = norm_apply(cfg.norm_type, x, p["post_attn_norm"], cfg.norm_eps)
+        h2 = norm_apply(cfg.norm_type, x, p["post_attn_norm"],
+                        cfg.norm_eps, impl=cfg.norm_impl)
         m = mlp_block(cfg, p["mlp"], h2)
         if layer_rng is not None:
             m = _dropout(m, cfg.hidden_dropout,

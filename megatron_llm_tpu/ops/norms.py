@@ -45,25 +45,20 @@ def layernorm_ref(
     return out.astype(dtype)
 
 
-def rmsnorm(x: jax.Array, weight: jax.Array, eps: float = 1e-5,
-            use_kernel: bool = False) -> jax.Array:
-    """Dispatch between the XLA-fused reference path and the Pallas kernel
-    (mirrors the availability-fallback pattern of
-    megatron/model/fused_softmax.py:152-172)."""
-    if use_kernel:
-        try:
-            from ..kernels.rmsnorm import rmsnorm_pallas
-        except ImportError:
-            pass  # kernel not built yet → XLA-fused reference path
-        else:
-            return rmsnorm_pallas(x, weight, eps=eps)
-    return rmsnorm_ref(x, weight, eps)
-
-
-def norm_apply(norm_type: str, x, params: dict, eps: float) -> jax.Array:
+def norm_apply(norm_type: str, x, params: dict, eps: float,
+               impl: str = "xla") -> jax.Array:
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown norm impl {impl!r} (want 'xla'|'pallas')")
     if norm_type == "rmsnorm":
+        if impl == "pallas":
+            from ..kernels.rmsnorm import rmsnorm_pallas
+            return rmsnorm_pallas(x, params["scale"], eps)
         return rmsnorm_ref(x, params["scale"], eps)
     elif norm_type == "layernorm":
+        if impl == "pallas":
+            from ..kernels.rmsnorm import layernorm_pallas
+            return layernorm_pallas(x, params["scale"], params.get("bias"),
+                                    eps)
         return layernorm_ref(x, params["scale"], params.get("bias"), eps)
     raise ValueError(f"unknown norm type {norm_type}")
 

@@ -110,8 +110,11 @@ class SentencePieceTokenizer(Tokenizer):
         for i, tok in enumerate(vocab_extra_ids_list or []):
             self._extra[tok] = base + i
         self._extra_by_id = {v: k for k, v in self._extra.items()}
+        # Longest-first alternation so a special token that prefixes
+        # another never shadows it.
+        ordered = sorted(self._extra, key=len, reverse=True)
         self._extra_re = (
-            re.compile("(" + "|".join(map(re.escape, self._extra)) + ")")
+            re.compile("(" + "|".join(map(re.escape, ordered)) + ")")
             if self._extra else None
         )
 
