@@ -1,0 +1,394 @@
+"""Pipeline parallelism: circular shift-register 1F1B over the ``pp`` mesh axis.
+
+Reference mapping (megatron/schedules.py:18-722):
+
+- ``forward_backward_no_pipelining`` (schedules.py:213) → the plain
+  microbatch ``lax.scan`` in ``training/step.py`` (pp = 1).
+- ``forward_backward_pipelining_without_interleaving`` — 1F1B
+  (schedules.py:606) → ``pipeline_apply`` with ``vpp = 1``.
+- ``forward_backward_pipelining_with_interleaving`` — virtual stages
+  (schedules.py:253) → ``pipeline_apply`` with ``vpp > 1`` (the circular
+  schedule: each device holds ``vpp`` layer chunks and every microbatch
+  passes around the ring ``vpp`` times).
+- ``p2p_communication.py``'s batched isend/irecv between stage neighbours →
+  a single ``jax.lax.ppermute`` over the ring per tick.
+
+Design: torch autograd drives the reference's backward passes through
+send/recv hooks; in JAX the whole pipelined forward is one differentiable
+SPMD program (``ppermute`` has a well-defined transpose = the reverse
+permutation), so ``jax.grad`` of the pipelined loss *is* the backward
+pipeline — warmup/steady/cooldown bookkeeping (schedules.py:606-722) never
+has to be re-derived.  Compute-wise every device runs every tick and the
+bubble shows up as ticks whose results are masked out, which costs exactly
+the same wall-clock as an idle bubble.
+
+Schedule shape (T = ticks):
+- vpp = 1:  T = M + pp - 1           (M = num microbatches)
+- vpp > 1:  T = M·vpp + pp - 1, requiring M ≥ pp; finished microbatches
+  wrap from the last stage back to stage 0 through a circular storage
+  buffer and re-enter for their next chunk after a full round of M ticks.
+Bubble fraction = (pp-1)/(M·vpp + pp - 1): interleaving divides the bubble
+by vpp exactly as in the reference's interleaved 1F1B.
+
+Layer→stage assignment matches the reference (megatron/model/
+transformer.py:1015-1060): chunk v on stage s holds global layers
+``[(v·pp + s)·lpc, (v·pp + s + 1)·lpc)`` — i.e. ``layers.reshape(vpp, pp,
+lpc, ...)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..config import ModelConfig, ParallelConfig, RuntimeConfig
+from ..models.transformer import AttnSideInputs, stack_forward
+from ..models import model as model_lib
+from ..ops.norms import norm_apply
+from .cross_entropy import cross_entropy, masked_mean_loss
+from . import mesh as mesh_lib
+
+PyTree = Any
+PP = mesh_lib.PIPELINE_AXIS
+
+
+# ---------------------------------------------------------------------------
+# Stage-stacked parameter layout
+# ---------------------------------------------------------------------------
+
+
+def layers_per_chunk(num_layers: int, pp: int, vpp: int = 1) -> int:
+    return mesh_lib.pipeline_stage_layers(num_layers, pp, vpp)[0]
+
+
+def to_stage_layers(stacked: PyTree, pp: int, vpp: int = 1) -> PyTree:
+    """[L, ...] layer stack → [vpp, pp, lpc, ...] stage-stacked layout."""
+
+    def split(x):
+        lpc = layers_per_chunk(x.shape[0], pp, vpp)
+        return x.reshape(vpp, pp, lpc, *x.shape[1:])
+
+    return jax.tree.map(split, stacked)
+
+
+def from_stage_layers(staged: PyTree) -> PyTree:
+    """Inverse of :func:`to_stage_layers` (for checkpoints / HF interop)."""
+    return jax.tree.map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1] * x.shape[2],
+                            *x.shape[3:]),
+        staged,
+    )
+
+
+def to_pipeline_params(params: PyTree, parallel: ParallelConfig) -> PyTree:
+    """Model params with the layer stack re-laid-out for the pipeline."""
+    pp = parallel.pipeline_parallel
+    if pp == 1:
+        return params
+    out = dict(params)
+    out["layers"] = to_stage_layers(
+        params["layers"], pp, parallel.virtual_pipeline_stages)
+    return out
+
+
+def from_pipeline_params(params: PyTree, parallel: ParallelConfig) -> PyTree:
+    if parallel.pipeline_parallel == 1:
+        return params
+    out = dict(params)
+    out["layers"] = from_stage_layers(params["layers"])
+    return out
+
+
+def stage_layer_specs(layer_specs: PyTree) -> PyTree:
+    """Turn per-layer-stack specs P(None, *dims) into staged specs
+    P(None, 'pp', None, *dims).  The first (layer) axis of the flat spec is
+    dropped and replaced by (vpp, pp, lpc)."""
+    def conv(spec: P) -> P:
+        rest = tuple(spec)[1:] if len(spec) else ()
+        return P(None, PP, None, *rest)
+
+    return jax.tree.map(conv, layer_specs,
+                        is_leaf=lambda s: isinstance(s, P))
+
+
+def pipeline_param_specs(specs: PyTree, parallel: ParallelConfig) -> PyTree:
+    """Full-model spec tree with the layer stack staged over 'pp'."""
+    if parallel.pipeline_parallel == 1:
+        return specs
+    out = dict(specs)
+    out["layers"] = stage_layer_specs(specs["layers"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The pipelined stack
+# ---------------------------------------------------------------------------
+
+
+def _stage_tick(cfg: ModelConfig, chunks: PyTree, chunk_idx, x, side,
+                rng):
+    """Apply this device's current layer chunk to one microbatch.
+
+    ``chunks``: [vpp, lpc, ...] local layer params; ``chunk_idx`` selects
+    which virtual chunk this tick runs (traced, device-varying).
+
+    The cast to compute dtype happens *here*, per tick: when the caller holds
+    fp32 params, the scan transpose then accumulates each tick's (bf16)
+    weight cotangents into an fp32 buffer — the analogue of the reference's
+    fp32 main_grad accumulation (megatron/model/distributed.py:75-200,
+    fused wgrad accum fused_weight_gradient_dense.cu).
+    """
+    chunk = jax.tree.map(
+        lambda c: jax.lax.dynamic_index_in_dim(c, chunk_idx, 0,
+                                               keepdims=False).astype(
+                                                   cfg.dtype),
+        chunks,
+    )
+    return stack_forward(cfg, chunk, x, side, rng)
+
+
+def pipeline_apply(
+    cfg: ModelConfig,
+    staged_layers: PyTree,  # [vpp, pp, lpc, ...] sharded P(None,'pp',None,…)
+    x_mb: jax.Array,  # [M, mb, s, h] microbatched hidden states
+    side_mb: AttnSideInputs,  # leaves with leading [M] dim or None
+    *,
+    mesh,
+    pp: int,
+    vpp: int = 1,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Run all M microbatches through the pipelined decoder stack.
+
+    Returns [M, mb, s, h] final hidden states, replicated over 'pp'.
+    """
+    M = x_mb.shape[0]
+    if vpp > 1:
+        assert M >= pp, (
+            f"interleaved pipeline needs num_microbatches ≥ pp ({M} < {pp})"
+        )
+    T = M * vpp + pp - 1
+
+    ring = [(s, (s + 1) % pp) for s in range(pp)]
+
+    compute_dtype = x_mb.dtype
+
+    def pipelined(chunks, x_all, pos_mb, seg_mb):
+        # chunks: [vpp, 1, lpc, ...] (pp axis manual) → squeeze stage dim
+        chunks_local = jax.tree.map(lambda c: c[:, 0], chunks)
+        # The boundary crossing runs in f32 (see call site); compute in the
+        # model dtype inside.
+        x_all = x_all.astype(compute_dtype)
+        stage = jax.lax.axis_index(PP)
+        side_all = AttnSideInputs(
+            rope_cos=side_mb.rope_cos, rope_sin=side_mb.rope_sin,
+            position_ids=pos_mb, segment_ids=seg_mb,
+            deterministic=side_mb.deterministic,
+        )
+
+        mb_shape = x_all.shape[1:]
+        outputs = jnp.zeros((M,) + mb_shape, x_all.dtype)
+        circ = (jnp.zeros((M,) + mb_shape, x_all.dtype)
+                if vpp > 1 else None)
+
+        def tick(carry, t):
+            state, circ, outputs = carry
+            # Which microbatch / chunk this stage works on at tick t.
+            rel = t - stage  # ticks since this stage first saw work
+            m_idx = jnp.clip(rel, 0, None) % M
+            chunk_idx = jnp.clip(rel // M, 0, vpp - 1)
+
+            # Stage-0 input: fresh microbatch while t < M, then wrapped
+            # microbatches from circular storage.
+            fresh = jax.lax.dynamic_index_in_dim(
+                x_all, jnp.minimum(t, M - 1), 0, keepdims=False)
+            if circ is not None:
+                wrapped = jax.lax.dynamic_index_in_dim(
+                    circ, t % M, 0, keepdims=False)
+                inp = jnp.where(t < M, fresh, wrapped)
+            else:
+                inp = fresh
+            current = jnp.where(stage == 0, inp, state)
+
+            tick_rng = None
+            if rng is not None:
+                # unique stream per (microbatch, ring position)
+                tick_rng = jax.random.fold_in(
+                    jax.random.fold_in(rng, m_idx),
+                    chunk_idx * pp + stage)
+
+            sel_side = AttnSideInputs(
+                rope_cos=side_all.rope_cos, rope_sin=side_all.rope_sin,
+                position_ids=(None if side_all.position_ids is None else
+                              jax.lax.dynamic_index_in_dim(
+                                  side_all.position_ids, m_idx, 0,
+                                  keepdims=False)),
+                segment_ids=(None if side_all.segment_ids is None else
+                             jax.lax.dynamic_index_in_dim(
+                                 side_all.segment_ids, m_idx, 0,
+                                 keepdims=False)),
+                deterministic=side_all.deterministic,
+            )
+
+            out = _stage_tick(cfg, chunks_local, chunk_idx, current,
+                              sel_side, tick_rng)
+
+            # Last stage collects finished microbatches (final chunk only).
+            out_idx = t - (vpp - 1) * M - (pp - 1)
+            valid = out_idx >= 0
+            w_idx = jnp.clip(out_idx, 0, M - 1)
+            existing = jax.lax.dynamic_index_in_dim(
+                outputs, w_idx, 0, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, out, existing), w_idx, 0)
+
+            # Rotate the ring: stage s → s+1; stage 0 receives the wrap
+            # from the last stage.
+            shifted = jax.lax.ppermute(out, PP, ring)
+
+            if circ is not None:
+                # The wrap produced at tick t is microbatch (t-(pp-1)) mod M
+                # finishing a chunk round; park it for re-entry.
+                c_idx = jnp.clip(t - (pp - 1), 0, None) % M
+                c_valid = t >= pp - 1
+                c_existing = jax.lax.dynamic_index_in_dim(
+                    circ, c_idx, 0, keepdims=False)
+                circ = jax.lax.dynamic_update_index_in_dim(
+                    circ, jnp.where(c_valid, shifted, c_existing), c_idx, 0)
+
+            return (shifted, circ, outputs), None
+
+        init = (jnp.zeros(mb_shape, x_all.dtype), circ, outputs)
+        (_, _, outputs), _ = jax.lax.scan(tick, init, jnp.arange(T))
+
+        # Only the last stage's buffer holds real data; make the result
+        # invariant over 'pp' with a masked psum (cheap: [M, mb, s, h] once).
+        # The psum runs in f32: XLA's CPU AllReducePromotion pass crashes on
+        # bf16 all-reduces emitted by partial-auto shard_map (repro'd on
+        # jax 0.9.0 CPU), and one f32 transfer of the boundary tensor is
+        # noise next to the per-tick ring traffic.
+        mask = (stage == pp - 1).astype(jnp.float32)
+        out32 = jax.lax.psum(outputs.astype(jnp.float32) * mask, PP)
+        return out32.astype(outputs.dtype)
+
+    layer_in_specs = jax.tree.map(
+        lambda _: P(None, PP), staged_layers)
+    pos = side_mb.position_ids
+    seg = side_mb.segment_ids
+    fn = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(layer_in_specs, P(), P(), P()),
+        out_specs=P(),
+        axis_names={PP},
+        check_vma=False,
+    )
+    # The replicated (P()) input's transpose is a psum of its cotangent over
+    # 'pp'; cross the boundary in f32 — partial-auto shard_map lowers bf16
+    # all-reduces to a form that crashes XLA:CPU's AllReducePromotion pass
+    # (jax 0.9.0), and f32 here also gives exact cotangent accumulation.
+    out = fn(staged_layers, x_mb.astype(jnp.float32), pos, seg)
+    return out.astype(compute_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Full-model pipelined loss
+# ---------------------------------------------------------------------------
+
+
+def pipeline_loss(
+    cfg: RuntimeConfig,
+    params: PyTree,  # pipeline layout (to_pipeline_params)
+    batch: dict,  # leaves [M, mb, ...]
+    *,
+    mesh,
+    rng: Optional[jax.Array] = None,
+    rope=None,
+):
+    """Mean masked LM loss over M microbatches through the pipeline.
+
+    Mirrors the per-microbatch loss averaging of the reference schedules
+    (schedules.py:129-139 collects per-microbatch losses; training.py:444-452
+    averages).  The embedding/unembedding run replicated over 'pp' — the
+    wall-clock equivalent of the reference's first/last-stage placement, and
+    the tied-embedding all-reduce of module.py:52-121 becomes unnecessary.
+    """
+    model_cfg = cfg.model
+    parallel = cfg.parallel
+    pp = parallel.pipeline_parallel
+    vpp = parallel.virtual_pipeline_stages
+
+    if rope is None:
+        from ..models.transformer import rope_tables
+        rope = rope_tables(model_cfg)
+    cos, sin = rope
+
+    tokens = batch["tokens"]  # [M, mb, s]
+    M = tokens.shape[0]
+
+    embed_rng = stack_rng = None
+    if rng is not None:
+        embed_rng, stack_rng = jax.random.split(rng)
+
+    deterministic = rng is None
+
+    # Per-use-site cast to compute dtype: callers may hold fp32 params so
+    # that cross-microbatch cotangent accumulation (the scan transposes)
+    # runs in fp32, matching _accumulate_grads' per-microbatch fp32 sum.
+    def cast(tree):
+        return jax.tree.map(lambda x: x.astype(model_cfg.dtype), tree)
+
+    # Embedding, scanned per microbatch so embedding-weight cotangents
+    # accumulate across microbatches at the caller's (fp32) precision.
+    def embed_one(_, m):
+        tok = tokens[m]
+        pos = (None if batch.get("position_ids") is None
+               else batch["position_ids"][m])
+        er = (None if embed_rng is None
+              else jax.random.fold_in(embed_rng, m))
+        x = model_lib.embed(model_cfg,
+                            {"embedding": cast(params["embedding"])},
+                            tok, pos, None, er, deterministic)
+        return None, x
+
+    _, x_mb = jax.lax.scan(embed_one, None, jnp.arange(M))
+
+    side_mb = AttnSideInputs(
+        rope_cos=cos, rope_sin=sin,
+        position_ids=batch.get("position_ids"),
+        segment_ids=batch.get("segment_ids"),
+        deterministic=deterministic,
+    )
+
+    h_mb = pipeline_apply(
+        model_cfg, params["layers"], x_mb, side_mb,
+        mesh=mesh, pp=pp, vpp=vpp, rng=stack_rng,
+    )
+
+    # Head: scan microbatches so only one microbatch of logits is live.
+    head_params = {"final_norm": params["final_norm"]}
+    if "lm_head" in params:
+        head_params["lm_head"] = params["lm_head"]
+    else:
+        head_params["embedding"] = params["embedding"]
+
+    def head(carry, inp):
+        h, labels, mask = inp
+        hp = cast(head_params)
+        h = norm_apply(model_cfg.norm_type, h, hp["final_norm"],
+                       model_cfg.norm_eps, impl=model_cfg.norm_impl)
+        logits = model_lib.unembed(model_cfg, hp, h).astype(jnp.float32)
+        per_token = cross_entropy(logits, labels,
+                                  vocab_size=model_cfg.vocab_size)
+        loss = masked_mean_loss(per_token, mask)
+        return carry + loss, None
+
+    head = jax.checkpoint(head, prevent_cse=False)
+    total, _ = jax.lax.scan(
+        head, jnp.zeros((), jnp.float32),
+        (h_mb, batch["labels"], batch["loss_mask"]),
+    )
+    return total / M

@@ -109,8 +109,32 @@ def _accumulate_grads(cfg: RuntimeConfig, params, batch, rng, rope,
     return grads, loss_sum * inv
 
 
+def _pipeline_grads(cfg: RuntimeConfig, params, batch, rng, rope,
+                    loss_scale, mesh):
+    """Grads via the pipelined schedule (parallel/pipeline.py) when pp > 1.
+
+    The microbatch loop *is* the pipeline here — one differentiable program
+    whose jax.grad is the backward pipeline (reference: schedules.py:606-722
+    drives backward through autograd send/recv hooks instead).
+    """
+    from ..parallel import pipeline as pipe
+
+    def scaled_loss(p32):
+        loss = pipe.pipeline_loss(cfg, p32, batch, mesh=mesh, rng=rng,
+                                  rope=rope)
+        return loss * loss_scale, loss
+
+    # Differentiate w.r.t. an fp32 view: pipeline_loss casts to compute
+    # dtype at each per-tick use site, so the scan transposes accumulate
+    # weight cotangents across microbatches in fp32 — the same invariant
+    # _accumulate_grads keeps via its per-microbatch fp32 sum.
+    params32 = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+    (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params32)
+    return grads, loss
+
+
 def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
-               base_rng: Optional[jax.Array] = None, rope=None):
+               base_rng: Optional[jax.Array] = None, rope=None, mesh=None):
     """One optimizer step over ``grad_accum`` microbatches.
 
     Returns (new_state, metrics).  Donate ``state`` when jitting.
@@ -124,8 +148,12 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
     scaler = state.opt.scaler
     loss_scale = scaler.scale if scaler is not None else jnp.float32(1.0)
 
-    grads, loss = _accumulate_grads(cfg, state.params, batch, rng, rope,
-                                    loss_scale)
+    if cfg.parallel.pipeline_parallel > 1:
+        grads, loss = _pipeline_grads(cfg, state.params, batch, rng, rope,
+                                      loss_scale, mesh)
+    else:
+        grads, loss = _accumulate_grads(cfg, state.params, batch, rng, rope,
+                                        loss_scale)
     # unscale (reference: optimizer.py:384-404 unscale-and-check-inf)
     grads = jax.tree.map(lambda g: g / loss_scale, grads)
     grad_norm = opt_lib.global_grad_norm(grads)
@@ -190,7 +218,7 @@ def make_train_step(cfg: RuntimeConfig, mesh=None, state_sharding=None,
     rope = rope_tables(cfg.model)
 
     def step(state, batch, base_rng):
-        return train_step(cfg, state, batch, base_rng, rope=rope)
+        return train_step(cfg, state, batch, base_rng, rope=rope, mesh=mesh)
 
     kwargs = {}
     if state_sharding is not None:
