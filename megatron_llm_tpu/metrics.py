@@ -1,0 +1,99 @@
+"""Pluggable validation-metric registry.
+
+Reference: megatron/metrics.py:62-110 — a ``MetricInput`` wrapper with lazy
+derived fields and a ``METRICS`` registry {perplexity, accuracy,
+instruct_accuracy, count_loss_mask, count_instruct_mask} evaluated during
+validation only (wired at finetune.py:206-211, names validated at
+arguments.py:94-95).  Metrics are pure jnp functions so they can run inside
+the jitted eval step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class MetricInput:
+    """Lazily-derived per-batch quantities shared across metrics
+    (reference MetricInput, metrics.py:62-99)."""
+
+    def __init__(self, batch: dict, logits: jax.Array,
+                 per_token_loss: jax.Array):
+        self.batch = batch  # tokens/labels/loss_mask (+segment/assistant masks)
+        self.logits = logits  # [b, s, vocab]
+        self.per_token_loss = per_token_loss  # [b, s]
+        self._predictions: Optional[jax.Array] = None
+
+    @property
+    def loss_mask(self) -> jax.Array:
+        return self.batch["loss_mask"].astype(jnp.float32)
+
+    @property
+    def assistant_mask(self) -> jax.Array:
+        """Instruction-tuning assistant-token mask: where the loss weight is
+        exactly 1 (non-assistant tokens carry the scalar weight < 1;
+        reference instruction_dataset.py:20-45, finetune.py:148-161)."""
+        m = self.batch.get("assistant_mask")
+        if m is not None:
+            return m.astype(jnp.float32)
+        return (self.batch["loss_mask"] >= 1.0).astype(jnp.float32)
+
+    @property
+    def predictions(self) -> jax.Array:
+        if self._predictions is None:
+            self._predictions = jnp.argmax(self.logits, axis=-1)
+        return self._predictions
+
+    @property
+    def correct(self) -> jax.Array:
+        return (self.predictions == self.batch["labels"]).astype(jnp.float32)
+
+
+def _masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
+    total = jnp.sum(mask)
+    return jnp.sum(x * mask) / jnp.maximum(total, 1.0)
+
+
+def perplexity(inp: MetricInput) -> jax.Array:
+    return jnp.exp(_masked_mean(inp.per_token_loss, inp.loss_mask))
+
+
+def accuracy(inp: MetricInput) -> jax.Array:
+    return _masked_mean(inp.correct, inp.loss_mask)
+
+
+def instruct_accuracy(inp: MetricInput) -> jax.Array:
+    return _masked_mean(inp.correct, inp.assistant_mask)
+
+
+def count_loss_mask(inp: MetricInput) -> jax.Array:
+    return jnp.sum(inp.loss_mask)
+
+
+def count_instruct_mask(inp: MetricInput) -> jax.Array:
+    return jnp.sum(inp.assistant_mask)
+
+
+METRICS: Dict[str, Callable[[MetricInput], jax.Array]] = {
+    "perplexity": perplexity,
+    "accuracy": accuracy,
+    "instruct_accuracy": instruct_accuracy,
+    "count_loss_mask": count_loss_mask,
+    "count_instruct_mask": count_instruct_mask,
+}
+
+
+def validate_metric_names(names) -> None:
+    unknown = [n for n in names if n not in METRICS]
+    if unknown:
+        raise ValueError(
+            f"unknown metrics {unknown}; available: {sorted(METRICS)}")
+
+
+def compute_metrics(names, batch: dict, logits: jax.Array,
+                    per_token_loss: jax.Array) -> dict[str, jax.Array]:
+    inp = MetricInput(batch, logits, per_token_loss)
+    return {n: METRICS[n](inp) for n in names}

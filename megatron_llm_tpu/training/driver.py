@@ -1,0 +1,591 @@
+"""Training orchestration: the ``pretrain()`` driver.
+
+TPU-native counterpart of megatron/training.py:55-961:
+- ``setup_train_state``   ← get_model + get_megatron_optimizer + load_checkpoint
+  (training.py:199-304, 353-391): builds the mesh-sharded TrainState with
+  ZeRO-1 optimizer-state specs and the jitted train step
+- ``pretrain``            ← pretrain + _train (training.py:55-169, 654-770):
+  data iterators, train loop, logging, eval/save/exit hooks, SIGTERM
+  checkpointing, batch-size rampup, consumed-samples resume
+- ``evaluate``            ← evaluate + evaluate_and_print_results
+  (training.py:773-876) with the pluggable metrics registry (metrics.py)
+- ``training_log``        ← training.py:462-641: loss/lr/norm/skip logging,
+  tokens-per-second counter (finetune.py:124-135) and per-phase timers
+
+Host/device split: the device state (params, moments, iteration) lives in the
+jitted step; host state (consumed_samples, wall-clock, signal flags, the
+microbatch calculator) lives here — matching the reference's division between
+CUDA tensors and the args namespace.
+"""
+
+from __future__ import annotations
+
+import datetime
+import signal
+import sys
+import time
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import checkpointing, metrics as metrics_lib
+from ..config import RuntimeConfig
+from ..data.samplers import BatchIterator
+from ..models import model as model_lib
+from ..models import sharding as shard_lib
+from ..models.transformer import rope_tables
+from ..parallel import mesh as mesh_lib
+from ..parallel.cross_entropy import cross_entropy, masked_mean_loss
+from ..utils.timers import Timers
+from ..utils.writers import NullWriter, build_writer
+from . import optimizer as opt_lib
+from .microbatches import build_num_microbatches_calculator
+from .step import TrainState, init_train_state, make_train_step
+
+PyTree = Any
+
+
+def print_rank_0(*args, **kwargs):
+    """Reference rank-printing discipline (megatron/utils.py:197-228); under
+    multi-controller JAX, process 0 speaks."""
+    if jax.process_index() == 0:
+        print(*args, **kwargs, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM checkpointing (reference: megatron/dist_signal_handler.py:50-81,
+# training.py:731-737)
+# ---------------------------------------------------------------------------
+
+
+class DistSignalHandler:
+    """Capture a signal and expose cluster-consensus receipt.
+
+    The reference all-gathers per-rank receipt flags so every rank agrees to
+    checkpoint; with multi-controller JAX each process polls its local flag
+    and agreement comes from ``process_allgather`` when more than one
+    process exists.
+    """
+
+    def __init__(self, sig: int = signal.SIGTERM):
+        self.sig = sig
+        self._received = False
+        self._prev = None
+
+    def __enter__(self):
+        def handler(signum, frame):
+            self._received = True
+
+        self._prev = signal.signal(self.sig, handler)
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev is not None:
+            signal.signal(self.sig, self._prev)
+        return False
+
+    def signals_received(self) -> bool:
+        if jax.process_count() == 1:
+            return self._received
+        try:
+            from jax.experimental import multihost_utils
+
+            flags = multihost_utils.process_allgather(
+                np.asarray([self._received]))
+            return bool(np.any(flags))
+        except Exception:
+            return self._received
+
+
+# ---------------------------------------------------------------------------
+# State construction (reference get_model + optimizer setup,
+# training.py:199-391)
+# ---------------------------------------------------------------------------
+
+
+class TrainingArtifacts:
+    """Everything ``pretrain`` needs per run: sharded state + jitted step."""
+
+    def __init__(self, cfg, mesh, state, state_sharding, batch_sharding,
+                 step_fn, param_specs):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.state = state
+        self.state_sharding = state_sharding
+        self.batch_sharding = batch_sharding
+        self.step_fn = step_fn
+        self.param_specs = param_specs
+
+
+def setup_train_state(
+    cfg: RuntimeConfig,
+    mesh=None,
+    init_rng: Optional[jax.Array] = None,
+    params: Optional[PyTree] = None,
+) -> TrainingArtifacts:
+    """Build mesh-sharded TrainState + jitted step for ``cfg``.
+
+    Mirrors _setup_model_and_optimizer (training.py:353-391): model init (or
+    externally supplied params, e.g. from an HF conversion), sharding
+    placement, optimizer-state init with ZeRO-1 dp specs, jit compile.
+    """
+    parallel = cfg.parallel
+    if mesh is None:
+        mesh = mesh_lib.build_mesh(parallel)
+    if init_rng is None:
+        init_rng = jax.random.key(cfg.train.seed)
+
+    with mesh:
+        from ..parallel import pipeline as pipe_lib
+
+        if params is None:
+            params = model_lib.init_params(
+                init_rng, cfg.model, tp=parallel.tensor_parallel)
+        pspecs = shard_lib.param_specs(cfg.model, parallel)
+        if parallel.pipeline_parallel > 1:
+            params = pipe_lib.to_pipeline_params(params, parallel)
+            pspecs = pipe_lib.pipeline_param_specs(pspecs, parallel)
+        params = shard_lib.shard_params(params, pspecs, mesh)
+        state = init_train_state(cfg, params)
+
+        ospecs = opt_lib.opt_state_specs(pspecs, params, parallel, state.opt)
+        state_spec = TrainState(
+            params=pspecs, opt=ospecs, iteration=P(), skipped=P())
+        state_sharding = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), state_spec,
+            is_leaf=lambda x: isinstance(x, P))
+        batch_sharding = NamedSharding(mesh, P(None, "dp", None))
+        state = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), state, state_sharding)
+
+        # batch sharding is a pytree prefix: one sharding broadcast over
+        # whatever keys the batch dict carries
+        step_fn = make_train_step(cfg, mesh, state_sharding, batch_sharding)
+    return TrainingArtifacts(cfg, mesh, state, state_sharding, batch_sharding,
+                             step_fn, pspecs)
+
+
+def _put_batch(batch: dict, sharding) -> dict:
+    return {k: jax.device_put(jnp.asarray(v), sharding)
+            for k, v in batch.items()}
+
+
+# ---------------------------------------------------------------------------
+# Evaluation (reference evaluate, training.py:773-826; metrics wired like
+# finetune.py:206-211)
+# ---------------------------------------------------------------------------
+
+
+def make_eval_step(cfg: RuntimeConfig, metric_names=(), mesh=None,
+                   batch_sharding=None, param_specs=None):
+    """Jitted forward-only step returning lm loss + registry metrics."""
+    metrics_lib.validate_metric_names(metric_names)
+    rope = rope_tables(cfg.model)
+
+    def eval_step(params, batch):
+        logits = model_lib.forward(
+            cfg.model, params, batch["tokens"],
+            position_ids=batch.get("position_ids"),
+            segment_ids=batch.get("segment_ids"),
+            deterministic=True, rope=rope,
+        )
+        per_token = cross_entropy(
+            logits, batch["labels"], vocab_size=cfg.model.vocab_size)
+        loss = masked_mean_loss(per_token, batch["loss_mask"])
+        out = {"lm_loss": loss}
+        out.update(metrics_lib.compute_metrics(
+            metric_names, batch, logits, per_token))
+        return out
+
+    kwargs = {}
+    if param_specs is not None and mesh is not None:
+        in_sharding = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), param_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        kwargs["in_shardings"] = (in_sharding, batch_sharding)
+    return jax.jit(eval_step, **kwargs)
+
+
+def make_pipeline_eval_step(cfg: RuntimeConfig, mesh):
+    """Forward-only loss via the pipelined schedule for pp > 1 (params are
+    in staged form and only the last stage sees logits, so the registry
+    metrics are unavailable — loss only, like the reference's pipelined
+    evaluate which reduces losses from the final stage)."""
+    from ..parallel import pipeline as pipe
+
+    rope = rope_tables(cfg.model)
+
+    def eval_step(params, batch):
+        loss = pipe.pipeline_loss(cfg, params, batch, mesh=mesh, rng=None,
+                                  rope=rope)
+        return {"lm_loss": loss}
+
+    return jax.jit(eval_step)
+
+
+def evaluate(cfg: RuntimeConfig, params, data_iterator, eval_step,
+             eval_iters: Optional[int] = None,
+             batch_sharding=None, flatten: bool = True) -> dict[str, float]:
+    """Average eval metrics over ``eval_iters`` batches
+    (reference training.py:773-826).  ``flatten=False`` keeps the
+    [accum, micro, ...] layout for the pipelined eval step."""
+    if eval_iters is None:
+        eval_iters = cfg.train.eval_iters
+    totals: dict[str, float] = {}
+    n = 0
+    for _ in range(eval_iters):
+        try:
+            batch = next(data_iterator)
+        except StopIteration:
+            break
+        if flatten:
+            # [accum, micro, ...] → [accum*micro, ...] for the plain
+            # forward-only step
+            flat = {k: np.reshape(v, (-1,) + v.shape[2:])
+                    for k, v in batch.items()}
+        else:
+            flat = batch
+        if batch_sharding is not None:
+            flat = {k: jax.device_put(jnp.asarray(v), batch_sharding)
+                    for k, v in flat.items()}
+        out = eval_step(params, flat)
+        out = jax.device_get(out)
+        for k, v in out.items():
+            totals[k] = totals.get(k, 0.0) + float(v)
+        n += 1
+    return {k: v / max(n, 1) for k, v in totals.items()}
+
+
+def evaluate_and_print_results(prefix: str, cfg, params, data_iterator,
+                               eval_step, writer=None, iteration: int = 0,
+                               batch_sharding=None,
+                               flatten: bool = True) -> dict[str, float]:
+    """Reference evaluate_and_print_results (training.py:829-876)."""
+    results = evaluate(cfg, params, data_iterator, eval_step,
+                       batch_sharding=batch_sharding, flatten=flatten)
+    string = f" validation loss at {prefix} | "
+    for k, v in results.items():
+        string += f"{k}: {v:.6E} | "
+        if writer is not None:
+            writer.add_scalar(f"valid/{k}", v, iteration)
+        if k == "lm_loss":
+            ppl = float(np.exp(min(20.0, v)))
+            string += f"lm loss PPL: {ppl:.6E} | "
+            if writer is not None:
+                writer.add_scalar("valid/lm_loss_ppl", ppl, iteration)
+    length = len(string) + 1
+    print_rank_0("-" * length)
+    print_rank_0(string)
+    print_rank_0("-" * length)
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Logging (reference training_log, training.py:462-641)
+# ---------------------------------------------------------------------------
+
+
+class _LogState:
+    def __init__(self):
+        self.total_loss = 0.0
+        self.count = 0
+        self.skipped_total = 0
+        self.tokens = 0
+        self.t_start = time.perf_counter()
+
+    def reset_window(self):
+        self.total_loss = 0.0
+        self.count = 0
+        self.tokens = 0
+        self.t_start = time.perf_counter()
+
+
+def training_log(cfg: RuntimeConfig, log: _LogState, metrics: dict,
+                 iteration: int, consumed_samples: int, writer,
+                 timers: Timers) -> None:
+    loss = float(metrics["loss"])
+    log.total_loss += loss
+    log.count += 1
+    log.skipped_total += int(metrics["skipped"])
+
+    if (not cfg.train.log_interval
+            or iteration % cfg.train.log_interval != 0):
+        return
+    elapsed = time.perf_counter() - log.t_start
+    per_iter = elapsed / max(log.count, 1)
+    tokens_per_sec = log.tokens / elapsed if elapsed > 0 else 0.0
+    flops = model_lib.flops_per_token(cfg.model, cfg.train.seq_length)
+    tflops = tokens_per_sec * flops / 1e12
+
+    avg_loss = log.total_loss / max(log.count, 1)
+    lr = float(metrics["lr"])
+    grad_norm = float(metrics["grad_norm"])
+    loss_scale = float(metrics.get("loss_scale", 1.0))
+
+    line = (
+        f" iteration {iteration:8d}/{cfg.train.train_iters:8d} |"
+        f" consumed samples: {consumed_samples:12d} |"
+        f" elapsed time per iteration (ms): {per_iter * 1000.0:.1f} |"
+        f" tokens per second: {tokens_per_sec:.1f} |"
+        f" model TFLOPs: {tflops:.1f} |"
+        f" learning rate: {lr:.3E} |"
+        f" lm loss: {avg_loss:.6E} |"
+        f" loss scale: {loss_scale:.1f} |"
+        f" grad norm: {grad_norm:.3f} |"
+        f" number of skipped iterations: {log.skipped_total:3d} |"
+    )
+    print_rank_0(line)
+    if writer is not None:
+        writer.add_scalar("train/lm_loss", avg_loss, iteration)
+        writer.add_scalar("train/learning_rate", lr, iteration)
+        writer.add_scalar("train/grad_norm", grad_norm, iteration)
+        writer.add_scalar("train/loss_scale", loss_scale, iteration)
+        writer.add_scalar("train/tokens_per_sec", tokens_per_sec, iteration)
+        writer.add_scalar("train/consumed_samples", consumed_samples,
+                          iteration)
+        timers.write(writer, iteration, reset=False)
+    timers.log(normalizer=max(log.count, 1),
+               printer=print if jax.process_index() == 0 else None)
+    log.reset_window()
+
+
+# ---------------------------------------------------------------------------
+# The driver (reference pretrain + _train, training.py:55-169,654-770)
+# ---------------------------------------------------------------------------
+
+
+def _build_train_iterator(cfg: RuntimeConfig, dataset, consumed_samples: int,
+                          global_batch_size: int, shuffle: bool,
+                          eod_token=None) -> Iterator[dict]:
+    accum = global_batch_size // (
+        cfg.train.micro_batch_size * cfg.parallel.data_parallel)
+    it = BatchIterator(
+        dataset,
+        global_batch_size=global_batch_size,
+        grad_accum=accum,
+        seq_length=cfg.train.seq_length,
+        consumed_samples=consumed_samples,
+        shuffle=shuffle,
+        seed=cfg.train.seed,
+        eod_token=eod_token,
+    )
+    return iter(it)
+
+
+def pretrain(
+    cfg: RuntimeConfig,
+    train_dataset=None,
+    valid_dataset=None,
+    test_dataset=None,
+    params: Optional[PyTree] = None,
+    batch_provider: Optional[Callable[[int, int], Iterator[dict]]] = None,
+    shuffle: bool = True,
+    eod_token: Optional[int] = None,
+) -> TrainState:
+    """Train ``cfg.train.train_iters`` iterations; returns the final state.
+
+    ``batch_provider(consumed_samples, global_batch_size)`` overrides the
+    dataset-based iterator (the reference's ``train_valid_test_dataset
+    provider`` indirection, training.py:877-961).
+    """
+    cfg.validate()
+    t_start = time.time()
+    timers = Timers()
+    writer = NullWriter()
+    if jax.process_index() == 0:
+        writer = build_writer(cfg.train.tensorboard_dir,
+                              cfg.train.wandb_project, cfg.train.wandb_name,
+                              config=cfg.to_dict())
+
+    timers("setup", log_level=0).start()
+    art = setup_train_state(cfg, params=params)
+    state = art.state
+
+    # --- resume (reference load_checkpoint, checkpointing.py:562-678) ---
+    iteration = 0
+    consumed_samples = 0
+    if cfg.train.load:
+        try:
+            state, tag = checkpointing.load_checkpoint(cfg.train.load, state)
+            meta = checkpointing.load_meta(cfg.train.load)
+            if tag != checkpointing.RELEASE:
+                iteration = int(tag)
+                consumed_samples = int(meta.get("consumed_samples", 0))
+            print_rank_0(f" loaded checkpoint from {cfg.train.load} at "
+                         f"iteration {tag} "
+                         f"(consumed_samples={consumed_samples})")
+        except FileNotFoundError:
+            print_rank_0(f" no checkpoint under {cfg.train.load}; "
+                         "starting from scratch")
+    timers("setup").stop()
+
+    calculator = build_num_microbatches_calculator(
+        cfg.train.global_batch_size, cfg.train.micro_batch_size,
+        cfg.parallel.data_parallel, cfg.train.rampup_batch_size)
+    calculator.update(consumed_samples, False)
+
+    # --- data iterators ---
+    def make_train_iter(consumed, gbs):
+        if batch_provider is not None:
+            return batch_provider(consumed, gbs)
+        assert train_dataset is not None, "no training data"
+        return _build_train_iterator(cfg, train_dataset, consumed, gbs,
+                                     shuffle, eod_token)
+
+    current_gbs = calculator.get_current_global_batch_size()
+    train_iter = make_train_iter(consumed_samples, current_gbs)
+
+    eval_step = None
+    eval_flatten = True
+    eval_batch_sharding = None
+    if valid_dataset is not None or test_dataset is not None:
+        if cfg.parallel.pipeline_parallel > 1:
+            # pipelined eval: loss from the last stage only, no registry
+            # metrics; keeps the [accum, micro, ...] batch layout
+            eval_step = make_pipeline_eval_step(cfg, art.mesh)
+            eval_flatten = False
+            eval_batch_sharding = art.batch_sharding
+        else:
+            eval_batch_sharding = NamedSharding(art.mesh, P("dp", None))
+            eval_step = make_eval_step(cfg, tuple(cfg.train.metrics),
+                                       art.mesh, eval_batch_sharding,
+                                       art.param_specs)
+
+    base_rng = jax.random.key(cfg.train.seed)
+    log = _LogState()
+    skip_set = set(cfg.train.skip_iters)
+    exit_reason = None
+
+    print_rank_0(f" training starts at iteration {iteration} / "
+                 f"{cfg.train.train_iters}")
+    with DistSignalHandler() as sig, art.mesh:
+        while iteration < cfg.train.train_iters:
+            # fault injection: --skip_iters (training.py:397-399,422-426)
+            if (iteration + 1) in skip_set:
+                try:
+                    next(train_iter)
+                except StopIteration:
+                    train_iter = make_train_iter(consumed_samples, current_gbs)
+                    next(train_iter)
+                iteration += 1
+                consumed_samples += current_gbs
+                calculator.update(consumed_samples, True)
+                state = state._replace(
+                    iteration=state.iteration + jnp.int32(1))
+                print_rank_0(f" skipping iteration {iteration} (fault "
+                             "injection)")
+                continue
+
+            # batch-size ramp: rebuild the iterator (and step shapes) on rung
+            # changes (reference microbatch calculator update,
+            # training.py:420)
+            new_gbs = calculator.get_current_global_batch_size()
+            if new_gbs != current_gbs:
+                current_gbs = new_gbs
+                train_iter = make_train_iter(consumed_samples, current_gbs)
+                print_rank_0(f" global batch size ramped to {current_gbs}")
+
+            timers("batch-generator", log_level=1).start()
+            try:
+                batch = next(train_iter)
+            except StopIteration:
+                train_iter = make_train_iter(consumed_samples, current_gbs)
+                batch = next(train_iter)
+            dev_batch = _put_batch(batch, art.batch_sharding)
+            timers("batch-generator").stop()
+
+            timers("train-step", log_level=0).start()
+            state, step_metrics = art.step_fn(state, dev_batch, base_rng)
+            step_metrics = jax.device_get(step_metrics)
+            timers("train-step").stop(wait_for=step_metrics)
+
+            iteration += 1
+            consumed_samples += current_gbs
+            calculator.update(consumed_samples, True)
+            log.tokens += current_gbs * cfg.train.seq_length
+            training_log(cfg, log, step_metrics, iteration, consumed_samples,
+                         writer, timers)
+
+            # --- eval hook ---
+            if (valid_dataset is not None and eval_step is not None
+                    and cfg.train.eval_interval
+                    and iteration % cfg.train.eval_interval == 0):
+                timers("eval", log_level=0).start()
+                valid_iter = _build_train_iterator(
+                    cfg, valid_dataset, 0, current_gbs, False, eod_token)
+                params_for_eval = state.params
+                evaluate_and_print_results(
+                    f"iteration {iteration}", cfg, params_for_eval,
+                    valid_iter, eval_step, writer, iteration,
+                    eval_batch_sharding, flatten=eval_flatten)
+                timers("eval").stop()
+
+            # --- save hook ---
+            if (cfg.train.save and cfg.train.save_interval
+                    and iteration % cfg.train.save_interval == 0):
+                _save(cfg, state, iteration, consumed_samples, timers)
+
+            # --- exit conditions (training.py:731-767) ---
+            # Multi-host signal consensus is a collective; polling it every
+            # iteration would host-sync each step, so multi-host runs check
+            # on the log cadence (every process evaluates the same
+            # iteration condition, keeping the collective aligned).
+            check_signal = (
+                jax.process_count() == 1
+                or not cfg.train.log_interval
+                or iteration % cfg.train.log_interval == 0)
+            if check_signal and sig.signals_received():
+                exit_reason = "signal"
+            elif (cfg.train.exit_interval
+                    and iteration % cfg.train.exit_interval == 0):
+                exit_reason = "exit_interval"
+            elif cfg.train.exit_duration_mins is not None:
+                mins = (time.time() - t_start) / 60.0
+                if mins > cfg.train.exit_duration_mins:
+                    exit_reason = "exit_duration"
+            if exit_reason:
+                break
+
+    if exit_reason:
+        print_rank_0(f" exiting at iteration {iteration}: {exit_reason}")
+        if cfg.train.save:
+            _save(cfg, state, iteration, consumed_samples, timers)
+        if exit_reason == "signal":
+            writer.flush()
+            sys.exit(0)
+    elif cfg.train.save:
+        _save(cfg, state, iteration, consumed_samples, timers)
+
+    # final validation + test (reference pretrain tail, training.py:144-169)
+    if valid_dataset is not None and eval_step is not None:
+        valid_iter = _build_train_iterator(
+            cfg, valid_dataset, 0, current_gbs, False, eod_token)
+        evaluate_and_print_results(
+            "the end of training for val data", cfg, state.params,
+            valid_iter, eval_step, writer, iteration, eval_batch_sharding,
+            flatten=eval_flatten)
+    if test_dataset is not None and eval_step is not None:
+        test_iter = _build_train_iterator(
+            cfg, test_dataset, 0, current_gbs, False, eod_token)
+        evaluate_and_print_results(
+            "the end of training for test data", cfg, state.params,
+            test_iter, eval_step, writer, iteration, eval_batch_sharding,
+            flatten=eval_flatten)
+
+    writer.flush()
+    elapsed = datetime.timedelta(seconds=int(time.time() - t_start))
+    print_rank_0(f" training finished in {elapsed} at iteration {iteration}")
+    return state
+
+
+def _save(cfg: RuntimeConfig, state, iteration: int, consumed_samples: int,
+          timers: Timers) -> None:
+    timers("save-checkpoint", log_level=0).start()
+    path = checkpointing.save_checkpoint(
+        cfg.train.save, state, cfg, iteration,
+        meta={"consumed_samples": consumed_samples})
+    timers("save-checkpoint").stop()
+    print_rank_0(f" saved checkpoint to {path}")

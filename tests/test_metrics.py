@@ -1,0 +1,64 @@
+"""Metrics-registry math (reference megatron/metrics.py:62-110)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.metrics import (
+    METRICS,
+    compute_metrics,
+    validate_metric_names,
+)
+
+
+def _batch_and_logits():
+    # vocab 4, batch 1, seq 4; labels chosen so positions 0,1 are correct
+    logits = jnp.asarray([[
+        [5.0, 0, 0, 0],
+        [0, 5.0, 0, 0],
+        [0, 0, 5.0, 0],
+        [0, 0, 0, 5.0],
+    ]])
+    labels = jnp.asarray([[0, 1, 3, 0]])  # correct, correct, wrong, wrong
+    loss_mask = jnp.asarray([[1.0, 1.0, 1.0, 0.0]])  # last position masked
+    per_token = -jnp.log(jnp.take_along_axis(
+        jnp.exp(logits) / jnp.sum(jnp.exp(logits), -1, keepdims=True),
+        labels[..., None], axis=-1))[..., 0]
+    batch = {"tokens": labels, "labels": labels, "loss_mask": loss_mask}
+    return batch, logits, per_token
+
+
+def test_registry_names():
+    assert set(METRICS) == {
+        "perplexity", "accuracy", "instruct_accuracy",
+        "count_loss_mask", "count_instruct_mask",
+    }
+    validate_metric_names(["perplexity", "accuracy"])
+    with pytest.raises(ValueError):
+        validate_metric_names(["nope"])
+
+
+def test_accuracy_and_counts():
+    batch, logits, per_token = _batch_and_logits()
+    out = compute_metrics(
+        ["accuracy", "count_loss_mask", "perplexity"], batch, logits,
+        per_token)
+    # 3 unmasked positions, 2 correct
+    np.testing.assert_allclose(float(out["accuracy"]), 2.0 / 3.0, rtol=1e-6)
+    assert float(out["count_loss_mask"]) == 3.0
+    expected_ppl = np.exp(float(jnp.sum(per_token * batch["loss_mask"]) / 3.0))
+    np.testing.assert_allclose(float(out["perplexity"]), expected_ppl,
+                               rtol=1e-5)
+
+
+def test_instruct_masks():
+    batch, logits, per_token = _batch_and_logits()
+    # scalar-weighted loss mask: weight-1 tokens are assistant tokens
+    batch["loss_mask"] = jnp.asarray([[1.0, 0.1, 1.0, 0.0]])
+    out = compute_metrics(
+        ["instruct_accuracy", "count_instruct_mask"], batch, logits,
+        per_token)
+    # assistant tokens = positions 0, 2 → correct at 0 only
+    assert float(out["count_instruct_mask"]) == 2.0
+    np.testing.assert_allclose(float(out["instruct_accuracy"]), 0.5,
+                               rtol=1e-6)

@@ -1,0 +1,66 @@
+"""Microbatch-calculator semantics (reference megatron/microbatches.py)."""
+
+import pytest
+
+from megatron_llm_tpu.training.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+
+
+def test_constant():
+    c = ConstantNumMicroBatches(
+        global_batch_size=64, micro_batch_size=4, data_parallel_size=2)
+    assert c.get() == 8
+    assert c.get_current_global_batch_size() == 64
+    c.update(10_000, True)  # no-op
+    assert c.get() == 8
+
+
+def test_constant_divisibility_enforced():
+    with pytest.raises(AssertionError):
+        ConstantNumMicroBatches(65, 4, 2)
+
+
+def test_rampup_schedule():
+    # start 8, +8 per rung, over 64 samples, target 32: rungs at 8,16,24,32
+    c = RampupBatchsizeNumMicroBatches(
+        start_batch_size=8, batch_size_increment=8, ramup_samples=64,
+        global_batch_size=32, micro_batch_size=4, data_parallel_size=1)
+    assert c.get_current_global_batch_size() == 8
+    assert c.get() == 2
+    # 3 increments over 64 samples → one rung every 64/3 samples
+    c.update(22, True)
+    assert c.get_current_global_batch_size() == 16
+    c.update(43, True)
+    assert c.get_current_global_batch_size() == 24
+    c.update(64, True)
+    assert c.get_current_global_batch_size() == 32
+    c.update(1_000_000, True)
+    assert c.get_current_global_batch_size() == 32
+    assert c.get() == 8
+
+
+def test_rampup_resume_midway():
+    """Resume from consumed_samples lands on the correct rung."""
+    c = build_num_microbatches_calculator(
+        32, 4, 1, rampup_batch_size=[8, 8, 64])
+    c.update(30, True)
+    assert c.get_current_global_batch_size() == 16
+
+
+def test_rampup_degenerate():
+    """start == target and zero ramp samples must not divide by zero."""
+    c = build_num_microbatches_calculator(8, 4, 1, [8, 8, 64])
+    assert c.get_current_global_batch_size() == 8
+    c2 = build_num_microbatches_calculator(32, 4, 1, [8, 8, 0])
+    c2.update(0, True)
+    assert c2.get_current_global_batch_size() == 32
+
+
+def test_builder_dispatch():
+    c = build_num_microbatches_calculator(16, 2, 2)
+    assert isinstance(c, ConstantNumMicroBatches)
+    r = build_num_microbatches_calculator(16, 2, 2, [4, 4, 100])
+    assert isinstance(r, RampupBatchsizeNumMicroBatches)
