@@ -169,3 +169,32 @@ def load_release_params(root: str, template: Any) -> Any:
     abstract = jax.tree.map(_as_abstract, template)
     with ocp.StandardCheckpointer() as ckptr:
         return ckptr.restore((path / "params").absolute(), abstract)
+
+
+def load_params_for_inference(root: str, model_cfg: Any,
+                              iteration: Optional[int | str] = None) -> Any:
+    """Load just the parameter tree for serving/eval: handles both 'release'
+    (params-only, conversion output) and full training checkpoints.
+
+    The parameter template comes from ``jax.eval_shape`` over the model init
+    — no throwaway materialization."""
+    from .models import model as model_lib
+
+    template = jax.eval_shape(
+        lambda: model_lib.init_params(jax.random.key(0), model_cfg))
+    if iteration is None:
+        iteration = read_tracker(root)
+        if iteration is None:
+            raise FileNotFoundError(f"no {TRACKER_FILENAME} under {root}")
+    if iteration == RELEASE:
+        return load_release_params(root, template)
+    path = checkpoint_dir(root, iteration)
+    # Partial restore of just the params subtree — optimizer state (fp32
+    # master weights + Adam moments, ~4-5× the param bytes) is never read.
+    abstract = jax.tree.map(_as_abstract, template)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(
+            (path / "state").absolute(),
+            args=ocp.args.PyTreeRestore(item={"params": abstract},
+                                        partial_restore=True))
+    return restored["params"]

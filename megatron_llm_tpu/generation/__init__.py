@@ -1,0 +1,48 @@
+"""Inference / text-generation layer (L6).
+
+TPU-native equivalent of megatron/text_generation/ + the REST server:
+KV-cached incremental decoding under one jit (no per-token host sync),
+top-k/top-p/temperature sampling, greedy scoring, beam search, and a
+stdlib-HTTP serving front-end.
+"""
+
+from .api import (
+    GenerationResult,
+    beam_search_and_post_process,
+    detokenize_generations,
+    generate_and_post_process,
+    score_and_post_process,
+    tokenize_prompts,
+)
+from .generation import (
+    BeamOutput,
+    GenerateOutput,
+    beam_search,
+    generate_tokens,
+    score_tokens,
+)
+from .sampling import (
+    modify_logits_for_top_k_filtering,
+    modify_logits_for_top_p_filtering,
+    sample,
+)
+from .server import GenerationService, MegatronServer
+
+__all__ = [
+    "BeamOutput",
+    "GenerateOutput",
+    "GenerationResult",
+    "GenerationService",
+    "MegatronServer",
+    "beam_search",
+    "beam_search_and_post_process",
+    "detokenize_generations",
+    "generate_and_post_process",
+    "generate_tokens",
+    "modify_logits_for_top_k_filtering",
+    "modify_logits_for_top_p_filtering",
+    "sample",
+    "score_and_post_process",
+    "score_tokens",
+    "tokenize_prompts",
+]

@@ -1,0 +1,191 @@
+"""High-level generation API: text in → text out.
+
+Parity with megatron/text_generation/api.py (generate_and_post_process :19,
+beam_search_and_post_process :147) and tokenization.py (tokenize_prompts :47,
+detokenize_generations :16).  The reference's rank-0 → world broadcast
+choreography (broadcast_float_list control channel) disappears: everything
+runs inside one SPMD program, so parameters reach every chip through jit —
+there is no separate controller process to synchronize with.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..tokenizer.tokenizer import Tokenizer
+from .generation import beam_search, generate_tokens, score_tokens
+
+
+def tokenize_prompts(
+    tokenizer: Tokenizer,
+    prompts: Sequence[str],
+    tokens_to_generate: int,
+    add_bos: bool = False,
+    max_position_embeddings: Optional[int] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Tokenize + right-pad prompts, reserving generation room.
+
+    Returns (tokens [b, max_prompt_len + tokens_to_generate], lengths [b]).
+    Parity: _tokenize_prompts_and_batch
+    (megatron/text_generation/tokenization.py:83-124).
+    """
+    ids = []
+    for p in prompts:
+        t = tokenizer.tokenize(p)
+        if add_bos and tokenizer.bos is not None:
+            t = [tokenizer.bos] + t
+        ids.append(t)
+    lengths = np.array([len(t) for t in ids], np.int32)
+    if tokens_to_generate > 0 and np.any(lengths == 0):
+        # e.g. empty prompt + a tokenizer with no BOS token: there is no
+        # position to condition generation on.
+        raise ValueError("a prompt tokenized to zero tokens (empty prompt "
+                         "with a BOS-less tokenizer?)")
+    max_len = int(lengths.max()) + tokens_to_generate
+    if max_position_embeddings is not None:
+        if max_len > max_position_embeddings:
+            raise ValueError(
+                f"prompt + tokens_to_generate = {max_len} exceeds "
+                f"max_position_embeddings = {max_position_embeddings}")
+    pad = tokenizer.pad
+    tokens = np.full((len(ids), max_len), pad, np.int32)
+    for i, t in enumerate(ids):
+        tokens[i, :len(t)] = t
+    return tokens, lengths
+
+
+def detokenize_generations(
+    tokenizer: Tokenizer,
+    tokens: np.ndarray,  # [b, s]
+    lengths: np.ndarray,  # [b]
+    return_segments: bool = False,
+):
+    """Trim to per-sample length and detokenize; optionally per-token pieces
+    (reference: tokenization.py:16-44)."""
+    texts, segments, all_ids = [], [], []
+    for row, n in zip(np.asarray(tokens), np.asarray(lengths)):
+        ids = [int(t) for t in row[:int(n)]]
+        all_ids.append(ids)
+        texts.append(tokenizer.detokenize(ids))
+        if return_segments:
+            segments.append([tokenizer.detokenize([t]) for t in ids])
+    if return_segments:
+        return texts, segments, all_ids
+    return texts, all_ids
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationResult:
+    texts: list[str]
+    tokens: list[list[int]]
+    segments: Optional[list[list[str]]] = None
+    logprobs: Optional[list[list[float]]] = None
+    scores: Optional[list[float]] = None  # beam search only
+
+
+def generate_and_post_process(
+    cfg: ModelConfig,
+    params,
+    tokenizer: Tokenizer,
+    prompts: Sequence[str],
+    *,
+    tokens_to_generate: int = 64,
+    return_output_log_probs: bool = False,
+    return_segments: bool = False,
+    top_k_sampling: int = 0,
+    top_p_sampling: float = 0.0,
+    temperature: float = 1.0,
+    add_BOS: bool = False,
+    use_eod_token_for_early_termination: bool = True,
+    random_seed: int = -1,
+) -> GenerationResult:
+    """Run generation on text prompts and detokenize
+    (reference: api.py:19-67 / generate :70-144)."""
+    import jax
+
+    tokens, lengths = tokenize_prompts(
+        tokenizer, prompts, tokens_to_generate, add_BOS,
+        cfg.max_position_embeddings)
+    if random_seed < 0:
+        # Unseeded requests must vary between calls (the reference only
+        # calls manual_seed when random_seed != -1, api.py:59-61).
+        random_seed = int.from_bytes(os.urandom(4), "little")
+    rng = jax.random.key(random_seed)
+    out = generate_tokens(
+        cfg, params, jnp.asarray(tokens), jnp.asarray(lengths),
+        eos_id=tokenizer.eod,
+        top_k=top_k_sampling, top_p=top_p_sampling, temperature=temperature,
+        rng=rng, return_logprobs=return_output_log_probs,
+        use_eos_stop=use_eod_token_for_early_termination)
+    toks = np.asarray(out.tokens)
+    lens = np.asarray(out.lengths)
+    if return_segments:
+        texts, segments, ids = detokenize_generations(
+            tokenizer, toks, lens, True)
+    else:
+        texts, ids = detokenize_generations(tokenizer, toks, lens)
+        segments = None
+    logprobs = None
+    if return_output_log_probs:
+        lp = np.asarray(out.logprobs)
+        logprobs = [lp[i, :max(int(n) - 1, 0)].tolist()
+                    for i, n in enumerate(lens)]
+    return GenerationResult(texts=texts, tokens=ids, segments=segments,
+                            logprobs=logprobs)
+
+
+def beam_search_and_post_process(
+    cfg: ModelConfig,
+    params,
+    tokenizer: Tokenizer,
+    prompt: str,
+    *,
+    tokens_to_generate: int = 64,
+    beam_size: int = 4,
+    stop_token: Optional[int] = None,
+    num_return_gen: int = 1,
+    length_penalty: float = 1.0,
+    add_BOS: bool = False,
+    return_segments: bool = False,
+) -> GenerationResult:
+    """Beam-search a single prompt (reference: api.py:147-186)."""
+    tokens, lengths = tokenize_prompts(
+        tokenizer, [prompt], tokens_to_generate, add_BOS,
+        cfg.max_position_embeddings)
+    out = beam_search(
+        cfg, params, tokens[0], int(lengths[0]),
+        beam_size=beam_size,
+        stop_token=stop_token if stop_token is not None else tokenizer.eod,
+        num_return_gen=num_return_gen, length_penalty=length_penalty)
+    toks = np.asarray(out.tokens)
+    lens = np.asarray(out.lengths)
+    if return_segments:
+        texts, segments, ids = detokenize_generations(
+            tokenizer, toks, lens, True)
+    else:
+        texts, ids = detokenize_generations(tokenizer, toks, lens)
+        segments = None
+    return GenerationResult(texts=texts, tokens=ids, segments=segments,
+                            scores=np.asarray(out.scores).tolist())
+
+
+def score_and_post_process(
+    cfg: ModelConfig,
+    params,
+    tokenizer: Tokenizer,
+    prompts: Sequence[str],
+) -> GenerationResult:
+    """Log-prob scoring of full prompts, no generation
+    (reference: tokens_to_generate=0 path, api.py:108-117)."""
+    tokens, lengths = tokenize_prompts(tokenizer, prompts, 0)
+    lp = np.asarray(score_tokens(cfg, params, jnp.asarray(tokens)))
+    texts, ids = detokenize_generations(tokenizer, tokens, lengths)
+    logprobs = [lp[i, :max(int(n) - 1, 0)].tolist()
+                for i, n in enumerate(lengths)]
+    return GenerationResult(texts=texts, tokens=ids, logprobs=logprobs)

@@ -1,0 +1,318 @@
+"""Autoregressive generation: greedy/sampling loop, scoring, beam search.
+
+TPU-native rework of megatron/text_generation/generation.py:
+- ``generate_tokens`` ≙ generate_tokens_probs_and_return_on_first_stage
+  (:89-285): ragged right-padded prompts, per-sample start at its prompt
+  length, EOS early-exit, optional per-token log-probs.
+- ``score_tokens`` ≙ score_and_return_on_first_stage (:20-86).
+- ``beam_search`` ≙ beam_search_and_return_on_first_stage (:288-414) with
+  HF-style ``BeamHypotheses`` scoring (sum-logprob / len**length_penalty).
+
+The whole token loop is a single ``lax.while_loop`` inside one ``jax.jit`` —
+no host round-trip per token (the reference pays a device sync + pipeline
+broadcast every token).  The KV cache lives in the loop carry; pipeline
+communication is unnecessary because the model is jitted over the whole mesh
+(GSPMD moves activations between stages).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..models import model as model_lib
+from .sampling import NEG_INF, sample_with_mode
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerateOutput:
+    tokens: jax.Array  # [b, max_seq] int32 — prompts + generations
+    lengths: jax.Array  # [b] int32 — total sequence length incl. prompt
+    logprobs: Optional[jax.Array]  # [b, max_seq-1] fp32 or None
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "min_prompt_len", "eos_id", "top_k",
+                     "sample_mode", "return_logprobs", "use_eos_stop"),
+)
+def _generate_impl(cfg: ModelConfig, params, tokens, lengths, rng,
+                   temperature, top_p,
+                   *, min_prompt_len: int, eos_id: int,
+                   top_k: int, sample_mode: str,
+                   return_logprobs: bool, use_eos_stop: bool):
+    b, max_seq = tokens.shape
+    vocab = cfg.vocab_size
+    rope = model_lib.rope_tables(cfg)
+    k_cache, v_cache = model_lib.init_kv_cache(cfg, b, max_seq)
+
+    # Prefill the common prompt prefix [0, min_prompt_len).
+    logits, k_cache, v_cache = model_lib.forward_cached(
+        cfg, params, tokens[:, :min_prompt_len], k_cache, v_cache,
+        jnp.int32(0), rope=rope)
+    last_logits = logits[:, -1]
+
+    logprob_buf = jnp.zeros((b, max_seq - 1), jnp.float32)
+    if return_logprobs:
+        # log-probs of the prompt tokens themselves (positions 1..min_len-1),
+        # matching the reference's full output_log_probs (:206-212).
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            lp[:, :-1], tokens[:, 1:min_prompt_len, None], axis=-1)[..., 0]
+        logprob_buf = jax.lax.dynamic_update_slice(
+            logprob_buf, picked, (0, 0))
+
+    done = jnp.zeros((b,), jnp.bool_)
+    out_lengths = jnp.full((b,), min_prompt_len, jnp.int32)
+
+    def cond(carry):
+        cur, _, _, _, _, done, _, _ = carry
+        return (cur < max_seq) & ~jnp.all(done)
+
+    def body(carry):
+        cur, tokens, k_cache, v_cache, last_logits, done, out_lengths, lp_buf \
+            = carry
+        step_rng = jax.random.fold_in(rng, cur)
+        sampled = sample_with_mode(
+            last_logits, step_rng, mode=sample_mode, top_k=top_k,
+            top_p=top_p, temperature=temperature, vocab_size=vocab)
+        started = lengths <= cur  # prompt exhausted at this position
+        prompt_tok = jax.lax.dynamic_slice(tokens, (0, cur), (b, 1))[:, 0]
+        write = started & ~done
+        tok_cur = jnp.where(write, sampled, prompt_tok)
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, tok_cur[:, None], (0, cur))
+
+        if return_logprobs:
+            lp = jax.nn.log_softmax(last_logits, axis=-1)
+            picked = jnp.take_along_axis(lp, tok_cur[:, None], axis=-1)
+            lp_buf = jax.lax.dynamic_update_slice(
+                lp_buf, picked, (0, cur - 1))
+
+        if use_eos_stop:
+            just_done = write & (tok_cur == eos_id)
+        else:
+            just_done = jnp.zeros_like(done)
+        out_lengths = jnp.where(~done, cur + 1, out_lengths)
+        done = done | just_done
+
+        logits, k_cache, v_cache = model_lib.forward_cached(
+            cfg, params, tok_cur[:, None], k_cache, v_cache, cur, rope=rope)
+        return (cur + 1, tokens, k_cache, v_cache, logits[:, 0], done,
+                out_lengths, lp_buf)
+
+    carry = (jnp.int32(min_prompt_len), tokens, k_cache, v_cache,
+             last_logits, done, out_lengths, logprob_buf)
+    carry = jax.lax.while_loop(cond, body, carry)
+    _, tokens, _, _, _, _, out_lengths, logprob_buf = carry
+    return tokens, out_lengths, logprob_buf
+
+
+def generate_tokens(
+    cfg: ModelConfig,
+    params,
+    tokens: jax.Array,  # [b, max_seq] right-padded prompts + generation room
+    lengths: jax.Array,  # [b] prompt lengths
+    *,
+    eos_id: int = 2,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    temperature: float = 1.0,
+    rng: Optional[jax.Array] = None,
+    return_logprobs: bool = False,
+    use_eos_stop: bool = True,
+) -> GenerateOutput:
+    """Generate until EOS or the buffer fills.  See module docstring."""
+    if rng is None:
+        rng = jax.random.key(0)
+    min_prompt_len = int(jnp.min(lengths))
+    if min_prompt_len >= tokens.shape[1]:
+        raise ValueError("context length + tokens_to_generate too large "
+                         "(reference: generation.py:118-121)")
+    assert not (top_k > 0 and top_p > 0.0), \
+        "cannot have both greedy-limiting top-k and top-p"
+    if top_k == 0 and top_p == 0.0:
+        sample_mode = "greedy"
+    elif top_k > 0:
+        sample_mode = "top_k"
+    else:
+        sample_mode = "top_p"
+    toks, lens, lps = _generate_impl(
+        cfg, params, jnp.asarray(tokens, jnp.int32),
+        jnp.asarray(lengths, jnp.int32), rng,
+        jnp.float32(temperature), jnp.float32(top_p),
+        min_prompt_len=min_prompt_len, eos_id=eos_id, top_k=top_k,
+        sample_mode=sample_mode,
+        return_logprobs=return_logprobs, use_eos_stop=use_eos_stop)
+    return GenerateOutput(tokens=toks, lengths=lens,
+                          logprobs=lps if return_logprobs else None)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def score_tokens(cfg: ModelConfig, params, tokens: jax.Array) -> jax.Array:
+    """Per-token log-probs of a given sequence [b, s] → [b, s-1]
+    (reference: score_and_return_on_first_stage, generation.py:20-86)."""
+    logits = model_lib.forward(cfg, params, tokens)
+    lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    return jnp.take_along_axis(lp, tokens[:, 1:, None], axis=-1)[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Beam search
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BeamOutput:
+    tokens: jax.Array  # [num_return, max_seq]
+    scores: jax.Array  # [num_return] — sum-logprob / len**length_penalty
+    lengths: jax.Array  # [num_return]
+
+
+def _gather_beams(tree, idx):
+    return jax.tree.map(lambda x: jnp.take(x, idx, axis=0), tree)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "prompt_len", "beam_size", "stop_token",
+                     "length_penalty"),
+)
+def _beam_search_impl(cfg: ModelConfig, params, prompt,  # [prompt_len]
+                      *, prompt_len: int, beam_size: int, stop_token: int,
+                      length_penalty: float):
+    max_seq = prompt.shape[0]
+    k = beam_size
+    rope = model_lib.rope_tables(cfg)
+
+    tokens = jnp.broadcast_to(prompt[None, :], (k, max_seq)).astype(jnp.int32)
+    k_cache, v_cache = model_lib.init_kv_cache(cfg, k, max_seq)
+    logits, k_cache, v_cache = model_lib.forward_cached(
+        cfg, params, tokens[:, :prompt_len], k_cache, v_cache, jnp.int32(0),
+        rope=rope)
+    last_logits = logits[:, -1]
+
+    # Alive beams: running sum of log-probs.  At the first expansion only
+    # beam 0's candidates are valid (all beams are identical copies of the
+    # prompt — reference sorts new_scores[0, :] there, generation.py:337-340).
+    alive_scores = jnp.zeros((k,), jnp.float32)
+    fin_tokens = jnp.zeros((k, max_seq), jnp.int32)
+    fin_scores = jnp.full((k,), NEG_INF, jnp.float32)
+    fin_lengths = jnp.zeros((k,), jnp.int32)
+
+    vocab = cfg.vocab_size
+    pad_vocab = last_logits.shape[-1]
+    pad_mask = (jnp.arange(pad_vocab) >= vocab)[None, :]
+
+    def cond(carry):
+        cur, _, _, _, _, alive_scores, _, fin_scores, _ = carry
+        # BeamHypotheses.is_done: the best still-possible alive score cannot
+        # beat the worst finished hypothesis once k are finished.
+        best_possible = jnp.max(alive_scores) / jnp.maximum(
+            (cur + 1 - prompt_len), 1) ** length_penalty
+        have_k = jnp.sum(fin_scores > NEG_INF / 2) >= k
+        done = have_k & (jnp.min(fin_scores) >= best_possible)
+        return (cur < max_seq) & ~done
+
+    def body(carry):
+        (cur, tokens, k_cache, v_cache, last_logits, alive_scores,
+         fin_tokens, fin_scores, fin_lengths) = carry
+        lp = jax.nn.log_softmax(
+            jnp.where(pad_mask, NEG_INF, last_logits), axis=-1)
+        cand = lp + alive_scores[:, None]  # [k, vocab]
+        first = cur == prompt_len
+        # Invalidate all but beam 0 on the first expansion.
+        beam_valid = jnp.where(
+            first, jnp.arange(k) == 0, jnp.ones((k,), jnp.bool_))
+        cand = jnp.where(beam_valid[:, None], cand, NEG_INF)
+        top_scores, top_idx = jax.lax.top_k(cand.reshape(-1), 2 * k)
+        beam_ids = top_idx // pad_vocab
+        words = top_idx % pad_vocab
+        is_stop = words == stop_token
+
+        # Finished candidates: stop-token hits within the top-k ranks
+        # (reference drops stop hits ranked ≥ beam_size, generation.py:350-353)
+        gen_len = cur + 1 - prompt_len
+        hyp_scores = top_scores / jnp.maximum(gen_len, 1) ** length_penalty
+        new_fin_valid = is_stop & (jnp.arange(2 * k) < k)
+        cand_fin_scores = jnp.where(new_fin_valid, hyp_scores, NEG_INF)
+        cand_fin_tokens = jnp.take(tokens, beam_ids, axis=0)
+        # Hypothesis recorded WITHOUT the stop token (reference adds
+        # tokens[beam_id] before writing the new word, :354-359).
+        merged_scores = jnp.concatenate([fin_scores, cand_fin_scores])
+        merged_tokens = jnp.concatenate([fin_tokens, cand_fin_tokens])
+        merged_lengths = jnp.concatenate(
+            [fin_lengths, jnp.full((2 * k,), cur, jnp.int32)])
+        keep = jax.lax.top_k(merged_scores, k)[1]
+        fin_scores = jnp.take(merged_scores, keep)
+        fin_tokens = jnp.take(merged_tokens, keep, axis=0)
+        fin_lengths = jnp.take(merged_lengths, keep)
+
+        # Alive continuation: best k non-stop candidates.
+        alive_rank = jnp.where(is_stop, NEG_INF, top_scores)
+        alive_pick = jax.lax.top_k(alive_rank, k)[1]
+        alive_scores = jnp.take(alive_rank, alive_pick)
+        alive_beam_ids = jnp.take(beam_ids, alive_pick)
+        alive_words = jnp.take(words, alive_pick)
+        tokens = jnp.take(tokens, alive_beam_ids, axis=0)
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, alive_words[:, None].astype(jnp.int32), (0, cur))
+        # Reorder the KV cache to follow the surviving beams (reference:
+        # swap_key_value_dict, forward_step.py/generation.py:383-386).
+        k_cache = jnp.take(k_cache, alive_beam_ids, axis=1)
+        v_cache = jnp.take(v_cache, alive_beam_ids, axis=1)
+
+        logits, k_cache, v_cache = model_lib.forward_cached(
+            cfg, params, alive_words[:, None].astype(jnp.int32),
+            k_cache, v_cache, cur, rope=rope)
+        return (cur + 1, tokens, k_cache, v_cache, logits[:, 0],
+                alive_scores, fin_tokens, fin_scores, fin_lengths)
+
+    carry = (jnp.int32(prompt_len), tokens, k_cache, v_cache, last_logits,
+             alive_scores, fin_tokens, fin_scores, fin_lengths)
+    (cur, tokens, _, _, _, alive_scores, fin_tokens, fin_scores,
+     fin_lengths) = jax.lax.while_loop(cond, body, carry)
+
+    # Open (unfinished) beams join the pool when the buffer filled without k
+    # stop tokens (reference: generation.py:391-396).
+    open_scores = alive_scores / jnp.maximum(cur - prompt_len, 1) \
+        ** length_penalty
+    merged_scores = jnp.concatenate([fin_scores, open_scores])
+    merged_tokens = jnp.concatenate([fin_tokens, tokens])
+    merged_lengths = jnp.concatenate(
+        [fin_lengths, jnp.full((k,), cur, jnp.int32)])
+    keep = jax.lax.top_k(merged_scores, k)[1]
+    return (jnp.take(merged_tokens, keep, axis=0),
+            jnp.take(merged_scores, keep),
+            jnp.take(merged_lengths, keep))
+
+
+def beam_search(
+    cfg: ModelConfig,
+    params,
+    tokens: jax.Array,  # [max_seq] or [1, max_seq] prompt + generation room
+    prompt_len: int,
+    *,
+    beam_size: int,
+    stop_token: int = 2,
+    num_return_gen: int = 1,
+    length_penalty: float = 1.0,
+) -> BeamOutput:
+    """Beam-search decode of a single prompt.  See module docstring."""
+    tokens = jnp.asarray(tokens, jnp.int32)
+    if tokens.ndim == 2:
+        assert tokens.shape[0] == 1, "beam search is single-prompt (ref :293)"
+        tokens = tokens[0]
+    if prompt_len >= tokens.shape[0]:
+        raise ValueError("context length + tokens_to_generate too large")
+    toks, scores, lens = _beam_search_impl(
+        cfg, params, tokens, prompt_len=int(prompt_len),
+        beam_size=int(beam_size), stop_token=int(stop_token),
+        length_penalty=float(length_penalty))
+    n = min(num_return_gen, beam_size)
+    return BeamOutput(tokens=toks[:n], scores=scores[:n], lengths=lens[:n])

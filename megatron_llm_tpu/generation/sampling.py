@@ -1,0 +1,107 @@
+"""Token sampling: greedy, temperature, top-k, top-p (nucleus).
+
+Parity with the reference's sampling module
+(megatron/text_generation/sampling.py:1-93): ``modify_logits_for_top_k_
+filtering`` / ``modify_logits_for_top_p_filtering`` semantics, the
+``top_k > 0 xor top_p > 0`` contract, vocabulary clamping of padded logits,
+and greedy when both are 0 with temperature ignored.  Implemented as pure
+jittable functions over [batch, vocab] logits.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1.0e10
+
+
+def modify_logits_for_top_k_filtering(logits: jax.Array,
+                                      top_k: int) -> jax.Array:
+    """Mask everything below the k-th largest logit to -inf
+    (reference: sampling.py:10-16)."""
+    if top_k <= 0:
+        return logits
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def _top_p_filter(logits: jax.Array, top_p) -> jax.Array:
+    """Nucleus filter core — ``top_p`` may be a traced scalar (no Python
+    guards), so serving can vary it per request without recompiling."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # shift right: always keep the argmax token
+    remove_sorted = (cum - sorted_probs) > top_p
+    # threshold logit = smallest kept logit in sorted order
+    kept = jnp.where(remove_sorted, jnp.inf, sorted_logits)
+    threshold = jnp.min(kept, axis=-1, keepdims=True)
+    return jnp.where(logits < threshold, NEG_INF, logits)
+
+
+def modify_logits_for_top_p_filtering(logits: jax.Array,
+                                      top_p: float) -> jax.Array:
+    """Nucleus filtering: drop tokens outside the smallest set whose
+    cumulative probability exceeds ``top_p`` (reference: sampling.py:19-37).
+
+    Matches the reference convention: the cumulative sum is shifted right so
+    the first token above the threshold is kept.
+    """
+    if top_p <= 0.0 or top_p >= 1.0:
+        return logits
+    return _top_p_filter(logits, top_p)
+
+
+def sample(
+    logits: jax.Array,  # [batch, vocab] fp32
+    rng: jax.Array | None = None,
+    *,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    temperature: float = 1.0,
+    vocab_size: int | None = None,
+) -> jax.Array:
+    """Sample one token id per row (reference: sampling.py:45-93).
+
+    ``vocab_size`` masks padded-vocab logits so padding tokens can never be
+    sampled (the reference clamps samples instead, :88-90 — masking is
+    equivalent and differentiable-friendly).  ``top_k==0 and top_p==0`` →
+    greedy argmax, temperature ignored (:63-65).
+    """
+    assert not (top_k > 0 and top_p > 0.0), \
+        "cannot have both greedy-limiting top-k and top-p (reference :57)"
+    if top_k == 0 and top_p == 0.0:
+        mode = "greedy"
+    elif top_k > 0:
+        mode = "top_k"
+    else:
+        mode = "top_p"
+    return sample_with_mode(logits, rng, mode=mode, top_k=top_k, top_p=top_p,
+                            temperature=temperature, vocab_size=vocab_size)
+
+
+def sample_with_mode(
+    logits: jax.Array,
+    rng: jax.Array | None,
+    *,
+    mode: str,  # "greedy" | "top_k" | "top_p"  (static)
+    top_k: int = 0,  # static (shapes lax.top_k)
+    top_p=0.0,  # may be traced
+    temperature=1.0,  # may be traced
+    vocab_size: int | None = None,
+) -> jax.Array:
+    """Sampling core with a *static* mode but traced temperature / top_p —
+    serving varies those per request without recompiling the decode loop."""
+    if vocab_size is not None and vocab_size < logits.shape[-1]:
+        pad = jnp.arange(logits.shape[-1]) >= vocab_size
+        logits = jnp.where(pad[None, :], NEG_INF, logits)
+    if mode == "greedy":
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if mode == "top_k":
+        logits = modify_logits_for_top_k_filtering(logits, top_k)
+    else:
+        logits = _top_p_filter(logits, top_p)
+    assert rng is not None, "stochastic sampling requires an rng key"
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

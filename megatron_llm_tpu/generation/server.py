@@ -1,0 +1,223 @@
+"""REST text-generation server.
+
+Parity with the reference's Flask ``MegatronServer``
+(megatron/text_generation_server.py:17-241): ``PUT /api`` takes a JSON body
+with ``prompts`` plus sampling knobs, returns ``{"text", "segments",
+"logprobs"}`` (or beam-search results when ``beam_width`` is set), with the
+same field validation and error strings.  Flask is not available in this
+image, so the server is built on the stdlib ``http.server`` —
+a ``ThreadingHTTPServer`` with a request lock, which also replaces the
+reference's rank-0 ``send_do_generate`` fan-out (one SPMD process, no
+controller choreography).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+from ..config import ModelConfig
+from ..tokenizer.tokenizer import Tokenizer
+from .api import (
+    beam_search_and_post_process,
+    generate_and_post_process,
+    score_and_post_process,
+)
+
+
+class GenerationService:
+    """Validates requests and runs generation.  Separated from HTTP plumbing
+    so it is directly unit-testable (and reusable from the CLI)."""
+
+    def __init__(self, cfg: ModelConfig, params, tokenizer: Tokenizer,
+                 max_batch_size: int = 8, max_tokens_to_generate: int = 1024):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_batch_size = max_batch_size
+        self.max_tokens_to_generate = max_tokens_to_generate
+        self.lock = threading.Lock()  # one generation at a time (ref :21)
+
+    def handle(self, body: dict) -> tuple[int, dict | str]:
+        """Returns (http_status, response_json_or_error_string).
+
+        Validation parity: text_generation_server.py:31-188.
+        """
+        if "prompts" not in body:
+            return 400, "prompts argument required"
+        if "max_len" in body:
+            return 400, ("max_len is no longer used.  "
+                         "Replace with tokens_to_generate")
+        if "sentences" in body:
+            return 400, "sentences is no longer used.  Replace with prompts"
+        prompts = body["prompts"]
+        if not isinstance(prompts, list) or \
+                not all(isinstance(p, str) for p in prompts):
+            return 400, "prompts is not a list of strings"
+        if len(prompts) == 0:
+            return 400, "prompts is empty"
+        if len(prompts) > self.max_batch_size:
+            return 400, f"Maximum number of prompts is {self.max_batch_size}"
+
+        tokens_to_generate = body.get("tokens_to_generate", 64)
+        if not isinstance(tokens_to_generate, int) or \
+                isinstance(tokens_to_generate, bool):
+            return 400, "tokens_to_generate must be an integer greater than 0"
+        if tokens_to_generate < 0:
+            return 400, ("tokens_to_generate must be an integer greater "
+                         "than or equal to 0")
+        if tokens_to_generate > self.max_tokens_to_generate:
+            return 400, (f"tokens_to_generate must be at most "
+                         f"{self.max_tokens_to_generate}")
+
+        logprobs = body.get("logprobs", False)
+        if not isinstance(logprobs, bool):
+            return 400, "logprobs must be a boolean value"
+        if tokens_to_generate == 0 and not logprobs:
+            return 400, "tokens_to_generate=0 implies logprobs should be True"
+
+        temperature = body.get("temperature", 1.0)
+        if not isinstance(temperature, (int, float)) or \
+                not 0.0 < temperature <= 100.0:
+            return 400, "temperature must be a positive number less than " \
+                        "or equal to 100.0"
+        top_k = body.get("top_k", 0)
+        if not isinstance(top_k, int) or isinstance(top_k, bool) or \
+                not 0 <= top_k <= 1000:
+            return 400, "top_k must be an integer equal to or greater " \
+                        "than 0 and less than or equal to 1000"
+        top_p = body.get("top_p", 0.0)
+        if not isinstance(top_p, (int, float)) or not 0.0 <= top_p <= 1.0:
+            return 400, "top_p must be less than or equal to 1 and greater " \
+                        "than or equal to 0"
+        if top_p > 0.0 and top_k > 0:
+            return 400, "cannot set both top-k and top-p samplings"
+
+        add_BOS = body.get("add_BOS", False)
+        if not isinstance(add_BOS, bool):
+            return 400, "add_BOS must be a boolean value"
+        if any(len(p) == 0 for p in prompts) and not add_BOS:
+            return 400, "Empty prompts require add_BOS=true"
+
+        random_seed = body.get("random_seed", -1)
+        if not isinstance(random_seed, int) or isinstance(random_seed, bool):
+            return 400, "random_seed must be integer"
+        if random_seed < -1:
+            return 400, "random_seed must be a positive integer"
+
+        no_early_term = body.get("no_early_termination", False)
+        if not isinstance(no_early_term, bool):
+            return 400, "no_early_termination must be a boolean value"
+
+        beam_width = body.get("beam_width", None)
+        if beam_width is not None:
+            if not isinstance(beam_width, int) or beam_width < 1:
+                return 400, "beam_width must be an integer > 0"
+            if len(prompts) > 1:
+                return 400, "When doing beam_search, batch size must be 1"
+        stop_token = body.get("stop_token", None)
+        length_penalty = body.get("length_penalty", 1.0)
+
+        with self.lock:
+            try:
+                if beam_width is not None:
+                    res = beam_search_and_post_process(
+                        self.cfg, self.params, self.tokenizer, prompts[0],
+                        tokens_to_generate=tokens_to_generate,
+                        beam_size=beam_width,
+                        stop_token=stop_token,
+                        length_penalty=length_penalty,
+                        num_return_gen=beam_width,
+                        add_BOS=add_BOS, return_segments=True)
+                    return 200, {"text": res.texts,
+                                 "segments": res.segments,
+                                 "scores": res.scores}
+                if tokens_to_generate == 0:
+                    res = score_and_post_process(
+                        self.cfg, self.params, self.tokenizer, prompts)
+                    return 200, {"text": res.texts,
+                                 "logprobs": res.logprobs}
+                res = generate_and_post_process(
+                    self.cfg, self.params, self.tokenizer, prompts,
+                    tokens_to_generate=tokens_to_generate,
+                    return_output_log_probs=logprobs,
+                    return_segments=True,
+                    top_k_sampling=top_k, top_p_sampling=top_p,
+                    temperature=temperature, add_BOS=add_BOS,
+                    use_eod_token_for_early_termination=not no_early_term,
+                    random_seed=random_seed)
+                return 200, {"text": res.texts,
+                             "segments": res.segments,
+                             "logprobs": res.logprobs}
+            except ValueError as e:
+                return 400, str(e)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: GenerationService  # injected by make_server
+
+    def log_message(self, *args):  # quiet by default
+        pass
+
+    def _respond(self, status: int, payload):
+        if isinstance(payload, str):
+            body = payload.encode()
+            ctype = "text/plain"
+        else:
+            body = json.dumps(payload).encode()
+            ctype = "application/json"
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        if self.path.rstrip("/") != "/api":
+            self._respond(404, "not found")
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._respond(400, "invalid JSON body")
+            return
+        status, payload = self.service.handle(body)
+        self._respond(status, payload)
+
+    do_POST = do_PUT  # convenience; the reference accepts PUT only
+
+
+class MegatronServer:
+    """HTTP front-end (reference: MegatronServer,
+    text_generation_server.py:234-241)."""
+
+    def __init__(self, cfg: ModelConfig, params, tokenizer: Tokenizer,
+                 **service_kw):
+        self.service = GenerationService(cfg, params, tokenizer, **service_kw)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def run(self, host: str = "0.0.0.0", port: int = 5000,
+            block: bool = True):
+        handler = type("Handler", (_Handler,), {"service": self.service})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        if block:
+            self._httpd.serve_forever()
+        else:
+            t = threading.Thread(target=self._httpd.serve_forever,
+                                 daemon=True)
+            t.start()
+        return self._httpd
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None
+        return self._httpd.server_address[1]
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -24,6 +24,7 @@ from .transformer import (
     norm_init,
     rope_tables,
     stack_forward,
+    stack_forward_cached,
 )
 from ..ops.norms import norm_apply
 
@@ -136,6 +137,49 @@ def forward(
                    impl=cfg.norm_impl)
     logits = unembed(cfg, params, x)
     return logits.astype(jnp.float32)
+
+
+def forward_cached(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [b, s] int32 — the *new* tokens only
+    k_cache: jax.Array,  # [L, b, max_len, kv_heads, head_dim]
+    v_cache: jax.Array,
+    cache_len: jax.Array,  # scalar int32 — tokens already in the cache
+    *,
+    rope: Optional[tuple] = None,
+):
+    """Incremental forward for generation: consume ``tokens`` positioned at
+    ``cache_len..cache_len+s``, append their K/V to the cache, and return
+    ``(logits[b, s, vocab] fp32, new_k_cache, new_v_cache)``.
+
+    The caller owns advancing ``cache_len`` (reference: InferenceParams
+    sequence-offset bookkeeping, megatron/text_generation/forward_step.py).
+    """
+    if rope is None:
+        cos, sin = rope_tables(cfg)
+    else:
+        cos, sin = rope
+    b, s = tokens.shape
+    position_ids = (cache_len + jnp.arange(s, dtype=jnp.int32))[None, :]
+    position_ids = jnp.broadcast_to(position_ids, (b, s))
+    x = embed(cfg, params, tokens, position_ids)
+    side = AttnSideInputs(rope_cos=cos, rope_sin=sin,
+                          position_ids=position_ids, deterministic=True)
+    x, new_k, new_v = stack_forward_cached(
+        cfg, params["layers"], x, side, k_cache, v_cache, cache_len)
+    x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps,
+                   impl=cfg.norm_impl)
+    logits = unembed(cfg, params, x)
+    return logits.astype(jnp.float32), new_k, new_v
+
+
+def init_kv_cache(cfg: ModelConfig, batch_size: int, max_len: int,
+                  dtype=None):
+    """Allocate an empty stacked KV cache ([L, b, max_len, kv_heads, d] ×2)."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, batch_size, max_len, cfg.kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
 def num_params(params: Params) -> int:

@@ -137,11 +137,17 @@ def _dropout(x, rate, rng, deterministic):
 
 
 def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
-                    side: AttnSideInputs, layer_rng) -> jax.Array:
+                    side: AttnSideInputs, layer_rng,
+                    kv_cache: Optional[tuple] = None):
     """QKV projection → RoPE → attention → output projection.
 
     Parity: megatron/model/transformer.py:412-565 (ParallelAttention) with
     GQA/MQA handled inside the attention einsum rather than by tiling K/V.
+
+    ``kv_cache`` is an optional ``(k_cache, v_cache, length)`` triple
+    ([b, max_len, nkv, d] ×2 + scalar int32) for incremental decoding (the
+    reference's InferenceParams KV cache, transformer.py:423-496).  When
+    given, the return value is ``(out, (new_k_cache, new_v_cache))``.
     """
     b, s, h = x.shape
     d = cfg.head_dim
@@ -159,9 +165,14 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
     k = k.reshape(b, s, nkv, d)
     v = v.reshape(b, s, nkv, d)
 
+    position_ids = side.position_ids
+    if kv_cache is not None and position_ids is None:
+        raise ValueError("kv_cache requires explicit position_ids "
+                         "(forward_cached supplies them)")
+
     if cfg.position_embedding_type == PositionEmbeddingType.ROTARY:
-        q = apply_rope(q, side.rope_cos, side.rope_sin, side.position_ids)
-        k = apply_rope(k, side.rope_cos, side.rope_sin, side.position_ids)
+        q = apply_rope(q, side.rope_cos, side.rope_sin, position_ids)
+        k = apply_rope(k, side.rope_cos, side.rope_sin, position_ids)
 
     softmax_scale = 1.0 / (d ** 0.5)
     if cfg.apply_query_key_layer_scaling:
@@ -174,18 +185,41 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
     if not side.deterministic and cfg.attention_dropout > 0.0:
         drop_rng = jax.random.fold_in(layer_rng, 1)
 
-    ctx = attention(
-        q, k, v,
-        impl=cfg.attention_impl,
-        causal=True,
-        segment_ids=side.segment_ids,
-        softmax_scale=softmax_scale,
-        dropout_rate=0.0 if side.deterministic else cfg.attention_dropout,
-        dropout_rng=drop_rng,
-    )
+    if kv_cache is not None:
+        k_cache, v_cache, cache_len = kv_cache
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0))
+        # Causal-with-offset mask over the static-length cache: query i (at
+        # absolute position cache_len+i) may see cache slot j iff
+        # j <= cache_len + i.  Slots past the fill level hold garbage but are
+        # masked by the same inequality.
+        max_len = k_cache.shape[1]
+        i = jnp.arange(s)[:, None]
+        j = jnp.arange(max_len)[None, :]
+        bias = jnp.where(j <= (cache_len + i), 0.0, -jnp.inf
+                         )[None, None].astype(jnp.float32)
+        ctx = attention(
+            q, k_cache, v_cache,
+            impl="dot", causal=False, bias=bias,
+            softmax_scale=softmax_scale,
+        )
+    else:
+        ctx = attention(
+            q, k, v,
+            impl=cfg.attention_impl,
+            causal=True,
+            segment_ids=side.segment_ids,
+            softmax_scale=softmax_scale,
+            dropout_rate=0.0 if side.deterministic else cfg.attention_dropout,
+            dropout_rng=drop_rng,
+        )
     out = ctx.reshape(b, s, nq * d) @ p["wo"]
     if "bo" in p:
         out = out + p["bo"]
+    if kv_cache is not None:
+        return out, (k_cache, v_cache)
     return out
 
 
@@ -216,16 +250,23 @@ def mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
 
 
 def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
-                  side: AttnSideInputs, layer_rng=None) -> jax.Array:
+                  side: AttnSideInputs, layer_rng=None,
+                  kv_cache: Optional[tuple] = None):
     """One pre-LN residual block, sequential or Falcon-parallel.
 
     Parity: megatron/model/transformer.py:695-817
-    (ParallelTransformerLayer.forward).
+    (ParallelTransformerLayer.forward).  With ``kv_cache`` returns
+    ``(out, new_cache)``.
     """
     residual = x
     h1 = norm_apply(cfg.norm_type, x, p["input_norm"], cfg.norm_eps,
                     impl=cfg.norm_impl)
-    attn_out = attention_block(cfg, p["attn"], h1, side, layer_rng)
+    new_cache = None
+    if kv_cache is not None:
+        attn_out, new_cache = attention_block(cfg, p["attn"], h1, side,
+                                              layer_rng, kv_cache)
+    else:
+        attn_out = attention_block(cfg, p["attn"], h1, side, layer_rng)
 
     det = side.deterministic
     if cfg.parallel_attn:
@@ -239,7 +280,7 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
         if layer_rng is not None:
             out = _dropout(out, cfg.hidden_dropout,
                            jax.random.fold_in(layer_rng, 2), det)
-        return residual + out
+        result = residual + out
     else:
         a = attn_out
         if layer_rng is not None:
@@ -252,7 +293,10 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
         if layer_rng is not None:
             m = _dropout(m, cfg.hidden_dropout,
                          jax.random.fold_in(layer_rng, 3), det)
-        return x + m
+        result = x + m
+    if kv_cache is not None:
+        return result, new_cache
+    return result
 
 
 def _remat_policy(cfg: ModelConfig):
@@ -287,6 +331,30 @@ def stack_forward(cfg: ModelConfig, stacked: Params, x: jax.Array,
 
     (x, _), _ = jax.lax.scan(body, (x, 0), (stacked,))
     return x
+
+
+def stack_forward_cached(cfg: ModelConfig, stacked: Params, x: jax.Array,
+                         side: AttnSideInputs,
+                         k_cache: jax.Array,  # [L, b, max_len, nkv, d]
+                         v_cache: jax.Array,
+                         cache_len: jax.Array):
+    """Scan over layers threading a per-layer KV cache (decode path).
+
+    The cache is stacked on the leading layer axis, mirroring the stacked
+    parameter layout, so one compiled layer body serves every depth.  Returns
+    ``(hidden, new_k_cache, new_v_cache)``; the caller advances ``cache_len``.
+    Parity: the reference's InferenceParams threading through
+    ParallelTransformer (megatron/model/transformer.py:423-496,1158-1246).
+    """
+
+    def body(h, inp):
+        layer_params, kc, vc = inp
+        h, (kc, vc) = layer_forward(cfg, layer_params, h, side, None,
+                                    kv_cache=(kc, vc, cache_len))
+        return h, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (stacked, k_cache, v_cache))
+    return x, new_k, new_v
 
 
 def rope_tables(cfg: ModelConfig, dtype=jnp.float32):

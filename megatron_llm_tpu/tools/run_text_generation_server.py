@@ -1,0 +1,55 @@
+"""Launch the REST text-generation server on a checkpoint.
+
+Parity: tools/run_text_generation_server.py in the reference.  Usage::
+
+    python -m megatron_llm_tpu.tools.run_text_generation_server \
+        --load /path/to/ckpt --model llama2 --size 7b \
+        --tokenizer_type SentencePieceTokenizer \
+        --tokenizer_model /path/tokenizer.model --port 5000
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--load", required=True, help="checkpoint directory")
+    ap.add_argument("--model", default="llama2",
+                    choices=["llama", "llama2", "codellama", "falcon", "gpt"])
+    ap.add_argument("--size", default="7b")
+    ap.add_argument("--tokenizer_type", default="SentencePieceTokenizer")
+    ap.add_argument("--tokenizer_model", default=None)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=5000)
+    ap.add_argument("--max_batch_size", type=int, default=8)
+    ap.add_argument("--max_tokens_to_generate", type=int, default=1024)
+    args = ap.parse_args(argv)
+
+    from ..checkpointing import load_params_for_inference
+    from ..models import families
+    from ..tokenizer.tokenizer import build_tokenizer
+
+    factory = {"llama": lambda s: families.llama(s, version=1),
+               "llama2": lambda s: families.llama(s, version=2),
+               "codellama": families.code_llama,
+               "falcon": families.falcon,
+               "gpt": families.gpt}[args.model]
+    lm = factory(args.size)
+    tokenizer = build_tokenizer(args.tokenizer_type, args.tokenizer_model)
+    params = load_params_for_inference(args.load, lm.cfg)
+
+    from ..generation.server import MegatronServer
+
+    server = MegatronServer(
+        lm.cfg, params, tokenizer,
+        max_batch_size=args.max_batch_size,
+        max_tokens_to_generate=args.max_tokens_to_generate)
+    print(f"serving on {args.host}:{args.port}")
+    server.run(args.host, args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

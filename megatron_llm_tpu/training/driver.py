@@ -88,16 +88,22 @@ class DistSignalHandler:
         return False
 
     def signals_received(self) -> bool:
-        if jax.process_count() == 1:
-            return self._received
-        try:
-            from jax.experimental import multihost_utils
+        return _cluster_any(self._received)
 
-            flags = multihost_utils.process_allgather(
-                np.asarray([self._received]))
-            return bool(np.any(flags))
-        except Exception:
-            return self._received
+
+def _cluster_any(local_flag: bool) -> bool:
+    """True iff any process observed the flag — the analogue of the
+    reference's all-reduce-MAX exit flags (training.py:745-767), so every
+    host takes the same branch and no collective is left half-entered."""
+    if jax.process_count() == 1:
+        return bool(local_flag)
+    try:
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(np.asarray([local_flag]))
+        return bool(np.any(flags))
+    except Exception:
+        return bool(local_flag)
 
 
 # ---------------------------------------------------------------------------
@@ -542,9 +548,11 @@ def pretrain(
             elif (cfg.train.exit_interval
                     and iteration % cfg.train.exit_interval == 0):
                 exit_reason = "exit_interval"
-            elif cfg.train.exit_duration_mins is not None:
+            elif cfg.train.exit_duration_mins is not None and check_signal:
+                # Clock skew between hosts must not split the exit decision:
+                # consensus on the same cadence as the signal check.
                 mins = (time.time() - t_start) / 60.0
-                if mins > cfg.train.exit_duration_mins:
+                if _cluster_any(mins > cfg.train.exit_duration_mins):
                     exit_reason = "exit_duration"
             if exit_reason:
                 break

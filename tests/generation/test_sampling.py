@@ -1,0 +1,68 @@
+"""Sampling unit tests (reference behavior: text_generation/sampling.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.generation.sampling import (
+    NEG_INF,
+    modify_logits_for_top_k_filtering,
+    modify_logits_for_top_p_filtering,
+    sample,
+)
+
+
+def test_top_k_filtering_keeps_k():
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+    out = modify_logits_for_top_k_filtering(logits, 2)
+    kept = np.asarray(out[0]) > NEG_INF / 2
+    assert kept.tolist() == [False, True, False, False, True]
+
+
+def test_top_k_zero_is_identity():
+    logits = jnp.asarray([[1.0, 2.0]])
+    assert np.allclose(modify_logits_for_top_k_filtering(logits, 0), logits)
+
+
+def test_top_p_keeps_nucleus():
+    # probs ≈ [0.64, 0.24, 0.09, 0.03]: top_p=0.7 keeps the first two
+    # (cumsum-shifted convention always keeps the argmax).
+    logits = jnp.log(jnp.asarray([[0.64, 0.24, 0.09, 0.03]]))
+    out = modify_logits_for_top_p_filtering(logits, 0.7)
+    kept = np.asarray(out[0]) > NEG_INF / 2
+    assert kept.tolist() == [True, True, False, False]
+
+
+def test_top_p_always_keeps_argmax():
+    logits = jnp.log(jnp.asarray([[0.97, 0.01, 0.01, 0.01]]))
+    out = modify_logits_for_top_p_filtering(logits, 0.5)
+    kept = np.asarray(out[0]) > NEG_INF / 2
+    assert kept.tolist() == [True, False, False, False]
+
+
+def test_greedy_when_no_filters():
+    logits = jnp.asarray([[0.1, 9.0, 0.2], [3.0, 1.0, 2.0]])
+    out = sample(logits, None, top_k=0, top_p=0.0, temperature=0.5)
+    assert np.asarray(out).tolist() == [1, 0]
+
+
+def test_vocab_clamp_masks_padding():
+    # padded vocab 8, real vocab 5: padding ids must never be sampled
+    logits = jnp.zeros((4, 8)).at[:, 6].set(100.0)
+    out = sample(logits, jax.random.key(0), top_k=3, vocab_size=5)
+    assert np.all(np.asarray(out) < 5)
+
+
+def test_top_k_sampling_stays_in_top_k():
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(16, 32)),
+                         jnp.float32)
+    out = sample(logits, jax.random.key(1), top_k=4)
+    top4 = np.argsort(np.asarray(logits), axis=-1)[:, -4:]
+    for i, t in enumerate(np.asarray(out)):
+        assert t in top4[i]
+
+
+def test_both_topk_topp_rejected():
+    with pytest.raises(AssertionError):
+        sample(jnp.zeros((1, 4)), jax.random.key(0), top_k=2, top_p=0.5)

@@ -1,0 +1,111 @@
+"""REST server end-to-end tests (reference: megatron/text_generation_server.py
+API contract) — stdlib urllib client against an in-process server."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.generation.server import GenerationService, MegatronServer
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.tokenizer.tokenizer import NullTokenizer
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = tiny_config(num_layers=2, vocab_size=256,
+                      make_vocab_size_divisible_by=8)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    tok = NullTokenizer(vocab_size=cfg.vocab_size)
+    server = MegatronServer(cfg, params, tok, max_tokens_to_generate=64)
+    server.run("127.0.0.1", 0, block=False)  # ephemeral port
+    yield server
+    server.shutdown()
+
+
+def _put(server, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/api",
+        data=json.dumps(body).encode(), method="PUT",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _put_err(server, body):
+    try:
+        _put(server, body)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+    raise AssertionError("expected an HTTP error")
+
+
+def test_generate_roundtrip(served):
+    status, out = _put(served, {"prompts": ["5 9 3"],
+                                "tokens_to_generate": 4})
+    assert status == 200
+    assert len(out["text"]) == 1
+    # NullTokenizer: space-separated ids; 3 prompt + 4 generated
+    assert len(out["text"][0].split()) == 7
+    assert len(out["segments"][0]) == 7
+
+
+def test_generate_with_logprobs(served):
+    status, out = _put(served, {"prompts": ["5 9 3"],
+                                "tokens_to_generate": 3,
+                                "logprobs": True})
+    assert status == 200
+    assert len(out["logprobs"][0]) == 5  # len-1
+    assert all(lp <= 0.0 for lp in out["logprobs"][0])
+
+
+def test_score_only(served):
+    status, out = _put(served, {"prompts": ["5 9 3 7"],
+                                "tokens_to_generate": 0,
+                                "logprobs": True})
+    assert status == 200
+    assert len(out["logprobs"][0]) == 3
+
+
+def test_beam_search_request(served):
+    status, out = _put(served, {"prompts": ["5 9 3"],
+                                "tokens_to_generate": 4,
+                                "beam_width": 2})
+    assert status == 200
+    assert len(out["text"]) == 2
+    assert out["scores"][0] >= out["scores"][1]
+
+
+def test_validation_errors(served):
+    code, msg = _put_err(served, {})
+    assert code == 400 and "prompts" in msg
+    code, msg = _put_err(served, {"prompts": ["x"], "max_len": 5})
+    assert code == 400 and "tokens_to_generate" in msg
+    code, msg = _put_err(served, {"prompts": ["x"],
+                                  "tokens_to_generate": -1})
+    assert code == 400
+    code, msg = _put_err(served, {"prompts": ["x"], "top_k": 5,
+                                  "top_p": 0.5})
+    assert code == 400 and "both" in msg
+    code, msg = _put_err(served, {"prompts": ["x"],
+                                  "tokens_to_generate": 0})
+    assert code == 400 and "logprobs" in msg
+    code, msg = _put_err(served, {"prompts": ["a", "b"], "beam_width": 2})
+    assert code == 400 and "batch size must be 1" in msg
+
+
+def test_service_direct_multibatch():
+    cfg = tiny_config(num_layers=1, vocab_size=256,
+                      make_vocab_size_divisible_by=8)
+    params = model_lib.init_params(jax.random.key(1), cfg)
+    svc = GenerationService(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size))
+    status, out = svc.handle({"prompts": ["1 2 3", "4 5"],
+                              "tokens_to_generate": 2,
+                              "temperature": 0.8, "top_k": 4,
+                              "random_seed": 7})
+    assert status == 200
+    assert len(out["text"]) == 2

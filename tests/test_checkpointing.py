@@ -156,3 +156,20 @@ def test_random_sampler_resume_matches_uninterrupted():
     full = take(RandomSampler(10, 0, 4, seed=3), 6)  # active=8/epoch → 2/epoch
     resumed = take(RandomSampler(10, 16, 4, seed=3), 2)  # 16 = 2 epochs
     assert resumed == full[4:6]
+
+
+def test_load_params_for_inference(tmp_path):
+    """Serving path: params-only restore from a full training checkpoint
+    (partial restore — no optimizer state read) and from a 'release'
+    params-only checkpoint."""
+    cfg = _cfg()
+    params = model_lib.init_params(jax.random.key(3), cfg.model)
+    state = init_train_state(cfg, params)
+    ckpt.save_checkpoint(str(tmp_path), state, cfg, iteration=5)
+    loaded = ckpt.load_params_for_inference(str(tmp_path), cfg.model)
+    jax.tree.map(np.testing.assert_array_equal, loaded, params)
+
+    rel = tmp_path / "rel"
+    ckpt.save_release_params(str(rel), params, cfg)
+    loaded_rel = ckpt.load_params_for_inference(str(rel), cfg.model)
+    jax.tree.map(np.testing.assert_array_equal, loaded_rel, params)
