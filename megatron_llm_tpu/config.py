@@ -112,6 +112,11 @@ class ModelConfig:
     norm_impl: str = "xla"
     # recompute: "none" | "selective" | "full"
     recompute: str = "selective"
+    # When set (to a mesh axis name, canonically "cp"), attention runs the
+    # ring-attention context-parallel path: seq dim sharded over this axis,
+    # K/V blocks rotated with ppermute (parallel/ring_attention.py).  Set by
+    # the runtime when ParallelConfig.context_parallel > 1.
+    context_parallel_axis: Optional[str] = None
     # Parallel-friendly sequence length used for activation layouts.
     seq_length: int = 4096
     # lm head
@@ -290,6 +295,26 @@ class RuntimeConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
 
     def validate(self) -> "RuntimeConfig":
+        # Wire context parallelism into the model: attention switches to the
+        # ring path (parallel/ring_attention.py) when the cp axis is real,
+        # and back off it when a checkpointed config is re-validated with
+        # cp == 1 (e.g. single-host inference on a cp-trained model).
+        if self.parallel.context_parallel > 1:
+            if self.model.context_parallel_axis is None:
+                object.__setattr__(
+                    self, "model",
+                    dataclasses.replace(self.model,
+                                        context_parallel_axis="cp"))
+            assert self.model.attention_dropout == 0.0, (
+                "ring attention (context_parallel > 1) does not support "
+                "attention dropout")
+            assert self.train.seq_length % self.parallel.context_parallel == 0, (
+                f"seq_length {self.train.seq_length} must divide by "
+                f"context_parallel {self.parallel.context_parallel}")
+        elif self.model.context_parallel_axis is not None:
+            object.__setattr__(
+                self, "model",
+                dataclasses.replace(self.model, context_parallel_axis=None))
         self.model.validate()
         self.parallel.validate()
         mb = self.train.micro_batch_size

@@ -214,6 +214,7 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
             softmax_scale=softmax_scale,
             dropout_rate=0.0 if side.deterministic else cfg.attention_dropout,
             dropout_rng=drop_rng,
+            cp_axis=cfg.context_parallel_axis,
         )
     out = ctx.reshape(b, s, nq * d) @ p["wo"]
     if "bo" in p:

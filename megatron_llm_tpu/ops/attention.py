@@ -114,8 +114,31 @@ def attention(
     dropout_rate: float = 0.0,
     dropout_rng=None,
     bias=None,
+    cp_axis: str | None = None,
+    mesh=None,
 ) -> jax.Array:
-    """Dispatcher: 'flash' → Pallas kernel (TPU), 'dot' → XLA einsum path."""
+    """Dispatcher: 'flash' → Pallas kernel (TPU), 'dot' → XLA einsum path.
+
+    ``cp_axis`` selects the ring-attention context-parallel path (sequence
+    sharded over that mesh axis; parallel/ring_attention.py) — it composes
+    with either impl's math but currently uses the blockwise einsum body.
+    """
+    if cp_axis is not None:
+        if bias is not None or dropout_rate > 0.0:
+            # No silent fallback: inside the pipeline's manual-cp shard_map
+            # the einsum path would attend only within local shards (wrong
+            # math), and under GSPMD it would all-gather K/V (the memory
+            # cliff cp exists to avoid).  RuntimeConfig.validate rejects
+            # cp + attention_dropout up front; this guards direct callers.
+            raise ValueError(
+                "ring attention (context parallelism) does not support "
+                "attention bias or attention dropout; set "
+                "attention_dropout=0 or disable context_parallel")
+        from ..parallel.ring_attention import ring_attention
+        return ring_attention(
+            q, k, v, mesh=mesh, axis_name=cp_axis, causal=causal,
+            segment_ids=segment_ids, softmax_scale=softmax_scale,
+        )
     if impl == "flash" and bias is None and dropout_rate == 0.0:
         try:
             from ..kernels.flash_attention import flash_attention

@@ -278,12 +278,27 @@ def pipeline_apply(
         lambda _: P(None, PP), staged_layers)
     pos = side_mb.position_ids
     seg = side_mb.segment_ids
+    # With context parallelism the cp axis joins the manual set: activations
+    # stay seq-sharded through the stage bodies and ring attention
+    # (parallel/ring_attention.py) runs its ppermute ring directly inside
+    # this shard_map (axes can't be re-bound by a nested one).
+    cp_axis = cfg.context_parallel_axis
+    if cp_axis is not None:
+        manual_axes = {PP, cp_axis}
+        x_spec = P(None, None, cp_axis, None)  # [M, mb, s, h]
+        side_spec = P(None, None, cp_axis)  # [M, mb, s]
+        assert pos is not None, (
+            "pipeline with context parallelism needs explicit global "
+            "position_ids (pipeline_loss supplies them)")
+    else:
+        manual_axes = {PP}
+        x_spec = side_spec = P()
     fn = jax.shard_map(
         pipelined,
         mesh=mesh,
-        in_specs=(layer_in_specs, P(), P(), P()),
-        out_specs=P(),
-        axis_names={PP},
+        in_specs=(layer_in_specs, x_spec, side_spec, side_spec),
+        out_specs=x_spec,
+        axis_names=manual_axes,
         check_vma=False,
     )
     # The replicated (P()) input's transpose is a psum of its cotangent over
@@ -356,9 +371,17 @@ def pipeline_loss(
 
     _, x_mb = jax.lax.scan(embed_one, None, jnp.arange(M))
 
+    position_ids = batch.get("position_ids")
+    if model_cfg.context_parallel_axis is not None and position_ids is None:
+        # Inside the manual-cp pipeline body each shard sees only its local
+        # sequence chunk, so RoPE needs explicit *global* positions.
+        s = tokens.shape[-1]
+        position_ids = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32),
+                                        tokens.shape)
+
     side_mb = AttnSideInputs(
         rope_cos=cos, rope_sin=sin,
-        position_ids=batch.get("position_ids"),
+        position_ids=position_ids,
         segment_ids=batch.get("segment_ids"),
         deterministic=deterministic,
     )

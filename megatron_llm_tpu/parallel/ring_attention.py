@@ -1,0 +1,189 @@
+"""Ring attention: context parallelism over the ``cp`` mesh axis.
+
+Long-context capability beyond the reference fork (which handles long
+sequences only via FlashAttention + RoPE scaling + sequence parallelism +
+recompute — SURVEY §5; there is no ring/blockwise/Ulysses attention in
+ipackhu/Megatron-LLM).  This module shards the *sequence* dimension of
+Q/K/V over the ``cp`` mesh axis and computes exact softmax attention by
+rotating K/V blocks around the ring with ``jax.lax.ppermute`` while
+maintaining online-softmax statistics (the blockwise log-sum-exp
+accumulation of Liu et al.'s Ring Attention / FlashAttention-2).
+
+Every cross-token op in a decoder transformer is inside attention, so with
+this op the rest of the model runs purely locally under the activation
+sharding P(dp, cp, None) — GSPMD never needs to all-gather the sequence.
+
+Differentiability: the ring is an ordinary ``lax.scan`` over ``ppermute``
+(which has a well-defined transpose — the reverse permutation), so
+``jax.grad`` of a loss through ``ring_attention`` *is* the backward ring:
+dK/dV cotangents travel the ring in the opposite direction.  No custom VJP
+bookkeeping is required, mirroring how parallel/pipeline.py gets the
+backward pipeline from the forward program.
+
+Causal handling: ranks own contiguous sequence chunks; a K/V block from a
+higher rank is fully in the future of all local queries and contributes
+zeros through the online-softmax masking.  The compute for those blocks is
+wasted (≈2× FLOPs vs a perfectly balanced schedule) but the program stays
+SPMD-uniform; a zigzag layout can halve this later without API changes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+CP = mesh_lib.CONTEXT_AXIS
+
+
+def ring_attention_local(
+    q: jax.Array,  # [b, sq_local, n_heads, d]
+    k: jax.Array,  # [b, sk_local, kv_heads, d]
+    v: jax.Array,  # [b, sk_local, kv_heads, d]
+    q_seg: Optional[jax.Array] = None,  # [b, sq_local] packed-seq ids
+    k_seg: Optional[jax.Array] = None,  # [b, sk_local]
+    *,
+    axis_name: str = CP,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact ring attention on per-device shards (call inside shard_map).
+
+    Sequence ownership is contiguous: the device at ring index r holds
+    global positions [r*s_local, (r+1)*s_local).
+    """
+    b, sq, nq, d = q.shape
+    _, sk, nkv, _ = k.shape
+    group = nq // nkv
+    if softmax_scale is None:
+        softmax_scale = 1.0 / float(np.sqrt(d))
+
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    qg = q.reshape(b, sq, nkv, group, d)
+    q_pos = my * sq + jnp.arange(sq)
+
+    # online-softmax accumulators (fp32)
+    m0 = jnp.full((b, nkv, group, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, nkv, group, sq), jnp.float32)
+    acc0 = jnp.zeros((b, sq, nkv, group, d), jnp.float32)
+
+    has_seg = q_seg is not None
+    if has_seg and k_seg is None:
+        k_seg = q_seg
+
+    def process_block(m, l, acc, kb, vb, sb, i):
+        """Fold one K/V block into the online-softmax accumulators."""
+        # after i rotations this device holds the block that started on
+        # ring index (my - i) mod n
+        src = (my - i) % n
+        scores = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, kb,
+            preferred_element_type=jnp.float32,
+        ) * softmax_scale  # [b, nkv, group, sq, sk]
+
+        if causal:
+            k_pos = src * sk + jnp.arange(sk)
+            keep = k_pos[None, :] <= q_pos[:, None]  # [sq, sk]
+            scores = jnp.where(keep[None, None, None], scores, -jnp.inf)
+        if has_seg:
+            same = q_seg[:, :, None] == sb[:, None, :]  # [b, sq, sk]
+            scores = jnp.where(same[:, None, None], scores, -jnp.inf)
+
+        blk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        # fully-masked-so-far rows: keep the exponent base at 0 so every
+        # exp() below is exp(-inf) = 0 rather than NaN
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        p = jnp.exp(scores - safe_m[..., None])  # [b, nkv, g, sq, sk]
+
+        l = l * corr + jnp.sum(p, axis=-1)
+        # corr is [b, nkv, g, sq] → align to acc [b, sq, nkv, g, d]
+        corr_a = jnp.transpose(corr, (0, 3, 1, 2))[..., None]
+        pv = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(vb.dtype), vb,
+                        preferred_element_type=jnp.float32)
+        acc = acc * corr_a + pv
+        return new_m, l, acc
+
+    def body(carry, i):
+        m, l, acc, kb, vb, sb = carry
+        m, l, acc = process_block(m, l, acc, kb, vb, sb, i)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        if has_seg:
+            sb = jax.lax.ppermute(sb, axis_name, perm)
+        return (m, l, acc, kb, vb, sb), None
+
+    seg0 = k_seg if has_seg else jnp.zeros((b, sk), jnp.int32)
+    # scan n-1 rotations, then fold the final block outside the loop — the
+    # n-th rotation would only produce values that are thrown away.
+    (m, l, acc, kb, vb, sb), _ = jax.lax.scan(
+        body, (m0, l0, acc0, k, v, seg0), jnp.arange(n - 1))
+    m, l, acc = process_block(m, l, acc, kb, vb, sb, jnp.int32(n - 1))
+
+    l_a = jnp.transpose(l, (0, 3, 1, 2))[..., None]
+    out = jnp.where(l_a > 0.0, acc / jnp.where(l_a > 0.0, l_a, 1.0), 0.0)
+    return out.reshape(b, sq, nq, d).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # [b, s, n_heads, d] — s sharded over cp
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = CP,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,  # [b, s]
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """shard_map wrapper: seq dim manual over ``cp``, everything else auto.
+
+    dp/tp shardings on batch/heads stay GSPMD-managed (partial-manual
+    shard_map, the same pattern parallel/pipeline.py uses for 'pp').
+    """
+    # Inside another shard_map that already bound the cp axis as Manual
+    # (the pipeline binds {pp, cp} when context parallelism is on), axes
+    # can't be re-bound — the inputs are already local shards, so run the
+    # local ring body directly.
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is not None and axis_name in getattr(ctx, "manual_axes", ()):
+        return ring_attention_local(
+            q, k, v, segment_ids, segment_ids, axis_name=axis_name,
+            causal=causal, softmax_scale=softmax_scale)
+    if ctx is not None and not ctx.empty:
+        # Auto context mesh (tracing under jit with a mesh context): the
+        # nested shard_map must use exactly the context mesh object.
+        mesh = ctx
+    elif mesh is None:
+        mesh = mesh_lib.current_mesh()
+    if mesh is None:
+        raise ValueError(
+            "ring_attention needs a mesh (pass mesh= or enter "
+            "parallel.mesh.use_mesh)")
+
+    fn = partial(ring_attention_local, axis_name=axis_name, causal=causal,
+                 softmax_scale=softmax_scale)
+    seq = P(None, axis_name)
+    if segment_ids is None:
+        wrapped = jax.shard_map(
+            lambda q_, k_, v_: fn(q_, k_, v_),
+            mesh=mesh, in_specs=(seq, seq, seq), out_specs=seq,
+            axis_names={axis_name}, check_vma=False,
+        )
+        return wrapped(q, k, v)
+    wrapped = jax.shard_map(
+        lambda q_, k_, v_, s_: fn(q_, k_, v_, s_, s_),
+        mesh=mesh, in_specs=(seq, seq, seq, seq), out_specs=seq,
+        axis_names={axis_name}, check_vma=False,
+    )
+    return wrapped(q, k, v, segment_ids)

@@ -144,7 +144,7 @@ def setup_train_state(
     if init_rng is None:
         init_rng = jax.random.key(cfg.train.seed)
 
-    with mesh:
+    with mesh_lib.use_mesh(mesh):
         from ..parallel import pipeline as pipe_lib
 
         if params is None:
@@ -163,7 +163,9 @@ def setup_train_state(
         state_sharding = jax.tree.map(
             lambda s: NamedSharding(mesh, s), state_spec,
             is_leaf=lambda x: isinstance(x, P))
-        batch_sharding = NamedSharding(mesh, P(None, "dp", None))
+        # [accum, micro_batch, seq] leaves: batch over dp, seq over cp (the
+        # cp axis is size 1 unless context parallelism is on).
+        batch_sharding = NamedSharding(mesh, P(None, "dp", "cp"))
         state = jax.tree.map(
             lambda x, s: jax.device_put(x, s), state, state_sharding)
 

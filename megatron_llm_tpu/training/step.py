@@ -218,7 +218,19 @@ def make_train_step(cfg: RuntimeConfig, mesh=None, state_sharding=None,
     rope = rope_tables(cfg.model)
 
     def step(state, batch, base_rng):
-        return train_step(cfg, state, batch, base_rng, rope=rope, mesh=mesh)
+        # Establish the mesh context at *trace* time: mesh-needing ops
+        # inside the model (ring attention's shard_map) resolve it via
+        # parallel.mesh.current_mesh(), and jit may trace this function
+        # long after the caller's `use_mesh` block has exited.
+        import contextlib
+
+        from ..parallel import mesh as mesh_lib
+
+        ctx = (mesh_lib.use_mesh(mesh) if mesh is not None
+               else contextlib.nullcontext())
+        with ctx:
+            return train_step(cfg, state, batch, base_rng, rope=rope,
+                              mesh=mesh)
 
     kwargs = {}
     if state_sharding is not None:

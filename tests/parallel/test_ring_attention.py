@@ -1,0 +1,171 @@
+"""Ring attention (context parallelism) vs the unsharded reference path.
+
+Validates that sharding the sequence over the cp mesh axis and rotating
+K/V blocks with ppermute reproduces exact softmax attention — forward and
+backward — including GQA grouping and packed-sequence segment masks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu.ops.attention import dot_product_attention
+from megatron_llm_tpu.parallel.ring_attention import ring_attention
+from megatron_llm_tpu.parallel import mesh as mesh_lib
+
+
+def cp_mesh(devices, cp):
+    n = len(devices)
+    devs = np.asarray(devices).reshape(n // cp, 1, cp, 1)
+    return Mesh(devs, mesh_lib.AXIS_ORDER)
+
+
+def make_qkv(rng, b=2, s=32, nq=4, nkv=2, d=8):
+    q = jnp.asarray(rng.normal(size=(b, s, nq, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, nkv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, nkv, d)), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("cp", [2, 4, 8])
+def test_ring_matches_dot_causal(devices, rng, cp):
+    mesh = cp_mesh(devices, cp)
+    q, k, v = make_qkv(rng)
+    want = dot_product_attention(q, k, v, causal=True)
+
+    spec = NamedSharding(mesh, P(None, "cp"))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    got = jax.jit(
+        lambda a, b_, c: ring_attention(a, b_, c, mesh=mesh, causal=True)
+    )(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_non_causal(devices, rng):
+    mesh = cp_mesh(devices, 4)
+    q, k, v = make_qkv(rng)
+    want = dot_product_attention(q, k, v, causal=False)
+    got = jax.jit(
+        lambda a, b_, c: ring_attention(a, b_, c, mesh=mesh, causal=False)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_segment_ids(devices, rng):
+    mesh = cp_mesh(devices, 4)
+    b, s = 2, 32
+    q, k, v = make_qkv(rng, b=b, s=s)
+    # two packed sequences per row, boundary inside a shard and across shards
+    seg = jnp.asarray(
+        np.stack([np.r_[[0] * 10, [1] * 22], np.r_[[0] * 20, [1] * 12]]),
+        jnp.int32,
+    )
+    want = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+    got = jax.jit(
+        lambda a, b_, c, s_: ring_attention(a, b_, c, mesh=mesh, causal=True,
+                                            segment_ids=s_)
+    )(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_gradients_match(devices, rng):
+    mesh = cp_mesh(devices, 4)
+    q, k, v = make_qkv(rng, s=16)
+    tgt = jnp.asarray(rng.normal(size=q.shape), jnp.float32)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum((dot_product_attention(q_, k_, v_, causal=True) - tgt) ** 2)
+
+    def loss_ring(q_, k_, v_):
+        return jnp.sum((ring_attention(q_, k_, v_, mesh=mesh, causal=True) - tgt) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, b_ in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(a),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_model_forward_with_cp(devices):
+    """Full decoder forward: cp-sharded model == unsharded model."""
+    import dataclasses
+
+    from megatron_llm_tpu.config import llama2_config
+    from megatron_llm_tpu.models import model as model_lib
+
+    cfg = llama2_config(
+        "7b", hidden_size=64, num_layers=2, num_attention_heads=4,
+        num_kv_heads=2, ffn_hidden_size=128, vocab_size=256,
+        seq_length=32, max_position_embeddings=32,
+        params_dtype="float32", attention_impl="dot", recompute="none",
+    )
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (2, 32)), jnp.int32)
+    want = model_lib.forward(cfg, params, tokens)
+
+    mesh = cp_mesh(devices, 4)
+    cfg_cp = dataclasses.replace(cfg, context_parallel_axis="cp")
+    tok_sharded = jax.device_put(tokens, NamedSharding(mesh, P(None, "cp")))
+    with mesh_lib.use_mesh(mesh):
+        got = jax.jit(
+            lambda p, t: model_lib.forward(cfg_cp, p, t)
+        )(params, tok_sharded)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_train_step_with_context_parallelism():
+    """Driver-level: ParallelConfig.context_parallel=2 wires the ring path
+    (via RuntimeConfig.validate) and the train-step loss matches cp=1."""
+    from megatron_llm_tpu.config import (
+        OptimizerConfig, ParallelConfig, RuntimeConfig, TrainConfig,
+        tiny_config,
+    )
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.training.driver import setup_train_state
+
+    gen = np.random.default_rng(7)
+    tokens = gen.integers(0, 64, (1, 4, 32))
+    batch = {
+        "tokens": jnp.asarray(tokens, jnp.int32),
+        "labels": jnp.asarray(np.roll(tokens, -1, axis=-1), jnp.int32),
+        "loss_mask": jnp.ones((1, 4, 32), jnp.float32),
+    }
+
+    def run(cp, pp=1, dp=2):
+        cfg = RuntimeConfig(
+            model=tiny_config(),
+            parallel=ParallelConfig(
+                data_parallel=dp, context_parallel=cp, pipeline_parallel=pp,
+                num_microbatches=2 if pp > 1 else 1),
+            optimizer=OptimizerConfig(lr=1e-3, clip_grad=1.0),
+            train=TrainConfig(
+                train_iters=2, micro_batch_size=2, global_batch_size=4,
+                seq_length=32, save=None,
+            ),
+        ).validate()
+        if cp > 1:
+            assert cfg.model.context_parallel_axis == "cp"
+        params = model_lib.init_params(jax.random.key(3), cfg.model)
+        art = setup_train_state(cfg, params=params)
+        b = batch
+        if pp > 1:
+            # pipeline consumes [M, mb, ...] microbatches
+            b = jax.tree.map(
+                lambda x: x.reshape(2, 2, *x.shape[2:]), batch)
+        _, metrics = art.step_fn(art.state, b, None)
+        return float(metrics["loss"])
+
+    loss_ref = run(1)
+    loss_cp = run(2)
+    assert np.isfinite(loss_cp)
+    np.testing.assert_allclose(loss_cp, loss_ref, rtol=1e-4, atol=1e-4)
+    # pipeline (pp=2) combined with ring attention (cp=2)
+    loss_pp_cp = run(2, pp=2, dp=1)
+    np.testing.assert_allclose(loss_pp_cp, loss_ref, rtol=1e-3, atol=1e-3)
