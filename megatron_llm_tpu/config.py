@@ -117,6 +117,17 @@ class ModelConfig:
     # K/V blocks rotated with ppermute (parallel/ring_attention.py).  Set by
     # the runtime when ParallelConfig.context_parallel > 1.
     context_parallel_axis: Optional[str] = None
+    # Mixture-of-experts (extension beyond the reference, which has no MoE —
+    # SURVEY §2.1 checklist).  num_experts == 0 → dense MLP everywhere.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coeff: float = 0.01
+    # Routing group size (GShard grouping): capacity and the [*, g, E, C]
+    # dispatch tensors are per-group, keeping dispatch cost linear in seq
+    # length.  The effective group is the largest divisor of the (local)
+    # sequence length ≤ this bound.
+    moe_group_size: int = 512
     # Parallel-friendly sequence length used for activation layouts.
     seq_length: int = 4096
     # lm head
@@ -159,6 +170,13 @@ class ModelConfig:
         assert self.num_attention_heads % self.kv_heads == 0
         if self.parallel_layernorm:
             assert self.parallel_attn, "parallel_layernorm requires parallel_attn"
+        if self.num_experts > 0:
+            assert 1 <= self.moe_top_k <= self.num_experts, (
+                f"moe_top_k {self.moe_top_k} must be in "
+                f"[1, num_experts={self.num_experts}]")
+            assert not self.use_bias, (
+                "MoE MLPs are bias-free (models/moe.py); use_bias=True with "
+                "num_experts > 0 is not supported")
         return self
 
 
@@ -204,6 +222,7 @@ class ParallelConfig:
             * self.pipeline_parallel
             * self.tensor_parallel
             * self.context_parallel
+            * self.expert_parallel
         )
 
     def validate(self) -> "ParallelConfig":
@@ -315,6 +334,12 @@ class RuntimeConfig:
             object.__setattr__(
                 self, "model",
                 dataclasses.replace(self.model, context_parallel_axis=None))
+        if self.parallel.expert_parallel > 1:
+            assert self.model.num_experts > 0, (
+                "expert_parallel > 1 requires a MoE model (num_experts > 0)")
+            assert self.model.num_experts % self.parallel.expert_parallel == 0, (
+                f"num_experts {self.model.num_experts} must divide by "
+                f"expert_parallel {self.parallel.expert_parallel}")
         self.model.validate()
         self.parallel.validate()
         mb = self.train.micro_batch_size

@@ -109,8 +109,14 @@ def forward(
     rng: Optional[jax.Array] = None,
     deterministic: bool = True,
     rope: Optional[tuple] = None,
-) -> jax.Array:
-    """Full forward to logits [b, s, padded_vocab] (fp32)."""
+    return_aux: bool = False,
+):
+    """Full forward to logits [b, s, padded_vocab] (fp32).
+
+    With ``return_aux`` also returns the MoE load-balance aux loss
+    (0 for dense models) — the training loss adds it scaled by
+    ``cfg.moe_aux_loss_coeff``.
+    """
     if rope is None:
         cos, sin = rope_tables(cfg)
     else:
@@ -132,11 +138,14 @@ def forward(
         position_ids=position_ids, segment_ids=segment_ids,
         deterministic=deterministic,
     )
-    x = stack_forward(cfg, params["layers"], x, side, stack_rng)
+    x, moe_aux = stack_forward(cfg, params["layers"], x, side, stack_rng)
     x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps,
                    impl=cfg.norm_impl)
     logits = unembed(cfg, params, x)
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if return_aux:
+        return logits, moe_aux
+    return logits
 
 
 def forward_cached(
@@ -196,12 +205,16 @@ def flops_per_token(cfg: ModelConfig, seq_len: int) -> float:
     nkv = cfg.kv_heads
     ffn = cfg.ffn_size
     n_mlp_mat = 3 if cfg.is_glu else 2
+    # MoE: each token activates top_k experts' MLPs (+ the router matmul)
+    mlp_mult = cfg.moe_top_k if cfg.num_experts > 0 else 1
+    router = 2 * h * cfg.num_experts if cfg.num_experts > 0 else 0
     per_layer = (
         2 * h * (nq * d)  # wq
         + 2 * h * (nkv * d) * 2  # wk, wv
         + 2 * (nq * d) * h  # wo
         + 2 * 2 * nq * d * seq_len  # attention scores + context (causal ÷2 *2)
-        + n_mlp_mat * 2 * h * ffn  # mlp matmuls
+        + mlp_mult * n_mlp_mat * 2 * h * ffn  # mlp matmuls
+        + router
     )
     head = 2 * h * cfg.padded_vocab_size()
     return float(L * per_layer + head)

@@ -1,0 +1,126 @@
+"""Mixture-of-experts MLP with expert parallelism over the ``ep`` mesh axis.
+
+Capability extension: the reference fork has **no MoE anywhere**
+(SURVEY §2.1 parallelism checklist, "EP ❌"), so there is no CUDA pattern to
+mirror.  The design is the TPU-idiomatic GShard/Switch formulation: routing
+is expressed as dense one-hot dispatch/combine einsums so the whole layer is
+static-shaped (XLA requirement) and the expert dimension of the weights is
+sharded over ``ep`` — GSPMD turns the dispatch einsums into the
+all-to-alls a CUDA implementation would hand-write.
+
+Routing: token-choice top-k with capacity.  Each batch row dispatches at
+most ``capacity = ceil(top_k · s · capacity_factor / E)`` tokens to each
+expert; overflow tokens lose that expert's contribution (their gate weight
+is dropped — the standard Switch overflow semantics).  The auxiliary
+load-balance loss is the Switch/GShard one: ``E · Σ_e f_e · p̄_e`` with
+``f_e`` the fraction of dispatched (token, choice) pairs hitting expert e
+and ``p̄_e`` the mean router probability of e.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..ops.activations import get_activation, is_glu
+
+Params = dict
+
+
+def init_moe_params(key: jax.Array, cfg: ModelConfig) -> Params:
+    """Expert-stacked MLP weights [E, ...] + router [h, E]."""
+    h = cfg.hidden_size
+    f = cfg.ffn_size
+    E = cfg.num_experts
+    dtype = cfg.dtype
+    std = cfg.init_method_std
+    out_std = std / (2.0 * cfg.num_layers) ** 0.5 if cfg.use_scaled_init else std
+    keys = jax.random.split(key, 4)
+
+    def normal(k, shape, s):
+        return (s * jax.random.normal(k, shape, jnp.float32)).astype(dtype)
+
+    p: Params = {
+        # router kept in fp32: routing decisions are precision-sensitive
+        "router": std * jax.random.normal(keys[0], (h, E), jnp.float32),
+        "w_up": normal(keys[2], (E, h, f), std),
+        "w_down": normal(keys[3], (E, f, h), out_std),
+    }
+    if is_glu(cfg.activation):
+        p["w_gate"] = normal(keys[1], (E, h, f), std)
+    return p
+
+
+def capacity(cfg: ModelConfig, group_len: int) -> int:
+    return max(1, math.ceil(
+        cfg.moe_top_k * group_len * cfg.moe_capacity_factor
+        / cfg.num_experts))
+
+
+def group_size(cfg: ModelConfig, seq_len: int) -> int:
+    """Largest divisor of ``seq_len`` ≤ cfg.moe_group_size."""
+    g = min(cfg.moe_group_size, seq_len)
+    while seq_len % g:
+        g -= 1
+    return g
+
+
+def moe_block(cfg: ModelConfig, p: Params, x: jax.Array):
+    """Routed MLP: returns ``(out [b,s,h], aux_loss scalar fp32)``.
+
+    The sequence is split into routing groups (GShard grouping): capacity
+    and the [*, g, E, C] dispatch/combine tensors are per-group, so dispatch
+    cost stays linear in sequence length.
+    """
+    b_in, s_in, h = x.shape
+    g = group_size(cfg, s_in)
+    x = x.reshape(b_in * (s_in // g), g, h)
+    b, s, _ = x.shape
+    E = cfg.num_experts
+    k = cfg.moe_top_k
+    C = capacity(cfg, s)
+    act = get_activation(cfg.activation)
+
+    router_logits = x.astype(jnp.float32) @ p["router"]  # [b, s, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [b, s, k]
+    if k > 1:
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    # Position-in-expert bookkeeping, priority by choice order then sequence
+    # order; tokens past capacity are dropped for that expert.
+    dispatch = jnp.zeros((b, s, E, C), jnp.float32)
+    combine = jnp.zeros((b, s, E, C), jnp.float32)
+    counts = jnp.zeros((b, E), jnp.float32)
+    frac_dispatched = jnp.zeros((E,), jnp.float32)
+    for j in range(k):
+        onehot = jax.nn.one_hot(gate_idx[..., j], E, dtype=jnp.float32)
+        pos = jnp.cumsum(onehot, axis=1) - onehot + counts[:, None]  # [b,s,E]
+        counts = counts + jnp.sum(onehot, axis=1)
+        within = (pos < C).astype(jnp.float32) * onehot
+        frac_dispatched = frac_dispatched + jnp.sum(onehot, axis=(0, 1))
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+        sel = within[..., None] * slot  # [b, s, E, C]
+        dispatch = dispatch + sel
+        combine = combine + gate_vals[..., j][..., None, None] * sel
+
+    # Switch aux loss over *assignments* (capacity-independent so its
+    # gradient pushes the router toward balance even when nothing is
+    # dropped): f_e over all (token, choice) pairs, p̄_e over tokens.
+    f_e = frac_dispatched / (b * s * k)
+    p_e = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(f_e * p_e)
+
+    xin = jnp.einsum("bsec,bsh->ebch", dispatch.astype(x.dtype), x)
+    if is_glu(cfg.activation):
+        gate = jnp.einsum("ebch,ehf->ebcf", xin, p["w_gate"])
+        up = jnp.einsum("ebch,ehf->ebcf", xin, p["w_up"])
+        hidden = act(jnp.concatenate([gate, up], axis=-1))
+    else:
+        hidden = act(jnp.einsum("ebch,ehf->ebcf", xin, p["w_up"]))
+    xout = jnp.einsum("ebcf,efh->ebch", hidden, p["w_down"])
+    out = jnp.einsum("ebch,bsec->bsh", xout, combine.astype(x.dtype))
+    return out.reshape(b_in, s_in, h), aux

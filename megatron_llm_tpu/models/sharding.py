@@ -33,6 +33,7 @@ TP = "tp"
 PP = "pp"
 DP = "dp"
 CP = "cp"
+EP = "ep"
 
 
 def _layer_specs(cfg: ModelConfig, layer_axis: Optional[str],
@@ -56,16 +57,26 @@ def _layer_specs(cfg: ModelConfig, layer_axis: Optional[str],
     if cfg.use_bias:
         attn["bo"] = P(L, None)
 
-    mlp = {}
-    if cfg.is_glu:
-        mlp["w_gate"] = P(L, None, TP)
-    mlp["w_up"] = P(L, None, TP)
-    mlp["w_down"] = P(L, TP, None)
-    if cfg.use_bias:
+    if cfg.num_experts > 0:
+        # Expert-stacked weights [E, h, f]: experts over 'ep', ffn over 'tp';
+        # GSPMD inserts the token all-to-alls from the dispatch einsums
+        # (models/moe.py).  Router stays replicated (tiny, fp32).
+        mlp = {"router": P(L, None, None)}
         if cfg.is_glu:
-            mlp["b_gate"] = P(L, TP)
-        mlp["b_up"] = P(L, TP)
-        mlp["b_down"] = P(L, None)
+            mlp["w_gate"] = P(L, EP, None, TP)
+        mlp["w_up"] = P(L, EP, None, TP)
+        mlp["w_down"] = P(L, EP, TP, None)
+    else:
+        mlp = {}
+        if cfg.is_glu:
+            mlp["w_gate"] = P(L, None, TP)
+        mlp["w_up"] = P(L, None, TP)
+        mlp["w_down"] = P(L, TP, None)
+        if cfg.use_bias:
+            if cfg.is_glu:
+                mlp["b_gate"] = P(L, TP)
+            mlp["b_up"] = P(L, TP)
+            mlp["b_down"] = P(L, None)
 
     def norm_spec():
         s = {"scale": P(L, None)}

@@ -76,18 +76,23 @@ def init_layer_params(key: jax.Array, cfg: ModelConfig) -> Params:
     if cfg.use_bias:
         attn["bo"] = jnp.zeros((h,), dtype)
 
-    mlp: Params = {}
-    if is_glu(cfg.activation):
-        mlp["w_gate"] = _normal(keys[4], (h, ffn), std, dtype)
-        mlp["w_up"] = _normal(keys[5], (h, ffn), std, dtype)
+    if cfg.num_experts > 0:
+        from .moe import init_moe_params
+
+        mlp: Params = init_moe_params(keys[4], cfg)
     else:
-        mlp["w_up"] = _normal(keys[5], (h, ffn), std, dtype)
-    mlp["w_down"] = _normal(keys[6], (ffn, h), out_std, dtype)
-    if cfg.use_bias:
+        mlp = {}
         if is_glu(cfg.activation):
-            mlp["b_gate"] = jnp.zeros((ffn,), dtype)
-        mlp["b_up"] = jnp.zeros((ffn,), dtype)
-        mlp["b_down"] = jnp.zeros((h,), dtype)
+            mlp["w_gate"] = _normal(keys[4], (h, ffn), std, dtype)
+            mlp["w_up"] = _normal(keys[5], (h, ffn), std, dtype)
+        else:
+            mlp["w_up"] = _normal(keys[5], (h, ffn), std, dtype)
+        mlp["w_down"] = _normal(keys[6], (ffn, h), out_std, dtype)
+        if cfg.use_bias:
+            if is_glu(cfg.activation):
+                mlp["b_gate"] = jnp.zeros((ffn,), dtype)
+            mlp["b_up"] = jnp.zeros((ffn,), dtype)
+            mlp["b_down"] = jnp.zeros((h,), dtype)
 
     layer: Params = {
         "input_norm": norm_init(cfg.norm_type, h, dtype),
@@ -250,14 +255,23 @@ def mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
     return out
 
 
+def _mlp_dispatch(cfg: ModelConfig, p: Params, x: jax.Array):
+    """Dense or routed MLP → ``(out, aux_loss)`` (aux is 0 for dense)."""
+    if cfg.num_experts > 0:
+        from .moe import moe_block
+
+        return moe_block(cfg, p, x)
+    return mlp_block(cfg, p, x), jnp.zeros((), jnp.float32)
+
+
 def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
                   side: AttnSideInputs, layer_rng=None,
                   kv_cache: Optional[tuple] = None):
     """One pre-LN residual block, sequential or Falcon-parallel.
 
     Parity: megatron/model/transformer.py:695-817
-    (ParallelTransformerLayer.forward).  With ``kv_cache`` returns
-    ``(out, new_cache)``.
+    (ParallelTransformerLayer.forward).  Returns ``(out, moe_aux)``; with
+    ``kv_cache`` returns ``(out, moe_aux, new_cache)``.
     """
     residual = x
     h1 = norm_apply(cfg.norm_type, x, p["input_norm"], cfg.norm_eps,
@@ -276,7 +290,7 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
                                 cfg.norm_eps, impl=cfg.norm_impl)
         else:
             mlp_in = h1
-        mlp_out = mlp_block(cfg, p["mlp"], mlp_in)
+        mlp_out, aux = _mlp_dispatch(cfg, p["mlp"], mlp_in)
         out = attn_out + mlp_out
         if layer_rng is not None:
             out = _dropout(out, cfg.hidden_dropout,
@@ -290,14 +304,14 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
         x = residual + a
         h2 = norm_apply(cfg.norm_type, x, p["post_attn_norm"],
                         cfg.norm_eps, impl=cfg.norm_impl)
-        m = mlp_block(cfg, p["mlp"], h2)
+        m, aux = _mlp_dispatch(cfg, p["mlp"], h2)
         if layer_rng is not None:
             m = _dropout(m, cfg.hidden_dropout,
                          jax.random.fold_in(layer_rng, 3), det)
         result = x + m
     if kv_cache is not None:
-        return result, new_cache
-    return result
+        return result, aux, new_cache
+    return result, aux
 
 
 def _remat_policy(cfg: ModelConfig):
@@ -312,17 +326,21 @@ def _remat_policy(cfg: ModelConfig):
 
 
 def stack_forward(cfg: ModelConfig, stacked: Params, x: jax.Array,
-                  side: AttnSideInputs, base_rng=None) -> jax.Array:
-    """Run all layers with lax.scan over the stacked parameter pytree."""
+                  side: AttnSideInputs, base_rng=None):
+    """Run all layers with lax.scan over the stacked parameter pytree.
+
+    Returns ``(hidden, moe_aux)`` — the aux load-balance loss summed over
+    layers (0 for dense models).
+    """
 
     def body(carry, inp):
-        h, idx = carry
+        h, idx, aux_sum = carry
         layer_params, = inp
         rng = None
         if base_rng is not None:
             rng = jax.random.fold_in(base_rng, idx)
-        h = layer_forward(cfg, layer_params, h, side, rng)
-        return (h, idx + 1), None
+        h, aux = layer_forward(cfg, layer_params, h, side, rng)
+        return (h, idx + 1, aux_sum + aux), None
 
     policy = _remat_policy(cfg)
     if policy is not None:
@@ -330,8 +348,9 @@ def stack_forward(cfg: ModelConfig, stacked: Params, x: jax.Array,
     elif cfg.recompute != "none":
         body = jax.checkpoint(body, prevent_cse=False)
 
-    (x, _), _ = jax.lax.scan(body, (x, 0), (stacked,))
-    return x
+    (x, _, aux), _ = jax.lax.scan(
+        body, (x, 0, jnp.zeros((), jnp.float32)), (stacked,))
+    return x, aux
 
 
 def stack_forward_cached(cfg: ModelConfig, stacked: Params, x: jax.Array,
@@ -350,8 +369,8 @@ def stack_forward_cached(cfg: ModelConfig, stacked: Params, x: jax.Array,
 
     def body(h, inp):
         layer_params, kc, vc = inp
-        h, (kc, vc) = layer_forward(cfg, layer_params, h, side, None,
-                                    kv_cache=(kc, vc, cache_len))
+        h, _aux, (kc, vc) = layer_forward(cfg, layer_params, h, side, None,
+                                          kv_cache=(kc, vc, cache_len))
         return h, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (stacked, k_cache, v_cache))

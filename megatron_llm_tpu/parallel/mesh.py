@@ -27,8 +27,10 @@ from ..config import ParallelConfig
 DATA_AXIS = "dp"
 PIPELINE_AXIS = "pp"
 CONTEXT_AXIS = "cp"
+EXPERT_AXIS = "ep"
 TENSOR_AXIS = "tp"
-AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, EXPERT_AXIS,
+              TENSOR_AXIS)
 
 
 def build_mesh(
@@ -48,6 +50,7 @@ def build_mesh(
         parallel.data_parallel,
         parallel.pipeline_parallel,
         parallel.context_parallel,
+        parallel.expert_parallel,
         parallel.tensor_parallel,
     )
     n = int(np.prod(shape))
@@ -70,7 +73,7 @@ def build_mesh(
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     if device is None:
         device = jax.devices()[0]
-    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), AXIS_ORDER)
+    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1, 1), AXIS_ORDER)
 
 
 # ---------------------------------------------------------------------------
@@ -96,6 +99,10 @@ def data_parallel_size(mesh: Mesh) -> int:
 
 def context_parallel_size(mesh: Mesh) -> int:
     return axis_size(mesh, CONTEXT_AXIS)
+
+
+def expert_parallel_size(mesh: Mesh) -> int:
+    return axis_size(mesh, EXPERT_AXIS)
 
 
 def pipeline_stage_layers(num_layers: int, pp: int, vpp: int = 1) -> list[int]:
@@ -191,4 +198,5 @@ class MeshAxes:
     dp: str = DATA_AXIS
     pp: str = PIPELINE_AXIS
     cp: str = CONTEXT_AXIS
+    ep: str = EXPERT_AXIS
     tp: str = TENSOR_AXIS

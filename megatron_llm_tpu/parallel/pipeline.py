@@ -160,10 +160,12 @@ def pipeline_apply(
     pp: int,
     vpp: int = 1,
     rng: Optional[jax.Array] = None,
-) -> jax.Array:
+) -> tuple:
     """Run all M microbatches through the pipelined decoder stack.
 
-    Returns [M, mb, s, h] final hidden states, replicated over 'pp'.
+    Returns ``(hidden [M, mb, s, h] replicated over 'pp', moe_aux scalar)``
+    — moe_aux sums the per-layer MoE load-balance losses over all layers and
+    microbatches (0 for dense models).
     """
     M = x_mb.shape[0]
     if vpp > 1:
@@ -195,7 +197,7 @@ def pipeline_apply(
                 if vpp > 1 else None)
 
         def tick(carry, t):
-            state, circ, outputs = carry
+            state, circ, outputs, aux_sum = carry
             # Which microbatch / chunk this stage works on at tick t.
             rel = t - stage  # ticks since this stage first saw work
             m_idx = jnp.clip(rel, 0, None) % M
@@ -233,8 +235,12 @@ def pipeline_apply(
                 deterministic=side_all.deterministic,
             )
 
-            out = _stage_tick(cfg, chunks_local, chunk_idx, current,
-                              sel_side, tick_rng)
+            out, tick_aux = _stage_tick(cfg, chunks_local, chunk_idx,
+                                        current, sel_side, tick_rng)
+            # Bubble ticks (warmup garbage / cooldown re-runs) must not
+            # contribute MoE aux loss.
+            tick_valid = (rel >= 0) & (rel < M * vpp)
+            aux_sum = aux_sum + jnp.where(tick_valid, tick_aux, 0.0)
 
             # Last stage collects finished microbatches (final chunk only).
             out_idx = t - (vpp - 1) * M - (pp - 1)
@@ -259,10 +265,11 @@ def pipeline_apply(
                 circ = jax.lax.dynamic_update_index_in_dim(
                     circ, jnp.where(c_valid, shifted, c_existing), c_idx, 0)
 
-            return (shifted, circ, outputs), None
+            return (shifted, circ, outputs, aux_sum), None
 
-        init = (jnp.zeros(mb_shape, x_all.dtype), circ, outputs)
-        (_, _, outputs), _ = jax.lax.scan(tick, init, jnp.arange(T))
+        init = (jnp.zeros(mb_shape, x_all.dtype), circ, outputs,
+                jnp.zeros((), jnp.float32))
+        (_, _, outputs, aux_sum), _ = jax.lax.scan(tick, init, jnp.arange(T))
 
         # Only the last stage's buffer holds real data; make the result
         # invariant over 'pp' with a masked psum (cheap: [M, mb, s, h] once).
@@ -272,7 +279,13 @@ def pipeline_apply(
         # noise next to the per-tick ring traffic.
         mask = (stage == pp - 1).astype(jnp.float32)
         out32 = jax.lax.psum(outputs.astype(jnp.float32) * mask, PP)
-        return out32.astype(outputs.dtype)
+        # Each (stage, chunk) processed every microbatch exactly once, so
+        # the pp-sum of the local aux sums covers all L layers × M
+        # microbatches; cp shards see equal token slices → mean over cp.
+        aux = jax.lax.psum(aux_sum, PP)
+        if cp_axis is not None:
+            aux = jax.lax.pmean(aux, cp_axis)
+        return out32.astype(outputs.dtype), aux
 
     layer_in_specs = jax.tree.map(
         lambda _: P(None, PP), staged_layers)
@@ -297,7 +310,7 @@ def pipeline_apply(
         pipelined,
         mesh=mesh,
         in_specs=(layer_in_specs, x_spec, side_spec, side_spec),
-        out_specs=x_spec,
+        out_specs=(x_spec, P()),
         axis_names=manual_axes,
         check_vma=False,
     )
@@ -305,8 +318,8 @@ def pipeline_apply(
     # 'pp'; cross the boundary in f32 — partial-auto shard_map lowers bf16
     # all-reduces to a form that crashes XLA:CPU's AllReducePromotion pass
     # (jax 0.9.0), and f32 here also gives exact cotangent accumulation.
-    out = fn(staged_layers, x_mb.astype(jnp.float32), pos, seg)
-    return out.astype(compute_dtype)
+    out, moe_aux = fn(staged_layers, x_mb.astype(jnp.float32), pos, seg)
+    return out.astype(compute_dtype), moe_aux
 
 
 # ---------------------------------------------------------------------------
@@ -386,7 +399,7 @@ def pipeline_loss(
         deterministic=deterministic,
     )
 
-    h_mb = pipeline_apply(
+    h_mb, moe_aux = pipeline_apply(
         model_cfg, params["layers"], x_mb, side_mb,
         mesh=mesh, pp=pp, vpp=vpp, rng=stack_rng,
     )
@@ -414,4 +427,9 @@ def pipeline_loss(
         head, jnp.zeros((), jnp.float32),
         (h_mb, batch["labels"], batch["loss_mask"]),
     )
-    return total / M
+    loss = total / M
+    if model_cfg.num_experts > 0:
+        # moe_aux sums over all layers and microbatches; per-microbatch mean
+        # matches the non-pipelined compute_loss accounting.
+        loss = loss + model_cfg.moe_aux_loss_coeff * moe_aux / M
+    return loss

@@ -62,16 +62,18 @@ def compute_loss(cfg: RuntimeConfig, params, batch: dict, rng=None,
     supports the instruction-tuning scalar-weighted masks of
     finetune.py:148-161), optional position_ids/segment_ids.
     """
-    logits = model_lib.forward(
+    logits, moe_aux = model_lib.forward(
         cfg.model, params, batch["tokens"],
         position_ids=batch.get("position_ids"),
         segment_ids=batch.get("segment_ids"),
-        rng=rng, deterministic=deterministic, rope=rope,
+        rng=rng, deterministic=deterministic, rope=rope, return_aux=True,
     )
     per_token = cross_entropy(
         logits, batch["labels"], vocab_size=cfg.model.vocab_size
     )
     loss = masked_mean_loss(per_token, batch["loss_mask"])
+    if cfg.model.num_experts > 0:
+        loss = loss + cfg.model.moe_aux_loss_coeff * moe_aux
     return loss
 
 

@@ -26,7 +26,8 @@ def _ref_ce(logits, targets):
 
 @pytest.fixture
 def tp_mesh(devices):
-    return Mesh(np.asarray(devices).reshape(1, 1, 1, 8), ("dp", "pp", "cp", "tp"))
+    return Mesh(np.asarray(devices).reshape(1, 1, 1, 1, 8),
+                ("dp", "pp", "cp", "ep", "tp"))
 
 
 def test_cross_entropy_matches_numpy(rng):

@@ -100,8 +100,8 @@ def test_reshard_on_load(tmp_path, devices):
     params = model_lib.init_params(jax.random.key(0), mcfg, tp=8)
     ckpt.save_release_params(str(tmp_path), params)
 
-    mesh = Mesh(np.asarray(devices).reshape(1, 1, 1, 8),
-                ("dp", "pp", "cp", "tp"))
+    mesh = Mesh(np.asarray(devices).reshape(1, 1, 1, 1, 8),
+                ("dp", "pp", "cp", "ep", "tp"))
     pspecs = shard_lib.param_specs(mcfg, ParallelConfig(tensor_parallel=8))
     template = jax.tree.map(
         lambda x, s: jax.ShapeDtypeStruct(
