@@ -141,12 +141,16 @@ def _stage_tick(cfg: ModelConfig, chunks: PyTree, chunk_idx, x, side,
     fp32 main_grad accumulation (megatron/model/distributed.py:75-200,
     fused wgrad accum fused_weight_gradient_dense.cu).
     """
-    chunk = jax.tree.map(
-        lambda c: jax.lax.dynamic_index_in_dim(c, chunk_idx, 0,
-                                               keepdims=False).astype(
-                                                   cfg.dtype),
-        chunks,
-    )
+    def index_and_cast(path, c):
+        c = jax.lax.dynamic_index_in_dim(c, chunk_idx, 0, keepdims=False)
+        # The MoE router deliberately stays fp32 (models/moe.py:
+        # routing decisions are precision-sensitive) — don't round it to the
+        # compute dtype like the matmul weights.
+        if path and getattr(path[-1], "key", None) == "router":
+            return c
+        return c.astype(cfg.dtype)
+
+    chunk = jax.tree_util.tree_map_with_path(index_and_cast, chunks)
     return stack_forward(cfg, chunk, x, side, rng)
 
 

@@ -1,0 +1,1 @@
+"""Downstream evaluation tasks (reference: tasks/ — zero-shot LM eval)."""

@@ -1,0 +1,241 @@
+"""Zero-shot LM evaluation: wikitext-style perplexity + LAMBADA accuracy.
+
+Parity with the reference's tasks/zeroshot_gpt (evaluate.py:73-211,
+datasets.py:28-141):
+
+- **loss / perplexity**: a long token stream is cut into overlapping (or
+  disjoint) windows of seq_len+1; the per-token LM loss is summed over the
+  non-overlap targets and perplexity reported as exp(total / num_targets).
+  The "adjusted" perplexity renormalizes by the original (pre-tokenizer)
+  word count, as the reference does for wikitext.
+- **accuracy (LAMBADA cloze)**: each example is (context, target tokens);
+  a prediction counts only if *every* target token is the argmax under
+  teacher forcing (evaluate.py:104-109's masked prod).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import re
+from typing import Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..models import model as model_lib
+from ..parallel.cross_entropy import cross_entropy
+
+
+# ---------------------------------------------------------------------------
+# Batch construction (reference datasets.py:28-113)
+# ---------------------------------------------------------------------------
+
+
+def lm_windows(tokens: Sequence[int], seq_len: int, pad_idx: int,
+               overlapping_eval: Optional[int] = None):
+    """Cut a token stream into [seq_len+1] windows with target pad masks.
+
+    ``overlapping_eval`` strides windows by fewer than seq_len tokens and
+    masks the overlap so every target is scored exactly once.
+    """
+    stride = max(1, overlapping_eval or seq_len)
+    total_targets = len(tokens) - 1
+    n_windows = max(math.ceil(max(total_targets - stride, 0) / stride) + 1, 1)
+    for idx in range(n_windows):
+        start = idx * stride
+        window = list(tokens[start:start + seq_len + 1])
+        mask = [1.0] * len(window)
+        if len(window) < seq_len + 1:
+            pad = seq_len + 1 - len(window)
+            window += [pad_idx] * pad
+            mask += [0.0] * pad
+        mask = np.asarray(mask[1:], np.float32)
+        if stride != seq_len and idx != 0:
+            mask[:-stride] = 0.0
+        yield np.asarray(window, np.int64), mask
+
+
+def lambada_example(text: str, tokenizer, strict: bool = False):
+    """(context tokens, target tokens) for one LAMBADA line
+    (reference datasets.py:85-93)."""
+    if not strict:
+        ids = tokenizer.tokenize(text)
+        return list(ids[:-1]), [int(ids[-1])]
+    last_word = text.split()[-1]
+    start = text.rfind(last_word)
+    ctx = tokenizer.tokenize(text[:start].strip())
+    tgt = tokenizer.tokenize(" " + last_word)
+    return list(ctx), list(tgt)
+
+
+def cloze_window(context: Sequence[int], target: Sequence[int],
+                 seq_len: int, pad_idx: int):
+    """Tokens [seq_len+1] + mask selecting only the target positions."""
+    toks = list(context) + list(target)
+    mask = [0.0] * len(context) + [1.0] * len(target)
+    if len(toks) > seq_len + 1:  # keep the tail; targets are at the end
+        toks = toks[-(seq_len + 1):]
+        mask = mask[-(seq_len + 1):]
+    if len(toks) < seq_len + 1:
+        pad = seq_len + 1 - len(toks)
+        toks += [pad_idx] * pad
+        mask += [0.0] * pad
+    return np.asarray(toks, np.int64), np.asarray(mask[1:], np.float32)
+
+
+def _batched(windows: Iterable[tuple], batch_size: int):
+    toks, masks = [], []
+    for t, m in windows:
+        toks.append(t)
+        masks.append(m)
+        if len(toks) == batch_size:
+            yield np.stack(toks), np.stack(masks)
+            toks, masks = [], []
+    if toks:
+        while len(toks) < batch_size:  # pad the final batch
+            toks.append(np.zeros_like(toks[0]))
+            masks.append(np.zeros_like(masks[0]))
+        yield np.stack(toks), np.stack(masks)
+
+
+# ---------------------------------------------------------------------------
+# Evaluation drivers (reference evaluate.py:116-211)
+# ---------------------------------------------------------------------------
+
+
+def evaluate_loss(cfg: ModelConfig, params, windows, batch_size: int = 8,
+                  num_original_tokens: Optional[int] = None) -> dict:
+    """Sum masked LM loss over all windows → perplexity report."""
+
+    @jax.jit
+    def step(p, toks, mask):
+        logits = model_lib.forward(cfg, p, toks[:, :-1])
+        per_tok = cross_entropy(logits, toks[:, 1:],
+                                vocab_size=cfg.vocab_size)
+        return jnp.sum(per_tok * mask), jnp.sum(mask)
+
+    total, count = 0.0, 0.0
+    for toks, mask in _batched(windows, batch_size):
+        l, c = step(params, jnp.asarray(toks), jnp.asarray(mask))
+        total += float(l)
+        count += float(c)
+    avg = total / max(count, 1.0)
+    report = {
+        "total_loss": total,
+        "num_targets": int(count),
+        "avg_loss": avg,
+        "ppl": math.exp(min(20.0, avg)),
+    }
+    if num_original_tokens is not None:
+        # wikitext adjusted ppl: renormalize to the pre-tokenization word
+        # count (reference evaluate.py:164-172)
+        report["adjusted_ppl"] = math.exp(
+            min(20.0, total / max(num_original_tokens - 1, 1)))
+    return report
+
+
+def evaluate_accuracy(cfg: ModelConfig, params, windows,
+                      batch_size: int = 8) -> dict:
+    """Strict cloze accuracy: all target tokens must be argmax-correct."""
+
+    @jax.jit
+    def step(p, toks, mask):
+        logits = model_lib.forward(cfg, p, toks[:, :-1])
+        logits = logits[..., : cfg.vocab_size]
+        pred = jnp.argmax(logits, axis=-1)
+        ok = (pred == toks[:, 1:]) | (mask == 0.0)
+        correct = jnp.all(ok, axis=-1) & (jnp.sum(mask, -1) > 0)
+        return jnp.sum(correct.astype(jnp.int32)), \
+            jnp.sum((jnp.sum(mask, -1) > 0).astype(jnp.int32))
+
+    correct, count = 0, 0
+    for toks, mask in _batched(windows, batch_size):
+        c, n = step(params, jnp.asarray(toks), jnp.asarray(mask))
+        correct += int(c)
+        count += int(n)
+    return {
+        "num_correct": correct,
+        "num_examples": count,
+        "accuracy": correct / max(count, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Detokenizer (reference detokenizer.py — wikitext's inverse tokenization)
+# ---------------------------------------------------------------------------
+
+
+def wikitext_detokenize(text: str) -> str:
+    """Undo wikitext's moses-style tokenization artifacts."""
+    rules = [
+        (r" @-@ ", "-"), (r" @,@ ", ","), (r" @\.@ ", "."),
+        (r" ([\.,;:!?\)\]']|'s|'t|'re|'ve|'m|'ll|'d)", r"\1"),
+        (r"\( ", "("), (r"\[ ", "["), (r" n't", "n't"),
+        (r'" ([^"]*) "', r'"\1"'),
+        (r" {2,}", " "),
+    ]
+    for pat, rep in rules:
+        text = re.sub(pat, rep, text)
+    return text.strip()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--task", required=True, choices=["wikitext", "lambada"])
+    p.add_argument("--load", required=True, help="native checkpoint dir")
+    p.add_argument("--data_path", required=True,
+                   help="wikitext: raw text file; lambada: jsonl with "
+                        "{'text': ...} lines")
+    p.add_argument("--tokenizer_type", default="huggingface")
+    p.add_argument("--tokenizer_model", required=True)
+    p.add_argument("--seq_length", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=8)
+    p.add_argument("--overlapping_eval", type=int, default=None)
+    p.add_argument("--strict_lambada", action="store_true")
+    args = p.parse_args(argv)
+
+    from .. import checkpointing
+    from ..tokenizer.tokenizer import build_tokenizer
+
+    cfg = checkpointing.load_config_from_checkpoint(args.load).model
+    params = checkpointing.load_params_for_inference(args.load, cfg)
+    tokenizer = build_tokenizer(args.tokenizer_type, args.tokenizer_model)
+    seq_len = args.seq_length or cfg.seq_length
+
+    if args.task == "wikitext":
+        raw = open(args.data_path).read()
+        text = wikitext_detokenize(raw)
+        tokens = tokenizer.tokenize(text)
+        windows = lm_windows(tokens, seq_len, tokenizer.pad,
+                             args.overlapping_eval)
+        report = evaluate_loss(
+            cfg, params, windows, args.batch_size,
+            num_original_tokens=len(raw.split()))
+    else:
+        examples = []
+        for line in open(args.data_path):
+            line = line.strip()
+            if not line:
+                continue
+            text = json.loads(line)["text"]
+            ctx, tgt = lambada_example(text, tokenizer,
+                                       strict=args.strict_lambada)
+            examples.append(cloze_window(ctx, tgt, seq_len, tokenizer.pad))
+        report = evaluate_accuracy(cfg, params, iter(examples),
+                                   args.batch_size)
+
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,203 @@
+"""Checkpoint conversion / resharding utility.
+
+Reference parity: tools/checkpoint_util.py re-topologizes a Megatron
+checkpoint to a different TP×PP layout via loader/saver subprocesses
+(checkpoint_util.py:1-152).  Native checkpoints here are sharding-agnostic
+orbax global arrays, so resharding is implicit at load time — the remaining
+jobs are format/dtype conversion:
+
+  hf-to-native   HF weights → release checkpoint (+ config.json)
+                 (reference weights_conversion/hf_to_megatron.py)
+  native-to-hf   native checkpoint → HF model directory
+                 (reference weights_conversion/megatron_to_hf.py)
+  resave         load any checkpoint (any topology) and rewrite it as a
+                 release checkpoint, optionally casting dtype — the moral
+                 equivalent of reshard-to-tp1pp1
+
+Usage:
+  python -m megatron_llm_tpu.tools.checkpoint_util hf-to-native \
+      --hf_path meta-llama/Llama-2-7b-hf --output /ckpts/llama2-7b
+  python -m megatron_llm_tpu.tools.checkpoint_util native-to-hf \
+      --load /ckpts/run1 --hf_base meta-llama/Llama-2-7b-hf --output /out/hf
+  python -m megatron_llm_tpu.tools.checkpoint_util resave \
+      --load /ckpts/run1 --output /ckpts/run1-release --dtype bfloat16
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+from .. import checkpointing
+from ..config import RuntimeConfig, ModelConfig
+from . import hf_interop
+
+
+def hf_to_native(hf_path: str, output: str, family: Optional[str] = None,
+                 dtype: str = "float32") -> None:
+    import transformers
+
+    hf_model = transformers.AutoModelForCausalLM.from_pretrained(hf_path)
+    family = family or hf_model.config.model_type
+    cfg = hf_interop.config_from_hf(hf_model.config, family,
+                                    params_dtype=dtype)
+    converter = hf_interop.CONVERTERS_FROM_HF[family]
+    np_dtype = np.float32 if dtype == "float32" else getattr(
+        __import__("ml_dtypes"), "bfloat16")
+    params = converter(hf_model.state_dict(), cfg, dtype=np_dtype)
+    run_cfg = RuntimeConfig(model=cfg)
+    checkpointing.save_release_params(output, params, run_cfg)
+    print(f"wrote release checkpoint: {output} "
+          f"({sum(p.size for p in _leaves(params)):,} params)")
+
+
+def native_to_hf(load: str, output: str, hf_base: Optional[str] = None,
+                 family: Optional[str] = None,
+                 iteration: Optional[str] = None) -> None:
+    import torch
+    import transformers
+
+    cfg = checkpointing.load_config_from_checkpoint(load, iteration)
+    model_cfg = cfg.model
+    if family is None:
+        family = _infer_family(model_cfg)
+    params = checkpointing.load_params_for_inference(
+        load, model_cfg, int(iteration) if (iteration or "").isdigit()
+        else iteration)
+    converter = hf_interop.CONVERTERS_TO_HF[family]
+    sd = {k: torch.tensor(np.asarray(v, np.float32))
+          for k, v in converter(params, model_cfg).items()}
+    if hf_base is not None:
+        hf_cfg = transformers.AutoConfig.from_pretrained(hf_base)
+    else:
+        hf_cfg = _hf_config_from_native(model_cfg, family)
+    model = transformers.AutoModelForCausalLM.from_config(hf_cfg)
+    missing, unexpected = model.load_state_dict(sd, strict=False)
+    missing = [m for m in missing if not m.endswith("masked_bias")
+               and not m.endswith(".attn.bias")
+               and not m.endswith("rotary_emb.inv_freq")]
+    assert not missing, f"missing HF keys: {missing[:8]}"
+    assert not unexpected, f"unexpected HF keys: {unexpected[:8]}"
+    model.save_pretrained(output)
+    print(f"wrote HF model: {output}")
+
+
+def resave(load: str, output: str, dtype: Optional[str] = None,
+           iteration: Optional[str] = None) -> None:
+    cfg = checkpointing.load_config_from_checkpoint(load, iteration)
+    model_cfg = cfg.model
+    params = checkpointing.load_params_for_inference(
+        load, model_cfg, int(iteration) if (iteration or "").isdigit()
+        else iteration)
+    if dtype is not None:
+        import jax
+        import jax.numpy as jnp
+
+        target = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else (
+            jnp.float32)
+        params = jax.tree.map(lambda x: np.asarray(x).astype(target), params)
+        import dataclasses
+
+        model_cfg = dataclasses.replace(model_cfg, params_dtype=dtype)
+        cfg = RuntimeConfig(model=model_cfg, parallel=cfg.parallel,
+                            optimizer=cfg.optimizer, train=cfg.train)
+    checkpointing.save_release_params(output, params, cfg)
+    print(f"resaved {load} -> {output} (release)")
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+def _infer_family(cfg: ModelConfig) -> str:
+    if cfg.parallel_attn:
+        return "falcon"
+    if cfg.norm_type == "rmsnorm":
+        return "llama"
+    return "gpt2"
+
+
+def _hf_config_from_native(cfg: ModelConfig, family: str):
+    import transformers
+
+    if family == "llama":
+        return transformers.LlamaConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.ffn_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.kv_heads,
+            max_position_embeddings=cfg.max_position_embeddings,
+            rms_norm_eps=cfg.norm_eps,
+            rope_theta=cfg.rope_theta,
+            tie_word_embeddings=cfg.tie_embed_logits,
+        )
+    if family == "falcon":
+        return transformers.FalconConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_kv_heads=cfg.kv_heads,
+            layer_norm_epsilon=cfg.norm_eps,
+            parallel_attn=cfg.parallel_attn,
+            new_decoder_architecture=cfg.parallel_layernorm,
+            multi_query=cfg.kv_heads == 1,
+            bias=False,
+        )
+    if family == "gpt2":
+        return transformers.GPT2Config(
+            vocab_size=cfg.vocab_size,
+            n_embd=cfg.hidden_size,
+            n_layer=cfg.num_layers,
+            n_head=cfg.num_attention_heads,
+            n_positions=cfg.max_position_embeddings,
+            layer_norm_epsilon=cfg.norm_eps,
+        )
+    raise ValueError(f"unknown family {family!r}")
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    a = sub.add_parser("hf-to-native")
+    a.add_argument("--hf_path", required=True)
+    a.add_argument("--output", required=True)
+    a.add_argument("--model_family", default=None)
+    a.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+
+    b = sub.add_parser("native-to-hf")
+    b.add_argument("--load", required=True)
+    b.add_argument("--output", required=True)
+    b.add_argument("--hf_base", default=None)
+    b.add_argument("--model_family", default=None)
+    b.add_argument("--iteration", default=None)
+
+    c = sub.add_parser("resave")
+    c.add_argument("--load", required=True)
+    c.add_argument("--output", required=True)
+    c.add_argument("--dtype", default=None,
+                   choices=[None, "float32", "bfloat16"])
+    c.add_argument("--iteration", default=None)
+
+    args = p.parse_args(argv)
+    if args.cmd == "hf-to-native":
+        hf_to_native(args.hf_path, args.output, args.model_family,
+                     args.dtype)
+    elif args.cmd == "native-to-hf":
+        native_to_hf(args.load, args.output, args.hf_base,
+                     args.model_family, args.iteration)
+    else:
+        resave(args.load, args.output, args.dtype, args.iteration)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -329,8 +329,186 @@ def gpt2_from_hf(state_dict, cfg: ModelConfig, tp: int = 1,
     return params
 
 
+def _fuse_falcon_qkv(q: Array, k: Array, v: Array, cfg: ModelConfig) -> Array:
+    """Inverse of ``_split_falcon_qkv``: [*, in] rows → HF fused layout."""
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    nkv = cfg.kv_heads
+    group = nq // nkv
+    h_in = q.shape[-1]
+    qg = q.reshape(nkv, group, d, h_in)
+    kg = k.reshape(nkv, 1, d, h_in)
+    vg = v.reshape(nkv, 1, d, h_in)
+    return np.concatenate([qg, kg, vg], axis=1).reshape(-1, h_in)
+
+
+def falcon_to_hf(params: dict, cfg: ModelConfig) -> dict:
+    """Native param pytree → HF FalconForCausalLM state dict.
+
+    Inverse of ``falcon_from_hf`` (reference: megatron_to_hf.py falcon
+    branch), incl. re-permuting interleaved RoPE weights back to HF
+    rotate-half layout and re-fusing QKV.
+    """
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    nkv = cfg.kv_heads
+    v = cfg.vocab_size
+    to_np = lambda x: np.asarray(x, dtype=np.float32)
+
+    sd = {
+        "transformer.word_embeddings.weight":
+            to_np(params["embedding"]["word"])[:v],
+        "transformer.ln_f.weight": to_np(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": to_np(params["final_norm"]["bias"]),
+        "lm_head.weight": to_np(params["embedding"]["word"])[:v],
+    }
+    L = params["layers"]
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        if cfg.parallel_layernorm:
+            sd[p + "ln_attn.weight"] = to_np(L["input_norm"]["scale"][i])
+            sd[p + "ln_attn.bias"] = to_np(L["input_norm"]["bias"][i])
+            sd[p + "ln_mlp.weight"] = to_np(L["mlp_norm"]["scale"][i])
+            sd[p + "ln_mlp.bias"] = to_np(L["mlp_norm"]["bias"][i])
+        else:
+            sd[p + "input_layernorm.weight"] = to_np(
+                L["input_norm"]["scale"][i])
+            sd[p + "input_layernorm.bias"] = to_np(
+                L["input_norm"]["bias"][i])
+        q = interleaved_to_hf(to_np(L["attn"]["wq"][i]).T, nq, d)
+        k = interleaved_to_hf(to_np(L["attn"]["wk"][i]).T, nkv, d)
+        vv = to_np(L["attn"]["wv"][i]).T
+        sd[p + "self_attention.query_key_value.weight"] = _fuse_falcon_qkv(
+            q, k, vv, cfg)
+        sd[p + "self_attention.dense.weight"] = to_np(L["attn"]["wo"][i]).T
+        sd[p + "mlp.dense_h_to_4h.weight"] = to_np(L["mlp"]["w_up"][i]).T
+        sd[p + "mlp.dense_4h_to_h.weight"] = to_np(L["mlp"]["w_down"][i]).T
+    return sd
+
+
+def gpt2_to_hf(params: dict, cfg: ModelConfig) -> dict:
+    """Native param pytree → HF GPT2LMHeadModel state dict (Conv1D layout:
+    weights stay [in, out]).  Inverse of ``gpt2_from_hf``."""
+    v = cfg.vocab_size
+    to_np = lambda x: np.asarray(x, dtype=np.float32)
+    sd = {
+        "transformer.wte.weight": to_np(params["embedding"]["word"])[:v],
+        "transformer.wpe.weight": to_np(params["embedding"]["position"]),
+        "transformer.ln_f.weight": to_np(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": to_np(params["final_norm"]["bias"]),
+        "lm_head.weight": to_np(params["embedding"]["word"])[:v],
+    }
+    L = params["layers"]
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = to_np(L["input_norm"]["scale"][i])
+        sd[p + "ln_1.bias"] = to_np(L["input_norm"]["bias"][i])
+        sd[p + "ln_2.weight"] = to_np(L["post_attn_norm"]["scale"][i])
+        sd[p + "ln_2.bias"] = to_np(L["post_attn_norm"]["bias"][i])
+        sd[p + "attn.c_attn.weight"] = np.concatenate(
+            [to_np(L["attn"]["wq"][i]), to_np(L["attn"]["wk"][i]),
+             to_np(L["attn"]["wv"][i])], axis=1)
+        sd[p + "attn.c_attn.bias"] = np.concatenate(
+            [to_np(L["attn"]["bq"][i]), to_np(L["attn"]["bk"][i]),
+             to_np(L["attn"]["bv"][i])])
+        sd[p + "attn.c_proj.weight"] = to_np(L["attn"]["wo"][i])
+        sd[p + "attn.c_proj.bias"] = to_np(L["attn"]["bo"][i])
+        sd[p + "mlp.c_fc.weight"] = to_np(L["mlp"]["w_up"][i])
+        sd[p + "mlp.c_fc.bias"] = to_np(L["mlp"]["b_up"][i])
+        sd[p + "mlp.c_proj.weight"] = to_np(L["mlp"]["w_down"][i])
+        sd[p + "mlp.c_proj.bias"] = to_np(L["mlp"]["b_down"][i])
+    return sd
+
+
 CONVERTERS_FROM_HF = {
     "llama": llama_from_hf,
     "falcon": falcon_from_hf,
     "gpt2": gpt2_from_hf,
 }
+
+CONVERTERS_TO_HF = {
+    "llama": llama_to_hf,
+    "falcon": falcon_to_hf,
+    "gpt2": gpt2_to_hf,
+}
+
+
+# ---------------------------------------------------------------------------
+# Config derivation (reference: verify_correctness.py + finetune.py read the
+# arch hyperparameters from CLI args; here they come from the HF config)
+# ---------------------------------------------------------------------------
+
+
+def config_from_hf(hf_config, family: str | None = None,
+                   **overrides) -> ModelConfig:
+    """Derive a native ModelConfig from a ``transformers`` config object."""
+    mt = family or getattr(hf_config, "model_type", None)
+    if mt in ("llama", "code_llama"):
+        scaling = getattr(hf_config, "rope_scaling", None) or {}
+        fields = dict(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_attention_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            ffn_hidden_size=hf_config.intermediate_size,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_type="rmsnorm",
+            norm_eps=hf_config.rms_norm_eps,
+            activation="swiglu",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rope_scaling_factor=float(scaling.get("factor", 1.0)),
+            tie_embed_logits=bool(getattr(hf_config, "tie_word_embeddings",
+                                          False)),
+        )
+    elif mt == "falcon":
+        # Only the RoPE, bias-free Falcon variants (7b/40b lineage) are
+        # supported: falcon_from_hf/falcon_to_hf convert no bias tensors and
+        # the model has no ALiBi path (falcon-rw-* would silently produce
+        # wrong logits if accepted).
+        if getattr(hf_config, "alibi", False):
+            raise ValueError("ALiBi Falcon variants (falcon-rw-*) are not "
+                             "supported; only rotary Falcon is")
+        if getattr(hf_config, "bias", False):
+            raise ValueError("Falcon variants with attention bias are not "
+                             "supported by the weight converters")
+        fields = dict(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_attention_heads=hf_config.num_attention_heads,
+            num_kv_heads=(hf_config.num_kv_heads
+                          if getattr(hf_config, "new_decoder_architecture",
+                                     False)
+                          else (1 if getattr(hf_config, "multi_query", True)
+                                else hf_config.num_attention_heads)),
+            ffn_hidden_size=4 * hf_config.hidden_size,
+            max_position_embeddings=2048,
+            norm_type="layernorm",
+            norm_eps=hf_config.layer_norm_epsilon,
+            activation="gelu",
+            parallel_attn=bool(getattr(hf_config, "parallel_attn", True)),
+            parallel_layernorm=bool(getattr(hf_config,
+                                            "new_decoder_architecture",
+                                            False)),
+            tie_embed_logits=True,
+        )
+    elif mt == "gpt2":
+        fields = dict(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            num_layers=hf_config.n_layer,
+            num_attention_heads=hf_config.n_head,
+            ffn_hidden_size=4 * hf_config.n_embd,
+            max_position_embeddings=hf_config.n_positions,
+            norm_type="layernorm",
+            norm_eps=hf_config.layer_norm_epsilon,
+            activation="gelu",
+            position_embedding_type="absolute",
+            use_bias=True,
+            tie_embed_logits=True,
+        )
+    else:
+        raise ValueError(f"unsupported HF model family: {mt!r}")
+    fields.update(overrides)
+    return ModelConfig(**fields).validate()

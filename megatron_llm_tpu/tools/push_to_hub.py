@@ -1,0 +1,55 @@
+"""Export a native checkpoint to HF format and push it to the Hub.
+
+Reference parity: tools/push_to_hub.py (converts then calls
+``model.push_to_hub``).  Conversion reuses checkpoint_util.native_to_hf;
+the upload step needs network + an HF token and is skipped with
+``--export_only``.
+
+Usage:
+  python -m megatron_llm_tpu.tools.push_to_hub \
+      --load /ckpts/run1 --repo_id my-org/my-model \
+      [--hf_base meta-llama/Llama-2-7b-hf] [--export_only --output /out]
+"""
+
+from __future__ import annotations
+
+import argparse
+import tempfile
+from typing import Optional
+
+from .checkpoint_util import native_to_hf
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--load", required=True)
+    p.add_argument("--repo_id", default=None)
+    p.add_argument("--hf_base", default=None)
+    p.add_argument("--model_family", default=None)
+    p.add_argument("--iteration", default=None)
+    p.add_argument("--output", default=None,
+                   help="export directory (default: temp dir)")
+    p.add_argument("--export_only", action="store_true",
+                   help="convert to HF format but do not upload")
+    p.add_argument("--private", action="store_true")
+    args = p.parse_args(argv)
+
+    out = args.output or tempfile.mkdtemp(prefix="hf_export_")
+    native_to_hf(args.load, out, args.hf_base, args.model_family,
+                 args.iteration)
+    if args.export_only:
+        print(f"export only: {out}")
+        return 0
+    if not args.repo_id:
+        p.error("--repo_id is required unless --export_only")
+
+    import transformers
+
+    model = transformers.AutoModelForCausalLM.from_pretrained(out)
+    model.push_to_hub(args.repo_id, private=args.private)
+    print(f"pushed {args.load} -> hf.co/{args.repo_id}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

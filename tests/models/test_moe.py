@@ -79,15 +79,21 @@ def test_moe_top1_selects_single_expert():
 
 
 def test_moe_capacity_drops_overflow():
-    """Tiny capacity: dispatched token count per expert never exceeds C."""
-    cfg = moe_cfg(moe_capacity_factor=0.25)
+    """Capacity masking: with a uniform router every token picks expert 0
+    (argmax tie → lowest index) and only the first C tokens per group get
+    dispatched — all later positions must come out exactly zero."""
+    cfg = moe_cfg(moe_top_k=1, moe_capacity_factor=0.1)
     C = moe_lib.capacity(cfg, 32)
+    assert C == 1
     p = moe_lib.init_moe_params(jax.random.key(2), cfg)
-    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 32, 32)),
+    p["router"] = jnp.zeros_like(p["router"])  # uniform probs → all pick e0
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(1, 32, 32)),
                     jnp.float32)
     out, aux = moe_lib.moe_block(cfg, p, x)
-    assert out.shape == x.shape and np.isfinite(float(aux))
-    assert C == max(1, int(np.ceil(2 * 32 * 0.25 / 4)))
+    out = np.asarray(out)
+    assert np.abs(out[0, 0]).sum() > 0  # first token served by expert 0
+    np.testing.assert_array_equal(out[0, 1:], 0.0)  # overflow dropped
+    assert np.isfinite(float(aux))
 
 
 def test_moe_model_forward_and_grad():

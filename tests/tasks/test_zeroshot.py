@@ -1,0 +1,134 @@
+"""Zero-shot eval harness tests (reference: tasks/zeroshot_gpt)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.tasks.zeroshot import (
+    cloze_window,
+    evaluate_accuracy,
+    evaluate_loss,
+    lm_windows,
+    wikitext_detokenize,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tiny_config()
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def test_lm_windows_cover_each_target_once():
+    tokens = list(range(100))
+    seen = np.zeros(99)
+    for toks, mask in lm_windows(tokens, seq_len=32, pad_idx=0):
+        for j, m in enumerate(mask):
+            if m > 0:
+                # target token value == its stream position + 1
+                seen[toks[j + 1] - 1] += 1
+    assert (seen == 1).all()
+
+
+def test_lm_windows_overlapping_cover_each_target_once():
+    tokens = list(range(100))
+    counts = {}
+    for toks, mask in lm_windows(tokens, seq_len=32, pad_idx=0,
+                                 overlapping_eval=16):
+        for j, m in enumerate(mask):
+            if m > 0:
+                counts[int(toks[j + 1])] = counts.get(int(toks[j + 1]), 0) + 1
+    assert all(v == 1 for v in counts.values())
+    assert len(counts) == 99
+
+
+def test_evaluate_loss_matches_direct(tiny_model):
+    cfg, params = tiny_model
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, 3 * 32 + 1).tolist()
+    report = evaluate_loss(cfg, params, lm_windows(tokens, 32, 0),
+                           batch_size=2)
+    assert report["num_targets"] == 3 * 32
+    # uniform-random tokens vs untrained model ≈ ln(vocab)
+    assert 0.5 * np.log(cfg.vocab_size) < report["avg_loss"] < \
+        2.0 * np.log(cfg.vocab_size)
+    assert report["ppl"] == pytest.approx(np.exp(report["avg_loss"]))
+
+
+def test_evaluate_accuracy_perfect_and_zero(tiny_model):
+    cfg, params = tiny_model
+    rng = np.random.default_rng(1)
+    ctx = rng.integers(0, cfg.vocab_size, 16).tolist()
+
+    logits = model_lib.forward(cfg, params,
+                               np.asarray([ctx], np.int32))
+    pred = int(np.argmax(np.asarray(logits)[0, -1, : cfg.vocab_size]))
+
+    win_right = cloze_window(ctx, [pred], seq_len=32, pad_idx=0)
+    wrong = (pred + 1) % cfg.vocab_size
+    win_wrong = cloze_window(ctx, [wrong], seq_len=32, pad_idx=0)
+    report = evaluate_accuracy(cfg, params, iter([win_right, win_wrong]),
+                               batch_size=2)
+    assert report["num_examples"] == 2
+    assert report["num_correct"] == 1
+    assert report["accuracy"] == 0.5
+
+
+def test_cloze_window_truncates_context_keeps_target():
+    ctx = list(range(100))
+    toks, mask = cloze_window(ctx, [7, 8], seq_len=32, pad_idx=0)
+    assert toks.shape == (33,)
+    assert mask.shape == (32,)
+    assert toks[-2:].tolist() == [7, 8]
+    assert mask[-2:].tolist() == [1.0, 1.0]
+    assert mask[:-2].sum() == 0
+
+
+def test_wikitext_detokenize():
+    s = "the cat @-@ like thing , said : \" hello world \" ( yes )"
+    out = wikitext_detokenize(s)
+    assert out == 'the cat-like thing, said: "hello world" (yes)'
+
+
+def test_zeroshot_cli(tmp_path, capsys, tiny_model):
+    """CLI end-to-end on a tiny checkpoint + byte-level tokenizer stub."""
+    from megatron_llm_tpu import checkpointing
+    from megatron_llm_tpu.config import RuntimeConfig
+    from megatron_llm_tpu.tasks import zeroshot
+
+    cfg, params = tiny_model
+    root = tmp_path / "ckpt"
+    checkpointing.save_release_params(str(root), params,
+                                      RuntimeConfig(model=cfg))
+
+    data = tmp_path / "lambada.jsonl"
+    data.write_text(json.dumps({"text": "hello world again"}) + "\n")
+
+    class ByteTok:
+        vocab_size = 256
+        pad = 0
+
+        def tokenize(self, text):
+            return list(text.encode())
+
+    import megatron_llm_tpu.tokenizer.tokenizer as tok_mod
+
+    orig = tok_mod.build_tokenizer
+    tok_mod.build_tokenizer = lambda *a, **k: ByteTok()
+    try:
+        rc = zeroshot.main([
+            "--task", "lambada", "--load", str(root),
+            "--data_path", str(data), "--tokenizer_model", "stub",
+            "--batch_size", "1",
+        ])
+    finally:
+        tok_mod.build_tokenizer = orig
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "accuracy" in out
