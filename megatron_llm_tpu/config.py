@@ -132,6 +132,9 @@ class ModelConfig:
     seq_length: int = 4096
     # lm head
     tokentype_size: int = 0  # BERT-style token types (0 = disabled)
+    # encoder-decoder (T5): decoder depth; None → same as num_layers
+    # (encoder depth).  Decoder-only families ignore this.
+    num_decoder_layers: Optional[int] = None
 
     @property
     def kv_heads(self) -> int:

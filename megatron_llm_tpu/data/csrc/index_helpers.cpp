@@ -100,4 +100,65 @@ void build_shuffle_idx(uint32_t seed, int64_t n_first, int64_t n_total,
   if (n_total > n_first) std::shuffle(out + n_first, out + n_total, gen);
 }
 
+/* BERT sentence-pair sample mapping (behavioral spec:
+ * megatron/data/helpers.cpp build_mapping, consumed by bert_dataset.py):
+ * greedily pack consecutive sentences of each document into samples of a
+ * (randomly shortened) target length, emitting rows of
+ * (first_sentence, one_past_last_sentence, target_len); samples need at
+ * least two sentences so an A/B split exists.  Rows are shuffled in place.
+ *
+ * `sent_sizes`: tokens per sentence; `doc_sent_idx`: per-document sentence
+ * ranges (len num_docs+1).  `out` must hold max_rows*3 int32 where
+ * max_rows = num_epochs * total_sentences.  Returns the row count. */
+int64_t build_bert_mapping(const int32_t* sent_sizes,
+                           const int64_t* doc_sent_idx, int64_t num_docs,
+                           int32_t max_num_tokens, double short_seq_prob,
+                           int32_t num_epochs, uint32_t seed, int32_t* out) {
+  std::mt19937 gen(seed);
+  std::uniform_real_distribution<double> unif(0.0, 1.0);
+  auto target_len = [&]() -> int32_t {
+    if (unif(gen) < short_seq_prob) {
+      std::uniform_int_distribution<int32_t> d(2, max_num_tokens);
+      return d(gen);
+    }
+    return max_num_tokens;
+  };
+
+  int64_t rows = 0;
+  for (int32_t epoch = 0; epoch < num_epochs; ++epoch) {
+    for (int64_t doc = 0; doc < num_docs; ++doc) {
+      const int64_t first = doc_sent_idx[doc];
+      const int64_t last = doc_sent_idx[doc + 1];
+      if (last - first < 2) continue; /* need two sentences for A/B */
+      int32_t target = target_len();
+      int64_t start = first;
+      int32_t len = 0;
+      int64_t num_sent = 0;
+      for (int64_t s = first; s < last; ++s) {
+        len += sent_sizes[s];
+        ++num_sent;
+        const bool is_last = (s == last - 1);
+        if (num_sent >= 2 && (len >= target || is_last)) {
+          out[rows * 3] = static_cast<int32_t>(start);
+          out[rows * 3 + 1] = static_cast<int32_t>(s + 1);
+          out[rows * 3 + 2] = target;
+          ++rows;
+          start = s + 1;
+          len = 0;
+          num_sent = 0;
+          target = target_len();
+        }
+      }
+    }
+  }
+
+  /* Fisher-Yates shuffle of the rows (64-bit indices like the reference). */
+  std::mt19937_64 gen64(seed + 1);
+  for (int64_t i = rows - 1; i > 0; --i) {
+    const int64_t j = static_cast<int64_t>(gen64() % (i + 1));
+    for (int k = 0; k < 3; ++k) std::swap(out[3 * i + k], out[3 * j + k]);
+  }
+  return rows;
+}
+
 }  /* extern "C" */

@@ -60,8 +60,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.build_blending_indices.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_double), ctypes.c_int32, ctypes.c_int64]
+        lib.build_bert_mapping.restype = ctypes.c_int64
+        lib.build_bert_mapping.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
+            ctypes.c_uint32, ctypes.POINTER(ctypes.c_int32)]
         _lib = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: a stale .so predating a newly added symbol —
+        # degrade to the numpy fallbacks rather than crashing callers.
         _lib = None
     return _lib
 
@@ -149,3 +156,62 @@ def build_blending_indices(weights: np.ndarray, size: int):
         _as_ptr(dataset_sample_index, ctypes.c_int64),
         _as_ptr(weights, ctypes.c_double), len(weights), size)
     return dataset_index, dataset_sample_index
+
+
+# ---------------------------------------------------------------------------
+# build_bert_mapping (reference helpers.cpp build_mapping)
+# ---------------------------------------------------------------------------
+
+
+def build_bert_mapping_py(sent_sizes: np.ndarray, doc_sent_idx: np.ndarray,
+                          max_num_tokens: int, short_seq_prob: float,
+                          num_epochs: int, seed: int) -> np.ndarray:
+    """Numpy fallback: same packing algorithm, numpy PRNG (the native and
+    fallback paths are each deterministic but draw different streams)."""
+    rng = np.random.default_rng(seed)
+
+    def target_len():
+        if rng.random() < short_seq_prob:
+            return int(rng.integers(2, max_num_tokens + 1))
+        return max_num_tokens
+
+    rows = []
+    for _ in range(num_epochs):
+        for doc in range(len(doc_sent_idx) - 1):
+            first, last = int(doc_sent_idx[doc]), int(doc_sent_idx[doc + 1])
+            if last - first < 2:
+                continue
+            target = target_len()
+            start, length, num_sent = first, 0, 0
+            for s in range(first, last):
+                length += int(sent_sizes[s])
+                num_sent += 1
+                if num_sent >= 2 and (length >= target or s == last - 1):
+                    rows.append((start, s + 1, target))
+                    start, length, num_sent = s + 1, 0, 0
+                    target = target_len()
+    out = np.asarray(rows, dtype=np.int32).reshape(-1, 3)
+    rng.shuffle(out, axis=0)
+    return out
+
+
+def build_bert_mapping(sent_sizes: np.ndarray, doc_sent_idx: np.ndarray,
+                       max_num_tokens: int, short_seq_prob: float = 0.1,
+                       num_epochs: int = 1, seed: int = 0) -> np.ndarray:
+    """[rows, 3] of (first_sentence, one_past_last, target_len), shuffled."""
+    sent_sizes = np.ascontiguousarray(sent_sizes, dtype=np.int32)
+    doc_sent_idx = np.ascontiguousarray(doc_sent_idx, dtype=np.int64)
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "build_bert_mapping"):
+        return build_bert_mapping_py(sent_sizes, doc_sent_idx,
+                                     max_num_tokens, short_seq_prob,
+                                     num_epochs, seed)
+    max_rows = num_epochs * len(sent_sizes)
+    out = np.empty((max_rows, 3), dtype=np.int32)
+    rows = lib.build_bert_mapping(
+        _as_ptr(sent_sizes, ctypes.c_int32),
+        _as_ptr(doc_sent_idx, ctypes.c_int64),
+        len(doc_sent_idx) - 1, max_num_tokens,
+        ctypes.c_double(short_seq_prob), num_epochs, seed,
+        _as_ptr(out, ctypes.c_int32))
+    return out[:rows].copy()

@@ -132,6 +132,10 @@ class AttnSideInputs:
     segment_ids: Optional[jax.Array] = None  # [b, s] packed sequences
     dropout_rng: Optional[jax.Array] = None
     deterministic: bool = True
+    # False → bidirectional self-attention (BERT/T5-encoder stacks;
+    # reference AttnMaskType.padding, megatron/model/enums.py).  Padding is
+    # expressed through segment_ids (pad tokens get their own segment).
+    causal: bool = True
 
 
 def _dropout(x, rate, rng, deterministic):
@@ -214,7 +218,7 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
         ctx = attention(
             q, k, v,
             impl=cfg.attention_impl,
-            causal=True,
+            causal=side.causal,
             segment_ids=side.segment_ids,
             softmax_scale=softmax_scale,
             dropout_rate=0.0 if side.deterministic else cfg.attention_dropout,

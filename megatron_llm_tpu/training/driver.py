@@ -599,3 +599,130 @@ def _save(cfg: RuntimeConfig, state, iteration: int, consumed_samples: int,
         meta={"consumed_samples": consumed_samples})
     timers("save-checkpoint").stop()
     print_rank_0(f" saved checkpoint to {path}")
+
+
+# ---------------------------------------------------------------------------
+# Generic (non-decoder-LM) pretraining loop — the forward_step_func hook of
+# the reference's pretrain() (training.py:55), used by pretrain_bert.py /
+# pretrain_t5.py for models whose batches and losses don't fit compute_loss.
+# ---------------------------------------------------------------------------
+
+
+def pretrain_custom(
+    cfg: RuntimeConfig,
+    dataset,
+    params: PyTree,
+    loss_fn,
+    valid_dataset=None,
+    eval_loss_fn=None,
+) -> TrainState:
+    """Data-parallel training of an arbitrary model family.
+
+    ``dataset[i]`` yields a dict of numpy arrays; batches are stacked to
+    [accum, micro_total, ...] and the step runs ``loss_fn(cfg, params,
+    microbatch, rng, deterministic)``.  Params stay replicated (dp only —
+    the secondary families don't need tp/pp, matching the reference's usage
+    of BERT/T5 as single-node models).
+    """
+    cfg.validate()
+    timers = Timers()
+    writer = NullWriter()
+    if jax.process_index() == 0:
+        writer = build_writer(cfg.train.tensorboard_dir,
+                              cfg.train.wandb_project, cfg.train.wandb_name,
+                              config=cfg.to_dict())
+
+    mesh = mesh_lib.build_mesh(cfg.parallel)
+    state = init_train_state(cfg, params)
+    # Replicated params + dp-sharded batch.  The copy forces unique buffers:
+    # eagerly-created zero constants can be deduplicated by the backend, and
+    # donation rejects the same buffer appearing twice in the arguments
+    # (device_put alone no-ops on already-placed arrays).
+    replicated = NamedSharding(mesh, P())
+    state_sharding = jax.tree.map(lambda _: replicated, state)
+    state = jax.device_put(
+        jax.tree.map(lambda x: jnp.array(x, copy=True), state), replicated)
+    batch_sharding = NamedSharding(mesh, P(None, "dp"))
+    step_fn = make_train_step(cfg, mesh, state_sharding, batch_sharding,
+                              loss_fn=loss_fn)
+
+    iteration = 0
+    consumed = 0
+    if cfg.train.load or (cfg.train.save and checkpointing.read_tracker(
+            cfg.train.save) is not None):
+        root = cfg.train.load or cfg.train.save
+        try:
+            state, it = checkpointing.load_checkpoint(root, state)
+            if it != "release":
+                iteration = int(it)
+                consumed = checkpointing.load_meta(root, it).get(
+                    "consumed_samples", 0)
+        except FileNotFoundError:
+            pass
+
+    gbs = cfg.train.global_batch_size
+    accum = cfg.grad_accum_steps
+    micro_total = gbs // accum
+    n = len(dataset)
+    log = _LogState()
+
+    def epoch_order(epoch: int) -> np.ndarray:
+        """Deterministic per-epoch permutation: sample order is a pure
+        function of (seed, consumed), so resume reproduces it exactly and
+        eval-time randomness can't perturb it (the resumable-sampler
+        contract of data_samplers.py:49-96 in the reference)."""
+        return np.random.default_rng(
+            (cfg.train.seed, epoch)).permutation(n)
+
+    def sample_index(position: int) -> int:
+        return int(epoch_order(position // n)[position % n])
+
+    eval_fn = eval_loss_fn or loss_fn
+    eval_jit = jax.jit(lambda p, mb: eval_fn(cfg, p, mb, None, True))
+    eval_rng = np.random.default_rng(cfg.train.seed + 977)
+
+    base_rng = jax.random.key(cfg.train.seed)
+    while iteration < cfg.train.train_iters:
+        idxs = [sample_index(consumed + j) for j in range(gbs)]
+        samples = [dataset[i] for i in idxs]
+        batch = {
+            k: np.stack([s[k] for s in samples]).reshape(
+                (accum, micro_total) + np.asarray(samples[0][k]).shape)
+            for k in samples[0]
+        }
+        batch = {k: jax.device_put(jnp.asarray(v), batch_sharding)
+                 for k, v in batch.items()}
+
+        timers("train-step", log_level=0).start()
+        state, metrics = step_fn(state, batch, base_rng)
+        timers("train-step").stop()
+        iteration += 1
+        consumed += gbs
+        log.tokens += gbs * cfg.train.seq_length
+        training_log(cfg, log, metrics, iteration, consumed, writer, timers)
+
+        if (cfg.train.save and cfg.train.save_interval
+                and iteration % cfg.train.save_interval == 0):
+            _save(cfg, state, iteration, consumed, timers)
+
+        if (valid_dataset is not None and cfg.train.eval_interval
+                and iteration % cfg.train.eval_interval == 0
+                and cfg.train.eval_iters):
+            losses = []
+            nv = len(valid_dataset)
+            vi = eval_rng.integers(0, nv, size=cfg.train.eval_iters)
+            for v0 in vi:
+                vs = [valid_dataset[int((v0 + j) % nv)]
+                      for j in range(micro_total)]
+                vb = {k: jnp.asarray(np.stack([s[k] for s in vs]))
+                      for k in vs[0]}
+                losses.append(float(eval_jit(state.params, vb)))
+            print_rank_0(f" validation loss at iteration {iteration}: "
+                         f"{np.mean(losses):.6E}")
+            writer.add_scalar("valid/loss", float(np.mean(losses)),
+                              iteration)
+
+    if cfg.train.save:
+        _save(cfg, state, iteration, consumed, timers)
+    writer.flush()
+    return state

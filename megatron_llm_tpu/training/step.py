@@ -78,16 +78,22 @@ def compute_loss(cfg: RuntimeConfig, params, batch: dict, rng=None,
 
 
 def _accumulate_grads(cfg: RuntimeConfig, params, batch, rng, rope,
-                      loss_scale):
+                      loss_scale, loss_fn=None):
     """Scan microbatches, accumulating fp32 grads and the mean loss.
 
-    ``batch`` leaves are [accum, micro_batch, ...].
+    ``batch`` leaves are [accum, micro_batch, ...].  ``loss_fn(cfg, params,
+    microbatch, rng, deterministic)`` overrides the decoder-LM loss — the
+    analogue of the reference's ``forward_step_func`` argument to
+    ``pretrain`` (training.py:55), used by the BERT/T5 entry points.
     """
     accum = jax.tree.leaves(batch)[0].shape[0]
 
     def scaled_loss_fn(p, mb, mb_rng):
-        loss = compute_loss(cfg, p, mb, rng=mb_rng,
-                            deterministic=(mb_rng is None), rope=rope)
+        if loss_fn is not None:
+            loss = loss_fn(cfg, p, mb, mb_rng, mb_rng is None)
+        else:
+            loss = compute_loss(cfg, p, mb, rng=mb_rng,
+                                deterministic=(mb_rng is None), rope=rope)
         return loss * loss_scale, loss
 
     grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
@@ -136,11 +142,15 @@ def _pipeline_grads(cfg: RuntimeConfig, params, batch, rng, rope,
 
 
 def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
-               base_rng: Optional[jax.Array] = None, rope=None, mesh=None):
+               base_rng: Optional[jax.Array] = None, rope=None, mesh=None,
+               loss_fn=None):
     """One optimizer step over ``grad_accum`` microbatches.
 
     Returns (new_state, metrics).  Donate ``state`` when jitting.
     """
+    if loss_fn is not None and cfg.parallel.pipeline_parallel > 1:
+        raise NotImplementedError(
+            "custom loss_fn is not supported with pipeline parallelism")
     train_iters = cfg.train.train_iters
     it = state.iteration
     rng = None
@@ -155,7 +165,7 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
                                       loss_scale, mesh)
     else:
         grads, loss = _accumulate_grads(cfg, state.params, batch, rng, rope,
-                                        loss_scale)
+                                        loss_scale, loss_fn)
     # unscale (reference: optimizer.py:384-404 unscale-and-check-inf)
     grads = jax.tree.map(lambda g: g / loss_scale, grads)
     grad_norm = opt_lib.global_grad_norm(grads)
@@ -210,7 +220,7 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
 
 
 def make_train_step(cfg: RuntimeConfig, mesh=None, state_sharding=None,
-                    batch_sharding=None):
+                    batch_sharding=None, loss_fn=None):
     """jit-compile ``train_step`` with donated state.
 
     RoPE tables are closed over as constants (computed once, not per step —
@@ -232,7 +242,7 @@ def make_train_step(cfg: RuntimeConfig, mesh=None, state_sharding=None,
                else contextlib.nullcontext())
         with ctx:
             return train_step(cfg, state, batch, base_rng, rope=rope,
-                              mesh=mesh)
+                              mesh=mesh, loss_fn=loss_fn)
 
     kwargs = {}
     if state_sharding is not None:

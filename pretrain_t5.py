@@ -1,0 +1,99 @@
+"""T5 span-corruption pretraining entry point (reference: pretrain_t5.py).
+
+Same sentence-per-item .bin/.idx corpus as pretrain_bert.py.
+
+Example:
+  python pretrain_t5.py --data_path corpus --vocab_size 32128 \
+      --encoder_seq_length 512 --decoder_seq_length 114 --train_iters 1000
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from megatron_llm_tpu.config import (
+    ModelConfig, OptimizerConfig, ParallelConfig, RuntimeConfig, TrainConfig,
+)
+from megatron_llm_tpu.data.indexed_dataset import MMapIndexedDataset
+from megatron_llm_tpu.data.t5_dataset import T5Dataset, T5SpecialTokens
+from megatron_llm_tpu.models import encdec
+from megatron_llm_tpu.training.driver import pretrain_custom
+
+
+def get_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data_path", required=True)
+    p.add_argument("--vocab_size", type=int, required=True)
+    p.add_argument("--hidden_size", type=int, default=768)
+    p.add_argument("--num_layers", type=int, default=12)
+    p.add_argument("--num_decoder_layers", type=int, default=None)
+    p.add_argument("--num_attention_heads", type=int, default=12)
+    p.add_argument("--encoder_seq_length", type=int, default=512)
+    p.add_argument("--decoder_seq_length", type=int, default=128)
+    p.add_argument("--micro_batch_size", type=int, default=4)
+    p.add_argument("--global_batch_size", type=int, default=32)
+    p.add_argument("--train_iters", type=int, default=1000)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--save", default=None)
+    p.add_argument("--save_interval", type=int, default=500)
+    p.add_argument("--log_interval", type=int, default=10)
+    p.add_argument("--data_parallel", type=int, default=1)
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--masked_lm_prob", type=float, default=0.15)
+    return p.parse_args(argv)
+
+
+def t5_runtime_config(args) -> RuntimeConfig:
+    model = ModelConfig(
+        vocab_size=args.vocab_size,
+        hidden_size=args.hidden_size,
+        num_layers=args.num_layers,
+        num_decoder_layers=args.num_decoder_layers,
+        num_attention_heads=args.num_attention_heads,
+        num_kv_heads=args.num_attention_heads,
+        ffn_hidden_size=4 * args.hidden_size,
+        max_position_embeddings=max(args.encoder_seq_length,
+                                    args.decoder_seq_length),
+        norm_type="layernorm",
+        activation="gelu",
+        position_embedding_type="absolute",
+        use_bias=True,
+        tie_embed_logits=True,
+        seq_length=args.encoder_seq_length,
+    )
+    return RuntimeConfig(
+        model=model,
+        parallel=ParallelConfig(data_parallel=args.data_parallel),
+        optimizer=OptimizerConfig(lr=args.lr, clip_grad=1.0),
+        train=TrainConfig(
+            train_iters=args.train_iters,
+            micro_batch_size=args.micro_batch_size,
+            global_batch_size=args.global_batch_size,
+            seq_length=args.encoder_seq_length,
+            save=args.save, save_interval=args.save_interval,
+            log_interval=args.log_interval, seed=args.seed,
+        ),
+    ).validate()
+
+
+def t5_loss_fn(cfg, params, mb, rng, deterministic):
+    return encdec.t5_loss(cfg.model, params, mb, rng, deterministic)
+
+
+def main(argv=None):
+    args = get_args(argv)
+    cfg = t5_runtime_config(args)
+    special = T5SpecialTokens(bos=0, eos=1, pad=0)
+    ds = T5Dataset(
+        MMapIndexedDataset(args.data_path),
+        args.encoder_seq_length, args.decoder_seq_length,
+        cfg.model.vocab_size, special,
+        masked_lm_prob=args.masked_lm_prob, seed=args.seed)
+    params = encdec.init_t5_params(jax.random.key(args.seed), cfg.model)
+    return pretrain_custom(cfg, ds, params, t5_loss_fn)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,175 @@
+"""BERT / T5 model family tests (reference: bert_model.py, t5_model.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import ModelConfig
+from megatron_llm_tpu.models import encdec
+
+
+def bert_cfg(**overrides):
+    base = dict(
+        vocab_size=96, hidden_size=48, num_layers=2, num_attention_heads=4,
+        num_kv_heads=4, ffn_hidden_size=96, max_position_embeddings=64,
+        norm_type="layernorm", activation="gelu",
+        position_embedding_type="absolute", use_bias=True,
+        tie_embed_logits=True, tokentype_size=2,
+        params_dtype="float32", attention_impl="dot", recompute="none",
+        make_vocab_size_divisible_by=8, seq_length=32,
+    )
+    base.update(overrides)
+    return ModelConfig(**base).validate()
+
+
+def t5_cfg(**overrides):
+    return bert_cfg(num_decoder_layers=2, tokentype_size=0, **overrides)
+
+
+@pytest.fixture(scope="module")
+def bert():
+    cfg = bert_cfg()
+    params = encdec.init_bert_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def t5():
+    cfg = t5_cfg()
+    params = encdec.init_t5_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def test_bert_forward_shapes(bert):
+    cfg, params = bert
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 96, (2, 32)), jnp.int32)
+    pad = jnp.ones((2, 32), jnp.float32)
+    mlm, binary = bert_forward = encdec.bert_forward(cfg, params, tokens, pad)
+    assert mlm.shape == (2, 32, cfg.padded_vocab_size())
+    assert binary.shape == (2, 2)
+    assert np.isfinite(np.asarray(mlm)).all()
+
+
+def test_bert_is_bidirectional(bert):
+    """Changing a late token must change early positions' logits (unlike a
+    causal decoder)."""
+    cfg, params = bert
+    rng = np.random.default_rng(1)
+    tokens = np.asarray(rng.integers(0, 96, (1, 32)))
+    pad = jnp.ones((1, 32), jnp.float32)
+    a, _ = encdec.bert_forward(cfg, params, jnp.asarray(tokens, jnp.int32),
+                               pad)
+    tokens2 = tokens.copy()
+    tokens2[0, -1] = (tokens2[0, -1] + 1) % 96
+    b, _ = encdec.bert_forward(cfg, params, jnp.asarray(tokens2, jnp.int32),
+                               pad)
+    assert float(jnp.abs(a[0, 0] - b[0, 0]).max()) > 1e-6
+
+
+def test_bert_padding_is_ignored(bert):
+    """Logits at content positions must not depend on pad token values."""
+    cfg, params = bert
+    rng = np.random.default_rng(2)
+    content = rng.integers(0, 96, 20)
+    pad_mask = jnp.asarray(([1.0] * 20 + [0.0] * 12), jnp.float32)[None]
+    t1 = np.concatenate([content, np.zeros(12, np.int64)])
+    t2 = np.concatenate([content, rng.integers(0, 96, 12)])
+    a, _ = encdec.bert_forward(cfg, params, jnp.asarray(t1[None], jnp.int32),
+                               pad_mask)
+    b, _ = encdec.bert_forward(cfg, params, jnp.asarray(t2[None], jnp.int32),
+                               pad_mask)
+    np.testing.assert_allclose(np.asarray(a[0, :20]), np.asarray(b[0, :20]),
+                               atol=1e-5)
+
+
+def test_bert_loss_decreases(bert):
+    cfg, _ = bert
+    params = encdec.init_bert_params(jax.random.key(7), cfg)
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, 96, (2, 32))
+    batch = {
+        "tokens": jnp.asarray(tokens, jnp.int32),
+        "labels": jnp.asarray(tokens, jnp.int32),
+        "pad_mask": jnp.ones((2, 32), jnp.float32),
+        "loss_mask": jnp.asarray(rng.random((2, 32)) < 0.15, jnp.float32),
+        "is_random": jnp.asarray([0, 1], jnp.int32),
+    }
+
+    loss_fn = jax.jit(lambda p: encdec.bert_loss(cfg, p, batch))
+    grad_fn = jax.jit(jax.grad(lambda p: encdec.bert_loss(cfg, p, batch)))
+    l0 = float(loss_fn(params))
+    for _ in range(12):
+        g = grad_fn(params)
+        params = jax.tree.map(lambda p, gg: p - 0.05 * gg, params, g)
+    l1 = float(loss_fn(params))
+    assert l1 < l0 * 0.9, (l0, l1)
+
+
+def test_t5_forward_shapes_and_cross_attention(t5):
+    cfg, params = t5
+    rng = np.random.default_rng(4)
+    enc = jnp.asarray(rng.integers(0, 96, (2, 24)), jnp.int32)
+    dec = jnp.asarray(rng.integers(0, 96, (2, 16)), jnp.int32)
+    logits = encdec.t5_forward(cfg, params, enc, dec)
+    assert logits.shape == (2, 16, cfg.padded_vocab_size())
+
+    # decoder output must depend on the encoder input (cross attention)
+    enc2 = enc.at[0, 3].set((int(enc[0, 3]) + 1) % 96)
+    logits2 = encdec.t5_forward(cfg, params, enc2, dec)
+    assert float(jnp.abs(logits[0] - logits2[0]).max()) > 1e-6
+    # ...but only for the modified batch row
+    np.testing.assert_allclose(np.asarray(logits[1]),
+                               np.asarray(logits2[1]), atol=1e-6)
+
+
+def test_t5_decoder_is_causal(t5):
+    cfg, params = t5
+    rng = np.random.default_rng(5)
+    enc = jnp.asarray(rng.integers(0, 96, (1, 24)), jnp.int32)
+    dec = np.asarray(rng.integers(0, 96, (1, 16)))
+    a = encdec.t5_forward(cfg, params, enc, jnp.asarray(dec, jnp.int32))
+    dec2 = dec.copy()
+    dec2[0, -1] = (dec2[0, -1] + 1) % 96
+    b = encdec.t5_forward(cfg, params, enc, jnp.asarray(dec2, jnp.int32))
+    # positions before the change are unaffected
+    np.testing.assert_allclose(np.asarray(a[0, :-1]), np.asarray(b[0, :-1]),
+                               atol=1e-6)
+
+
+def test_t5_encoder_padding_masked_in_cross_attention(t5):
+    cfg, params = t5
+    rng = np.random.default_rng(6)
+    content = rng.integers(0, 96, 16)
+    enc_mask = jnp.asarray(([1.0] * 16 + [0.0] * 8), jnp.float32)[None]
+    dec = jnp.asarray(rng.integers(0, 96, (1, 8)), jnp.int32)
+    e1 = np.concatenate([content, np.zeros(8, np.int64)])
+    e2 = np.concatenate([content, rng.integers(0, 96, 8)])
+    a = encdec.t5_forward(cfg, params, jnp.asarray(e1[None], jnp.int32),
+                          dec, enc_pad_mask=enc_mask)
+    b = encdec.t5_forward(cfg, params, jnp.asarray(e2[None], jnp.int32),
+                          dec, enc_pad_mask=enc_mask)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_t5_loss_decreases(t5):
+    cfg, _ = t5
+    params = encdec.init_t5_params(jax.random.key(9), cfg)
+    rng = np.random.default_rng(7)
+    enc = rng.integers(0, 96, (2, 16))
+    dec = rng.integers(0, 96, (2, 12))
+    batch = {
+        "enc_tokens": jnp.asarray(enc, jnp.int32),
+        "dec_tokens": jnp.asarray(dec, jnp.int32),
+        "labels": jnp.asarray(np.roll(dec, -1, -1), jnp.int32),
+        "loss_mask": jnp.ones((2, 12), jnp.float32),
+    }
+    loss_fn = jax.jit(lambda p: encdec.t5_loss(cfg, p, batch))
+    grad_fn = jax.jit(jax.grad(lambda p: encdec.t5_loss(cfg, p, batch)))
+    l0 = float(loss_fn(params))
+    for _ in range(12):
+        g = grad_fn(params)
+        params = jax.tree.map(lambda p, gg: p - 0.05 * gg, params, g)
+    l1 = float(loss_fn(params))
+    assert l1 < l0 * 0.9, (l0, l1)
