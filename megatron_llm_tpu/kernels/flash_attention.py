@@ -451,8 +451,8 @@ def flash_attention(
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,  # [b, s] (sq == sk required)
     softmax_scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Blockwise fused attention; drop-in for ops.attention (same layout)."""

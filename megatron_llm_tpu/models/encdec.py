@@ -30,6 +30,7 @@ from .transformer import (
     Params,
     _dropout,
     _normal,
+    attention_block,
     init_stack_params,
     layer_forward,
     mlp_block,
@@ -78,6 +79,9 @@ def encoder_forward(cfg: ModelConfig, stacked: Params, x: jax.Array,
 
 def init_bert_params(key: jax.Array, cfg: ModelConfig, tp: int = 1) -> Params:
     assert not cfg.parallel_attn, "BERT/T5 use sequential residual blocks"
+    assert cfg.num_experts == 0, (
+        "MoE is not plumbed through the encoder stacks (the aux "
+        "load-balance loss would be silently dropped)")
     h = cfg.hidden_size
     dtype = cfg.dtype
     std = cfg.init_method_std
@@ -193,6 +197,9 @@ def num_decoder_layers(cfg: ModelConfig) -> int:
 
 def init_t5_params(key: jax.Array, cfg: ModelConfig, tp: int = 1) -> Params:
     assert not cfg.parallel_attn, "BERT/T5 use sequential residual blocks"
+    assert cfg.num_experts == 0, (
+        "MoE is not plumbed through the encoder stacks (the aux "
+        "load-balance loss would be silently dropped)")
     h = cfg.hidden_size
     dtype = cfg.dtype
     std = cfg.init_method_std
@@ -266,21 +273,19 @@ def t5_decoder_forward(cfg: ModelConfig, stacked: Params, cross: Params,
 
         # reference ordering (t5_model.py decoder layer): self-attn →
         # cross-attn → MLP, each as a pre-norm residual with hidden dropout.
-        from ..ops.norms import norm_apply as _norm
-        from .transformer import attention_block
-
-        h1 = _norm(cfg.norm_type, h, layer_params["input_norm"],
-                   cfg.norm_eps, impl=cfg.norm_impl)
+        h1 = norm_apply(cfg.norm_type, h, layer_params["input_norm"],
+                        cfg.norm_eps, impl=cfg.norm_impl)
         h = h + drop(attention_block(cfg, layer_params["attn"], h1, side,
                                      rng), 2)
 
-        c_norm = _norm(cfg.norm_type, h, cross_params["norm"],
-                       cfg.norm_eps, impl=cfg.norm_impl)
+        c_norm = norm_apply(cfg.norm_type, h, cross_params["norm"],
+                            cfg.norm_eps, impl=cfg.norm_impl)
         h = h + drop(cross_attention_block(cfg, cross_params, c_norm,
                                            enc_out, enc_pad_mask), 3)
 
-        m_norm = _norm(cfg.norm_type, h, layer_params["post_attn_norm"],
-                       cfg.norm_eps, impl=cfg.norm_impl)
+        m_norm = norm_apply(cfg.norm_type, h,
+                            layer_params["post_attn_norm"],
+                            cfg.norm_eps, impl=cfg.norm_impl)
         h = h + drop(mlp_block(cfg, layer_params["mlp"], m_norm), 4)
         return (h, idx + 1), None
 

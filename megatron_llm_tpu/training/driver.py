@@ -666,11 +666,15 @@ def pretrain_custom(
     n = len(dataset)
     log = _LogState()
 
+    import functools
+
+    @functools.lru_cache(maxsize=2)
     def epoch_order(epoch: int) -> np.ndarray:
         """Deterministic per-epoch permutation: sample order is a pure
         function of (seed, consumed), so resume reproduces it exactly and
         eval-time randomness can't perturb it (the resumable-sampler
-        contract of data_samplers.py:49-96 in the reference)."""
+        contract of data_samplers.py:49-96 in the reference).  Cached — a
+        batch may straddle at most two epochs."""
         return np.random.default_rng(
             (cfg.train.seed, epoch)).permutation(n)
 
