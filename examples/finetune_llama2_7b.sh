@@ -1,0 +1,22 @@
+#!/bin/bash
+# Llama-2-7B finetune on one v5e/v5p host (8 chips): tp=8 + sequence
+# parallelism + ZeRO-1 — the BASELINE.md headline configuration.
+set -euo pipefail
+
+CKPT=${CKPT:-ckpts/llama2-7b}
+DATA=${DATA:-data/corpus_text_document}
+TOKENIZER=${TOKENIZER:-tokenizer.model}
+
+python finetune.py \
+    --model llama2 --model_size 7b \
+    --load "$CKPT" --save ckpts/run1 --save_interval 100 \
+    --data_path "$DATA" \
+    --tokenizer_type sentencepiece --tokenizer_model "$TOKENIZER" \
+    --tp 8 --sequence_parallel --use_distributed_optimizer \
+    --params_dtype bfloat16 --attention_impl flash --recompute selective \
+    --micro_batch_size 4 --global_batch_size 1000 \
+    --seq_length 1024 --train_iters 500 \
+    --lr 2e-5 --min_lr 2e-6 --lr_decay_style cosine --lr_warmup_iters 50 \
+    --weight_decay 0.1 --clip_grad 1.0 \
+    --eval_interval 100 --eval_iters 10 --log_interval 10 \
+    --metrics perplexity accuracy

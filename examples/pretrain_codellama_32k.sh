@@ -1,0 +1,15 @@
+#!/bin/bash
+# Code-Llama style long-context training: 32k sequences via linear RoPE
+# position interpolation (rope_scaling_factor = 32768/4096 = 8) + ring
+# attention context parallelism over 4 chips + flash attention.
+set -euo pipefail
+
+python finetune.py \
+    --model codellama --model_size 7b \
+    --data_path "$1" \
+    --tokenizer_type sentencepiece --tokenizer_model "$2" \
+    --seq_length 32768 --rope_scaling_factor 8 \
+    --cp 4 --tp 2 --sequence_parallel \
+    --attention_impl flash --recompute full \
+    --micro_batch_size 1 --global_batch_size 16 \
+    --train_iters 1000 --lr 1e-5 --log_interval 5

@@ -1,0 +1,10 @@
+#!/bin/bash
+# REST text-generation server + a probe request.
+set -euo pipefail
+python -m megatron_llm_tpu.tools.run_text_generation_server \
+    --load "${1:-ckpts/run1}" \
+    --tokenizer_type sentencepiece --tokenizer_model "${2:-tokenizer.model}" \
+    --port 5000 &
+sleep 10
+curl -X PUT localhost:5000/api -H 'Content-Type: application/json' \
+    -d '{"prompts": ["The capital of France is"], "tokens_to_generate": 16}'

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Trust path: HF → native conversion, logit verification, round-trip export.
+set -euo pipefail
+HF=${1:-meta-llama/Llama-2-7b-hf}
+
+python -m megatron_llm_tpu.tools.checkpoint_util hf-to-native \
+    --hf_path "$HF" --output ckpts/imported
+python -m megatron_llm_tpu.tools.verify_correctness \
+    --hf_path "$HF" --iters 10 --seq_length 512
+python -m megatron_llm_tpu.tools.checkpoint_util native-to-hf \
+    --load ckpts/imported --output export/hf --hf_base "$HF"

@@ -305,9 +305,29 @@ def main(argv=None) -> int:
             and args.tokenizer_model:
         from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
 
+        # accept both "--x a b" and the comma-joined "--x a,b" forms (the
+        # preprocess tool documents the comma form)
+        extra = args.vocab_extra_ids_list
+        if extra:
+            extra = [t for item in extra for t in item.split(",") if t]
         tok = build_tokenizer(args.tokenizer_type, args.tokenizer_model,
-                              args.vocab_extra_ids_list)
+                              extra)
         eod = tok.eod
+        if tok.vocab_size > cfg.model.vocab_size:
+            # extra special tokens grew the tokenizer beyond the preset
+            # model vocab (reference pads vocab from the tokenizer,
+            # megatron/tokenizer/tokenizer.py:39-63) — grow the embedding
+            # so the new ids are real rows, not clamped aliases.
+            import dataclasses as _dc
+
+            from megatron_llm_tpu.config import RuntimeConfig as _RC
+
+            cfg = _RC(
+                model=_dc.replace(cfg.model, vocab_size=tok.vocab_size),
+                parallel=cfg.parallel, optimizer=cfg.optimizer,
+                train=cfg.train).validate()
+            print_rank_0(f" vocab grown to {tok.vocab_size} "
+                         f"(tokenizer extra ids)")
 
     print_rank_0(f"model: {args.model} {args.model_size} | "
                  f"mesh: dp={cfg.parallel.data_parallel} "

@@ -104,8 +104,11 @@ def _fwd_kernel(cfg: _Config, nk: int, *refs):
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)          # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)          # [bk, d]
+        # inputs stay in their storage dtype (bf16): the MXU multiplies in
+        # bf16 with fp32 accumulation via preferred_element_type — casting
+        # to f32 first would force ~4x-slower fp32 MXU passes
+        q = q_ref[0, 0]                               # [bq, d]
+        k = k_ref[0, 0]                               # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -121,9 +124,9 @@ def _fwd_kernel(cfg: _Config, nk: int, *refs):
         p = jnp.exp(s - m_new)                        # [bq, bk]
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
 
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0]
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         acc_scr[:] = acc_scr[:] * alpha + pv
@@ -233,10 +236,10 @@ def _dq_kernel(cfg: _Config, nk: int, *refs):
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         qseg = qseg_ref[0] if cfg.use_segs else None
@@ -249,7 +252,7 @@ def _dq_kernel(cfg: _Config, nk: int, *refs):
         )
         ds = p * (dp - delta.reshape(-1, 1)) * cfg.scale
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -277,10 +280,10 @@ def _dkv_kernel(cfg: _Config, nq: int, *refs):
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         qseg = qseg_ref[0] if cfg.use_segs else None
@@ -288,7 +291,7 @@ def _dkv_kernel(cfg: _Config, nq: int, *refs):
 
         p = _recompute_p(cfg, qi, ki, q, k, lse, qseg, kseg)   # [bq, bk]
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
@@ -297,7 +300,7 @@ def _dkv_kernel(cfg: _Config, nq: int, *refs):
         )
         ds = p * (dp - delta.reshape(-1, 1)) * cfg.scale        # [bq, bk]
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 

@@ -114,11 +114,15 @@ def init_bert_params(key: jax.Array, cfg: ModelConfig, tp: int = 1) -> Params:
     return params
 
 
-def bert_forward(cfg: ModelConfig, params: Params, tokens: jax.Array,
-                 pad_mask: jax.Array,
-                 tokentype_ids: Optional[jax.Array] = None,
-                 rng=None, deterministic: bool = True):
-    """→ (mlm_logits [b,s,v] fp32, binary_logits [b,2] fp32)."""
+def bert_encode(cfg: ModelConfig, params: Params, tokens: jax.Array,
+                pad_mask: jax.Array,
+                tokentype_ids: Optional[jax.Array] = None,
+                rng=None, deterministic: bool = True):
+    """Shared BERT trunk → (hidden [b,s,h], pooled [CLS] [b,h]).
+
+    Used by both the pretraining heads (bert_forward) and downstream
+    classification (tasks/classification.py), so the embed/encode/pool path
+    exists exactly once."""
     b, s = tokens.shape
     if tokentype_ids is None:
         tokentype_ids = jnp.zeros((b, s), jnp.int32)
@@ -132,6 +136,18 @@ def bert_forward(cfg: ModelConfig, params: Params, tokens: jax.Array,
                         deterministic)
     x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps,
                    impl=cfg.norm_impl)
+    pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"]
+                      + params["pooler"]["b"])
+    return x, pooled
+
+
+def bert_forward(cfg: ModelConfig, params: Params, tokens: jax.Array,
+                 pad_mask: jax.Array,
+                 tokentype_ids: Optional[jax.Array] = None,
+                 rng=None, deterministic: bool = True):
+    """→ (mlm_logits [b,s,v] fp32, binary_logits [b,2] fp32)."""
+    x, pooled = bert_encode(cfg, params, tokens, pad_mask, tokentype_ids,
+                            rng, deterministic)
 
     head = params["lm_head"]
     t = x @ head["dense"] + head["dense_bias"]
@@ -141,8 +157,6 @@ def bert_forward(cfg: ModelConfig, params: Params, tokens: jax.Array,
     mlm_logits = (t @ params["embedding"]["word"].T).astype(jnp.float32)
     mlm_logits = mlm_logits + head["bias"]
 
-    pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"]
-                      + params["pooler"]["b"])
     binary_logits = (pooled @ params["binary_head"]["w"]
                      + params["binary_head"]["b"]).astype(jnp.float32)
     return mlm_logits, binary_logits

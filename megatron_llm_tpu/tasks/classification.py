@@ -1,0 +1,245 @@
+"""Sequence-classification finetuning (GLUE / RACE style).
+
+Reference parity: tasks/glue/finetune.py + tasks/race/finetune.py +
+tasks/finetune_utils.py — a BERT encoder with a classification head
+finetuned on (text_a[, text_b], label) examples; RACE-style multiple
+choice is the same model with the choices flattened into the batch and a
+1-class head scored per choice.
+
+Data format: TSV with a header (``sentence1\tsentence2\tlabel`` — the
+second sentence column optional) or JSONL with ``{"text_a": ..,
+"text_b": .., "label": ..}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig, RuntimeConfig
+from ..models import encdec
+from ..models.transformer import _normal
+from ..parallel.cross_entropy import cross_entropy
+
+
+# ---------------------------------------------------------------------------
+# Model: BERT encoder + classification head (reference: megatron/model/
+# classification.py)
+# ---------------------------------------------------------------------------
+
+
+def init_classification_params(key: jax.Array, cfg: ModelConfig,
+                               num_classes: int) -> dict:
+    k_bert, k_head = jax.random.split(key)
+    params = encdec.init_bert_params(k_bert, cfg)
+    # The MLM + NSP heads are dead weight downstream (the reference's
+    # Classification model drops the LM head): keeping them would waste
+    # optimizer state and let decoupled weight decay corrupt the
+    # pretrained head in saved finetune checkpoints.
+    params.pop("lm_head")
+    params.pop("binary_head")
+    params["classification_head"] = {
+        "w": _normal(k_head, (cfg.hidden_size, num_classes),
+                     cfg.init_method_std, cfg.dtype),
+        "b": jnp.zeros((num_classes,), cfg.dtype),
+    }
+    return params
+
+
+def classification_forward(cfg: ModelConfig, params: dict, tokens, pad_mask,
+                           tokentype_ids=None, rng=None,
+                           deterministic: bool = True) -> jax.Array:
+    """→ class logits [b, num_classes] fp32 (pooled [CLS] → dense —
+    reference classification.py:70-90)."""
+    _, pooled = encdec.bert_encode(cfg, params, tokens, pad_mask,
+                                   tokentype_ids, rng, deterministic)
+    head = params["classification_head"]
+    return (pooled @ head["w"] + head["b"]).astype(jnp.float32)
+
+
+def classification_loss(cfg: ModelConfig, params: dict, batch: dict,
+                        rng=None, deterministic: bool = True):
+    logits = classification_forward(
+        cfg, params, batch["tokens"], batch["pad_mask"],
+        batch.get("tokentype_ids"), rng, deterministic)
+    per = cross_entropy(logits[:, None, :], batch["label"][:, None],
+                        vocab_size=logits.shape[-1])
+    return jnp.mean(per)
+
+
+def classification_accuracy(cfg: ModelConfig, params: dict,
+                            dataset, batch_size: int = 32) -> float:
+    fwd = jax.jit(lambda p, t, m, tt: classification_forward(
+        cfg, p, t, m, tt))
+    correct = total = 0
+    for i in range(0, len(dataset), batch_size):
+        idx = range(i, min(i + batch_size, len(dataset)))
+        samples = [dataset[j] for j in idx]
+        toks = jnp.asarray(np.stack([s["tokens"] for s in samples]))
+        mask = jnp.asarray(np.stack([s["pad_mask"] for s in samples]))
+        tts = jnp.asarray(np.stack([s["tokentype_ids"] for s in samples]))
+        logits = fwd(params, toks, mask, tts)
+        pred = np.asarray(jnp.argmax(logits, -1))
+        labels = np.asarray([s["label"] for s in samples])
+        correct += int((pred == labels).sum())
+        total += len(samples)
+    return correct / max(total, 1)
+
+
+# ---------------------------------------------------------------------------
+# Dataset (reference: tasks/data_utils.py build_sample / glue abstract ds)
+# ---------------------------------------------------------------------------
+
+
+class ClassificationDataset:
+    def __init__(self, rows: Sequence[tuple], tokenizer, seq_length: int,
+                 cls_id: int, sep_id: int, pad_id: int,
+                 label_map: Optional[dict] = None):
+        self.rows = list(rows)
+        self.tok = tokenizer
+        self.seq = seq_length
+        self.cls, self.sep, self.pad = cls_id, sep_id, pad_id
+        if label_map is None:
+            labels = sorted({r[2] for r in self.rows})
+            label_map = {l: i for i, l in enumerate(labels)}
+        self.label_map = label_map
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.label_map)
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __getitem__(self, idx: int) -> dict:
+        text_a, text_b, label = self.rows[idx]
+        a = list(self.tok.tokenize(text_a))
+        b = list(self.tok.tokenize(text_b)) if text_b else []
+        # truncate pairwise from the longer side (data_utils semantics)
+        while len(a) + len(b) > self.seq - (3 if b else 2):
+            (a if len(a) >= len(b) else b).pop()
+        tokens = [self.cls] + a + [self.sep] + (b + [self.sep] if b else [])
+        tokentypes = [0] * (len(a) + 2) + ([1] * (len(b) + 1) if b else [])
+        n = len(tokens)
+        pad = self.seq - n
+        return {
+            "tokens": np.asarray(tokens + [self.pad] * pad, np.int64),
+            "tokentype_ids": np.asarray(tokentypes + [0] * pad, np.int64),
+            "pad_mask": np.asarray([1.0] * n + [0.0] * pad, np.float32),
+            "label": np.int64(self.label_map[label]),
+        }
+
+
+def load_rows(path: str) -> list[tuple]:
+    rows = []
+    if path.endswith(".jsonl"):
+        for line in open(path):
+            if not line.strip():
+                continue
+            d = json.loads(line)
+            rows.append((d["text_a"], d.get("text_b", ""),
+                         str(d["label"])))
+    else:  # TSV with header
+        with open(path) as f:
+            reader = csv.DictReader(f, delimiter="\t")
+            for d in reader:
+                rows.append((d.get("sentence1") or d.get("text_a") or "",
+                             d.get("sentence2") or d.get("text_b") or "",
+                             str(d["label"])))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# CLI (reference: tasks/main.py + glue finetune drivers)
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[list] = None) -> dict:
+    from ..config import OptimizerConfig, ParallelConfig, TrainConfig
+    from ..tokenizer.tokenizer import build_tokenizer
+    from ..training.driver import pretrain_custom
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train_data", required=True)
+    p.add_argument("--valid_data", required=True)
+    p.add_argument("--tokenizer_model", default="bert-base-uncased")
+    p.add_argument("--pretrained_checkpoint", default=None,
+                   help="BERT release checkpoint to start from")
+    p.add_argument("--hidden_size", type=int, default=768)
+    p.add_argument("--num_layers", type=int, default=12)
+    p.add_argument("--num_attention_heads", type=int, default=12)
+    p.add_argument("--seq_length", type=int, default=128)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--micro_batch_size", type=int, default=8)
+    p.add_argument("--global_batch_size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=2e-5)
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--save", default=None)
+    args = p.parse_args(argv)
+
+    tok = build_tokenizer("huggingface", args.tokenizer_model)
+    inner = tok.inner
+    model = ModelConfig(
+        vocab_size=tok.vocab_size,
+        hidden_size=args.hidden_size,
+        num_layers=args.num_layers,
+        num_attention_heads=args.num_attention_heads,
+        num_kv_heads=args.num_attention_heads,
+        ffn_hidden_size=4 * args.hidden_size,
+        max_position_embeddings=args.seq_length,
+        norm_type="layernorm", activation="gelu",
+        position_embedding_type="absolute", use_bias=True,
+        tie_embed_logits=True, tokentype_size=2,
+        seq_length=args.seq_length,
+    )
+    train_rows = load_rows(args.train_data)
+    train_ds = ClassificationDataset(
+        train_rows, tok, args.seq_length,
+        inner.cls_token_id, inner.sep_token_id, inner.pad_token_id or 0)
+    valid_ds = ClassificationDataset(
+        load_rows(args.valid_data), tok, args.seq_length,
+        inner.cls_token_id, inner.sep_token_id, inner.pad_token_id or 0,
+        label_map=train_ds.label_map)
+
+    iters = max(1, args.epochs * len(train_ds) // args.global_batch_size)
+    cfg = RuntimeConfig(
+        model=model,
+        parallel=ParallelConfig(),
+        optimizer=OptimizerConfig(lr=args.lr, clip_grad=1.0),
+        train=TrainConfig(
+            train_iters=iters, micro_batch_size=args.micro_batch_size,
+            global_batch_size=args.global_batch_size,
+            seq_length=args.seq_length, seed=args.seed, save=args.save,
+        ),
+    ).validate()
+
+    params = init_classification_params(
+        jax.random.key(args.seed), cfg.model, train_ds.num_classes)
+    if args.pretrained_checkpoint:
+        from .. import checkpointing
+
+        template = {k: v for k, v in params.items()
+                    if k != "classification_head"}
+        bert = checkpointing.load_release_params(
+            args.pretrained_checkpoint, template)
+        params.update(bert)
+
+    def loss_fn(rcfg, p, mb, rng, deterministic):
+        return classification_loss(rcfg.model, p, mb, rng, deterministic)
+
+    state = pretrain_custom(cfg, train_ds, params, loss_fn)
+    acc = classification_accuracy(cfg.model, state.params, valid_ds)
+    print(json.dumps({"task": "classification", "valid_accuracy": acc,
+                      "num_classes": train_ds.num_classes,
+                      "iterations": int(state.iteration)}))
+    return {"accuracy": acc, "state": state}
+
+
+if __name__ == "__main__":
+    main()

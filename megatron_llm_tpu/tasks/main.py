@@ -1,0 +1,37 @@
+"""Task dispatch (reference: tasks/main.py).
+
+Usage:
+  python -m megatron_llm_tpu.tasks.main --task wikitext  ... (zeroshot args)
+  python -m megatron_llm_tpu.tasks.main --task lambada   ... (zeroshot args)
+  python -m megatron_llm_tpu.tasks.main --task classification ... (glue args)
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(add_help=False)
+    p.add_argument("--task", required=True)
+    ns, rest = p.parse_known_args(
+        list(sys.argv[1:] if argv is None else argv))
+    task = ns.task
+    if task in ("wikitext", "lambada"):
+        from .zeroshot import main as zmain
+
+        return zmain(["--task", task, *rest])
+    if task in ("classification", "glue", "race"):
+        from .classification import main as cmain
+
+        cmain(rest)
+        return 0
+    raise SystemExit(f"unknown --task {task!r}; choose from wikitext, "
+                     "lambada, classification")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

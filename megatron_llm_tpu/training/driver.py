@@ -194,12 +194,20 @@ def make_eval_step(cfg: RuntimeConfig, metric_names=(), mesh=None,
     rope = rope_tables(cfg.model)
 
     def eval_step(params, batch):
-        logits = model_lib.forward(
-            cfg.model, params, batch["tokens"],
-            position_ids=batch.get("position_ids"),
-            segment_ids=batch.get("segment_ids"),
-            deterministic=True, rope=rope,
-        )
+        # Mesh context at trace time — ring attention under cp resolves the
+        # mesh via parallel.mesh.current_mesh() (same dance as
+        # make_train_step; jit may trace long after the caller's block).
+        import contextlib
+
+        ctx = (mesh_lib.use_mesh(mesh) if mesh is not None
+               else contextlib.nullcontext())
+        with ctx:
+            logits = model_lib.forward(
+                cfg.model, params, batch["tokens"],
+                position_ids=batch.get("position_ids"),
+                segment_ids=batch.get("segment_ids"),
+                deterministic=True, rope=rope,
+            )
         per_token = cross_entropy(
             logits, batch["labels"], vocab_size=cfg.model.vocab_size)
         loss = masked_mean_loss(per_token, batch["loss_mask"])
@@ -457,7 +465,7 @@ def pretrain(
             eval_flatten = False
             eval_batch_sharding = art.batch_sharding
         else:
-            eval_batch_sharding = NamedSharding(art.mesh, P("dp", None))
+            eval_batch_sharding = NamedSharding(art.mesh, P("dp", "cp"))
             eval_step = make_eval_step(cfg, tuple(cfg.train.metrics),
                                        art.mesh, eval_batch_sharding,
                                        art.param_specs)
