@@ -92,13 +92,17 @@ def main() -> None:
 
     # warmup / compile
     state, metrics = step(state, batch, key)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
+    # Timing via an explicit host fetch of the last loss: the steps chain
+    # through the donated state, so the fetch transitively waits for all of
+    # them.  (block_until_ready proved unreliable for independent outputs
+    # over the axon-tunneled backend; a host read is unambiguous.)
     iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, batch, key)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     tokens_per_sec = iters * mb * seq / dt

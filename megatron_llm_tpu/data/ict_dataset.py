@@ -1,0 +1,76 @@
+"""Inverse-Cloze-Task dataset for bi-encoder pretraining.
+
+Reference parity: megatron/data/ict_dataset.py — a (query, block) pair per
+sample: the query is one sentence of a block and the context is the block
+with that sentence removed with probability ``remove_prob`` (the reference's
+``query_in_block_prob`` complement, ict_dataset.py:79-126).  The corpus is
+the same sentence-per-item indexed format as the BERT dataset.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .index_helpers import build_bert_mapping
+from .indexed_dataset import MMapIndexedDataset
+
+
+@dataclass(frozen=True)
+class ICTSpecialTokens:
+    cls: int
+    sep: int
+    pad: int
+
+
+class ICTDataset:
+    def __init__(self, indexed: MMapIndexedDataset, query_seq_length: int,
+                 block_seq_length: int, special: ICTSpecialTokens,
+                 remove_prob: float = 0.9, num_epochs: int = 1,
+                 seed: int = 0):
+        self.ds = indexed
+        self.q_len = query_seq_length
+        self.b_len = block_seq_length
+        self.special = special
+        self.remove_prob = remove_prob
+        self.seed = seed
+        # reuse the sentence-packing mapping; blocks need >= 2 sentences so
+        # removing the query still leaves context
+        self.mapping = build_bert_mapping(
+            np.asarray(indexed.sizes), np.asarray(indexed.doc_idx),
+            max_num_tokens=block_seq_length - 2, short_seq_prob=0.0,
+            num_epochs=num_epochs, seed=seed)
+
+    def __len__(self) -> int:
+        return len(self.mapping)
+
+    def _pack(self, token_lists, seq_len):
+        sp = self.special
+        toks = [sp.cls]
+        for t in token_lists:
+            toks.extend(int(x) for x in t)
+        toks = toks[: seq_len - 1] + [sp.sep]
+        n = len(toks)
+        pad = seq_len - n
+        return (np.asarray(toks + [sp.pad] * pad, np.int64),
+                np.asarray([1.0] * n + [0.0] * pad, np.float32))
+
+    def __getitem__(self, idx: int) -> dict:
+        start, end, _ = (int(x) for x in self.mapping[idx])
+        rng = np.random.default_rng((self.seed + 1) * 1618 + idx)
+        sents = [np.asarray(self.ds[i]) for i in range(start, end)]
+        qi = int(rng.integers(0, len(sents)))
+        query = sents[qi]
+        if rng.random() < self.remove_prob:
+            block = sents[:qi] + sents[qi + 1:]
+        else:
+            block = sents
+        q_toks, q_mask = self._pack([query], self.q_len)
+        c_toks, c_mask = self._pack(block, self.b_len)
+        return {
+            "query_tokens": q_toks,
+            "query_pad_mask": q_mask,
+            "context_tokens": c_toks,
+            "context_pad_mask": c_mask,
+        }

@@ -1,0 +1,185 @@
+"""Bi-encoder retrieval model (ICT / REALM / ORQA lineage).
+
+Reference parity: megatron/model/biencoder_model.py (BiEncoderModel with
+query + context BERT towers and optional shared weights), the ICT
+pretraining objective (in-batch softmax over query·context scores —
+tasks/orqa/supervised/finetune.py style retrieval loss), and
+megatron/indexer.py (embed a corpus of blocks, retrieve top-k by inner
+product).
+
+Both towers are the BERT trunk of models/encdec.py; ``shared`` ties them
+(biencoder_model_provider(shared_query_context_model=True)).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from . import encdec
+from .transformer import Params, _normal
+
+
+def init_biencoder_params(key: jax.Array, cfg: ModelConfig,
+                          projection_dim: int = 0,
+                          shared: bool = False) -> Params:
+    """Query + context towers (+ optional linear projection head).
+
+    ``projection_dim`` > 0 adds the REALM-style embedding projection
+    (biencoder_model.py projection_dim); 0 uses the pooled [CLS] directly.
+    """
+    kq, kc, kp = jax.random.split(key, 3)
+
+    def tower(k):
+        t = encdec.init_bert_params(k, cfg)
+        t.pop("lm_head")
+        t.pop("binary_head")
+        return t
+
+    query = tower(kq)
+    context = query if shared else tower(kc)
+    params: Params = {"query": query, "context": context}
+    if projection_dim:
+        q_proj = _normal(kp, (cfg.hidden_size, projection_dim),
+                         cfg.init_method_std, cfg.dtype)
+        # shared model shares the whole encoder incl. the projection
+        # (shared_query_context_model semantics)
+        c_proj = q_proj if shared else _normal(
+            jax.random.fold_in(kp, 1),
+            (cfg.hidden_size, projection_dim),
+            cfg.init_method_std, cfg.dtype)
+        params["projection"] = {"q": q_proj, "c": c_proj}
+    return params
+
+
+def embed_text(cfg: ModelConfig, tower: Params, tokens: jax.Array,
+               pad_mask: jax.Array, proj: Optional[jax.Array] = None,
+               rng=None, deterministic: bool = True,
+               pooling: str = "cls") -> jax.Array:
+    """→ [b, dim] embeddings, optionally projected.
+
+    Reference: BiEncoderModel.embed_text (biencoder_model.py:145-151) pools
+    the [CLS] position (``pooling="cls"``) — appropriate when the towers
+    warm-start from pretrained BERT (init_state_dict_from_bert).  From
+    scratch the CLS output is residual-dominated and nearly input-invariant
+    at init, so ``pooling="mean"`` (content-masked mean) is offered for
+    training without a warm start.
+    """
+    x, pooled = encdec.bert_encode(cfg, tower, tokens, pad_mask,
+                                   rng=rng, deterministic=deterministic)
+    if pooling == "mean":
+        w = pad_mask[..., None]
+        pooled = jnp.sum(x * w, axis=1) / jnp.maximum(
+            jnp.sum(w, axis=1), 1.0)
+    if proj is not None:
+        pooled = pooled @ proj
+    return pooled
+
+
+def biencoder_forward(cfg: ModelConfig, params: Params,
+                      query_tokens, query_pad_mask,
+                      context_tokens, context_pad_mask,
+                      rng=None, deterministic: bool = True,
+                      pooling: str = "cls"):
+    """→ (query_embeds [b, d], context_embeds [b, d])."""
+    qr = cr = None
+    if rng is not None:
+        qr, cr = jax.random.split(rng)
+    proj = params.get("projection")
+    q = embed_text(cfg, params["query"], query_tokens, query_pad_mask,
+                   None if proj is None else proj["q"], qr, deterministic,
+                   pooling)
+    c = embed_text(cfg, params["context"], context_tokens, context_pad_mask,
+                   None if proj is None else proj["c"], cr, deterministic,
+                   pooling)
+    return q, c
+
+
+def retrieval_loss(cfg: ModelConfig, params: Params, batch: dict,
+                   rng=None, deterministic: bool = True,
+                   pooling: str = "cls"):
+    """In-batch-negative softmax retrieval loss (ICT objective): batch row i's
+    query must score its own context highest among all contexts in the
+    batch."""
+    q, c = biencoder_forward(
+        cfg, params, batch["query_tokens"], batch["query_pad_mask"],
+        batch["context_tokens"], batch["context_pad_mask"],
+        rng, deterministic, pooling)
+    scores = (q.astype(jnp.float32) @ c.astype(jnp.float32).T)  # [b, b]
+    labels = jnp.arange(scores.shape[0])
+    logp = jax.nn.log_softmax(scores, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
+
+
+def retrieval_accuracy(scores: jax.Array) -> jax.Array:
+    """Fraction of in-batch queries ranking their own context first."""
+    return jnp.mean(
+        (jnp.argmax(scores, axis=-1) == jnp.arange(scores.shape[0]))
+        .astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Dense index (reference: megatron/indexer.py IndexBuilder + the FAISS-lite
+# retrieval of tasks/orqa; on TPU a corpus·query matmul is the index)
+# ---------------------------------------------------------------------------
+
+
+class DenseIndex:
+    """Embed a corpus of blocks once; retrieve by top-k inner product."""
+
+    def __init__(self, cfg: ModelConfig, params: Params,
+                 batch_size: int = 64, pooling: str = "cls"):
+        self.cfg = cfg
+        self.params = params
+        self.batch_size = batch_size
+        self._embeds: Optional[np.ndarray] = None
+        proj = params.get("projection")
+        self._embed_ctx = jax.jit(
+            lambda tower, t, m, p: embed_text(cfg, tower, t, m, p,
+                                              pooling=pooling))
+        self._proj_c = None if proj is None else proj["c"]
+        self._proj_q = None if proj is None else proj["q"]
+
+    def _embed_padded(self, tower, tokens: np.ndarray,
+                      pad_mask: np.ndarray, proj) -> np.ndarray:
+        """Embed in fixed-size batches (ragged tails padded then trimmed) so
+        the jitted tower compiles exactly once per shape family."""
+        bs = self.batch_size
+        n = len(tokens)
+        out = []
+        for i in range(0, n, bs):
+            t = np.asarray(tokens[i:i + bs])
+            m = np.asarray(pad_mask[i:i + bs])
+            got = len(t)
+            if got < bs:
+                t = np.concatenate([t, np.zeros((bs - got,) + t.shape[1:],
+                                                t.dtype)])
+                m = np.concatenate([m, np.zeros((bs - got,) + m.shape[1:],
+                                                m.dtype)])
+            e = np.asarray(self._embed_ctx(tower, jnp.asarray(t),
+                                           jnp.asarray(m), proj))
+            out.append(e[:got])
+        return np.concatenate(out)
+
+    def build(self, blocks) -> np.ndarray:
+        """``blocks``: dataset yielding {tokens, pad_mask} dicts."""
+        tokens = np.stack([blocks[j]["tokens"] for j in range(len(blocks))])
+        masks = np.stack([blocks[j]["pad_mask"] for j in range(len(blocks))])
+        self._embeds = self._embed_padded(self.params["context"], tokens,
+                                          masks, self._proj_c)
+        return self._embeds
+
+    def retrieve(self, query_tokens: np.ndarray, query_pad_mask: np.ndarray,
+                 top_k: int = 5):
+        """→ (indices [b, k], scores [b, k]) over the built corpus."""
+        assert self._embeds is not None, "call build() first"
+        q = self._embed_padded(self.params["query"],
+                               np.asarray(query_tokens),
+                               np.asarray(query_pad_mask), self._proj_q)
+        scores = q @ self._embeds.T  # [b, n]
+        idx = np.argsort(-scores, axis=-1)[:, :top_k]
+        return idx, np.take_along_axis(scores, idx, axis=-1)
