@@ -1,0 +1,64 @@
+"""Process bootstrap for multi-host TPU slices.
+
+Reference parity: megatron/initialize.py:124-151 (_initialize_distributed:
+torch.distributed.init_process_group + device binding).  Under JAX the
+per-host runtime discovers the slice topology itself; this helper wraps
+``jax.distributed.initialize`` with the same call-once, env-driven ergonomics
+and the reference's rendezvous-timeout spirit.
+
+On TPU pods the coordinator/process variables are auto-detected from the
+TPU metadata, so ``initialize_distributed()`` with no arguments is correct;
+on CPU/GPU clusters pass (or export) the coordinator address, process count
+and process id (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+``JAX_PROCESS_ID``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[list] = None,
+) -> None:
+    """Idempotent multi-process runtime init (no-op single-host)."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    if coordinator_address is None:
+        # TPU pods auto-detect the cluster from instance metadata; anything
+        # else without a coordinator is a single-host run.
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        multi_worker = len([h for h in hostnames.split(",") if h]) > 1
+        if multi_worker:
+            # a real multi-host slice must rendezvous — failing here and
+            # continuing single-host would train N divergent copies
+            jax.distributed.initialize()
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized

@@ -40,20 +40,36 @@ def init_biencoder_params(key: jax.Array, cfg: ModelConfig,
         t.pop("binary_head")
         return t
 
-    query = tower(kq)
-    context = query if shared else tower(kc)
-    params: Params = {"query": query, "context": context}
+    # Sharing is structural, not aliasing: a shared model simply has no
+    # "context" subtree (context_tower() falls back to the query tower), so
+    # functional updates cannot silently untie the weights and checkpoints
+    # store them once — the durable form of the reference's
+    # shared_query_context_model.
+    params: Params = {"query": tower(kq)}
+    if not shared:
+        params["context"] = tower(kc)
     if projection_dim:
-        q_proj = _normal(kp, (cfg.hidden_size, projection_dim),
-                         cfg.init_method_std, cfg.dtype)
-        # shared model shares the whole encoder incl. the projection
-        # (shared_query_context_model semantics)
-        c_proj = q_proj if shared else _normal(
-            jax.random.fold_in(kp, 1),
-            (cfg.hidden_size, projection_dim),
-            cfg.init_method_std, cfg.dtype)
-        params["projection"] = {"q": q_proj, "c": c_proj}
+        params["projection"] = {
+            "q": _normal(kp, (cfg.hidden_size, projection_dim),
+                         cfg.init_method_std, cfg.dtype),
+        }
+        if not shared:
+            params["projection"]["c"] = _normal(
+                jax.random.fold_in(kp, 1),
+                (cfg.hidden_size, projection_dim),
+                cfg.init_method_std, cfg.dtype)
     return params
+
+
+def context_tower(params: Params) -> Params:
+    return params.get("context", params["query"])
+
+
+def _context_proj(params: Params):
+    proj = params.get("projection")
+    if proj is None:
+        return None
+    return proj.get("c", proj["q"])
 
 
 def embed_text(cfg: ModelConfig, tower: Params, tokens: jax.Array,
@@ -93,9 +109,9 @@ def biencoder_forward(cfg: ModelConfig, params: Params,
     q = embed_text(cfg, params["query"], query_tokens, query_pad_mask,
                    None if proj is None else proj["q"], qr, deterministic,
                    pooling)
-    c = embed_text(cfg, params["context"], context_tokens, context_pad_mask,
-                   None if proj is None else proj["c"], cr, deterministic,
-                   pooling)
+    c = embed_text(cfg, context_tower(params), context_tokens,
+                   context_pad_mask, _context_proj(params), cr,
+                   deterministic, pooling)
     return q, c
 
 
@@ -141,7 +157,7 @@ class DenseIndex:
         self._embed_ctx = jax.jit(
             lambda tower, t, m, p: embed_text(cfg, tower, t, m, p,
                                               pooling=pooling))
-        self._proj_c = None if proj is None else proj["c"]
+        self._proj_c = _context_proj(params)
         self._proj_q = None if proj is None else proj["q"]
 
     def _embed_padded(self, tower, tokens: np.ndarray,
@@ -169,8 +185,8 @@ class DenseIndex:
         """``blocks``: dataset yielding {tokens, pad_mask} dicts."""
         tokens = np.stack([blocks[j]["tokens"] for j in range(len(blocks))])
         masks = np.stack([blocks[j]["pad_mask"] for j in range(len(blocks))])
-        self._embeds = self._embed_padded(self.params["context"], tokens,
-                                          masks, self._proj_c)
+        self._embeds = self._embed_padded(context_tower(self.params),
+                                          tokens, masks, self._proj_c)
         return self._embeds
 
     def retrieve(self, query_tokens: np.ndarray, query_pad_mask: np.ndarray,
@@ -181,5 +197,9 @@ class DenseIndex:
                                np.asarray(query_tokens),
                                np.asarray(query_pad_mask), self._proj_q)
         scores = q @ self._embeds.T  # [b, n]
-        idx = np.argsort(-scores, axis=-1)[:, :top_k]
+        k = min(top_k, scores.shape[-1])
+        part = np.argpartition(-scores, k - 1, axis=-1)[:, :k]
+        part_scores = np.take_along_axis(scores, part, axis=-1)
+        order = np.argsort(-part_scores, axis=-1)
+        idx = np.take_along_axis(part, order, axis=-1)
         return idx, np.take_along_axis(scores, idx, axis=-1)

@@ -59,8 +59,11 @@ def test_biencoder_shapes_and_shared():
     p_sep = biencoder.init_biencoder_params(jax.random.key(0), cfg)
     p_shared = biencoder.init_biencoder_params(jax.random.key(0), cfg,
                                                shared=True)
-    assert p_shared["query"] is p_shared["context"]
-    assert p_sep["query"] is not p_sep["context"]
+    # structural sharing: no separate context subtree, so functional
+    # updates cannot untie the towers
+    assert "context" not in p_shared
+    assert biencoder.context_tower(p_shared) is p_shared["query"]
+    assert "context" in p_sep
 
     rng = np.random.default_rng(0)
     qt = jnp.asarray(rng.integers(0, 96, (4, 16)), jnp.int32)

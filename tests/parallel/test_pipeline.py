@@ -158,3 +158,48 @@ def test_interleaved_layer_assignment():
     np.testing.assert_array_equal(np.asarray(staged[0, 1]), [2, 3])
     np.testing.assert_array_equal(np.asarray(staged[1, 0]), [4, 5])
     np.testing.assert_array_equal(np.asarray(staged[1, 1]), [6, 7])
+
+
+def test_falcon_style_pipeline_matches_reference():
+    """BASELINE config 3 shape: MQA (kv=1) + parallel attention +
+    parallel LayerNorm through the pipelined schedule (tp=2, pp=2)."""
+    cfg = tiny_config(
+        num_layers=4,
+        num_kv_heads=1,           # MQA
+        norm_type="layernorm",
+        activation="gelu",
+        parallel_attn=True,
+        parallel_layernorm=True,  # Falcon-40B style
+        use_bias=False,
+        qkv_bias=True,            # Falcon-7B attention bias
+        tie_embed_logits=True,
+        params_dtype="float32",
+        recompute="none",
+        seq_length=32,
+        max_position_embeddings=32,
+    )
+    M = 3
+    parallel = ParallelConfig(pipeline_parallel=2, tensor_parallel=2,
+                              num_microbatches=M)
+    mesh = mesh_lib.build_mesh(parallel)
+
+    params = model_lib.init_params(jax.random.key(1), cfg)
+    batch = _batch(cfg, M, mb=2, seed=5)
+
+    ref_loss = _reference_loss(cfg, params, batch)
+    p_params = pipe.to_pipeline_params(params, parallel)
+    specs = shard_lib.param_specs(cfg, parallel)
+    p_specs = pipe.pipeline_param_specs(specs, parallel)
+    p_params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        p_params, p_specs, is_leaf=lambda v: isinstance(v, P))
+
+    runtime = RuntimeConfig(model=cfg, parallel=parallel,
+                            optimizer=OptimizerConfig(),
+                            train=TrainConfig(seq_length=cfg.seq_length))
+    with mesh_lib.use_mesh(mesh):
+        pl_loss = jax.jit(
+            lambda p, b: pipe.pipeline_loss(runtime, p, b, mesh=mesh)
+        )(p_params, batch)
+    np.testing.assert_allclose(np.asarray(pl_loss), np.asarray(ref_loss),
+                               rtol=2e-5, atol=2e-5)

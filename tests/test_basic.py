@@ -32,3 +32,15 @@ def test_tiny_forward():
     logits = jax.jit(lambda p, t: model.forward(cfg, p, t))(params, tokens)
     assert logits.shape == (2, 16, cfg.padded_vocab_size())
     assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_initialize_distributed_single_host_noop():
+    """No coordinator configured → single-host no-op, idempotent."""
+    from megatron_llm_tpu.initialize import (
+        initialize_distributed,
+        is_initialized,
+    )
+
+    initialize_distributed()
+    assert is_initialized()
+    initialize_distributed()  # second call is a no-op
