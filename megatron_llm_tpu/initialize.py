@@ -41,13 +41,17 @@ def initialize_distributed(
         process_id = int(os.environ["JAX_PROCESS_ID"])
 
     if coordinator_address is None:
-        # TPU pods auto-detect the cluster from instance metadata; anything
-        # else without a coordinator is a single-host run.
+        # jax auto-detects several cluster environments; attempt the
+        # rendezvous whenever one is present — silently running single-host
+        # on a real cluster would train N divergent copies.
         hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
-        multi_worker = len([h for h in hostnames.split(",") if h]) > 1
+        multi_worker = (
+            len([h for h in hostnames.split(",") if h]) > 1
+            or int(os.environ.get("SLURM_NTASKS", "1") or 1) > 1
+            or int(os.environ.get("OMPI_COMM_WORLD_SIZE", "1") or 1) > 1
+            or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") is not None
+        )
         if multi_worker:
-            # a real multi-host slice must rendezvous — failing here and
-            # continuing single-host would train N divergent copies
             jax.distributed.initialize()
         _initialized = True
         return

@@ -98,7 +98,7 @@ def unembed(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
     return logits
 
 
-def forward(
+def forward_hidden(
     cfg: ModelConfig,
     params: Params,
     tokens: jax.Array,  # [b, s] int32
@@ -109,14 +109,12 @@ def forward(
     rng: Optional[jax.Array] = None,
     deterministic: bool = True,
     rope: Optional[tuple] = None,
-    return_aux: bool = False,
 ):
-    """Full forward to logits [b, s, padded_vocab] (fp32).
+    """Forward through the final norm → ``(hidden [b,s,h], moe_aux)``.
 
-    With ``return_aux`` also returns the MoE load-balance aux loss
-    (0 for dense models) — the training loss adds it scaled by
-    ``cfg.moe_aux_loss_coeff``.
-    """
+    The pre-unembedding split lets the training loss use the fused
+    linear+CE head (parallel/cross_entropy.fused_linear_cross_entropy)
+    without materializing fp32 logits."""
     if rope is None:
         cos, sin = rope_tables(cfg)
     else:
@@ -141,6 +139,39 @@ def forward(
     x, moe_aux = stack_forward(cfg, params["layers"], x, side, stack_rng)
     x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps,
                    impl=cfg.norm_impl)
+    return x, moe_aux
+
+
+def unembed_weight(cfg: ModelConfig, params: Params) -> jax.Array:
+    """[h, padded_vocab] unembedding matrix (tied or untied)."""
+    if cfg.tie_embed_logits:
+        return params["embedding"]["word"].T
+    return params["lm_head"]
+
+
+def forward(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [b, s] int32
+    *,
+    position_ids: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+    tokentype_ids: Optional[jax.Array] = None,
+    rng: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    rope: Optional[tuple] = None,
+    return_aux: bool = False,
+):
+    """Full forward to logits [b, s, padded_vocab] (fp32).
+
+    With ``return_aux`` also returns the MoE load-balance aux loss
+    (0 for dense models) — the training loss adds it scaled by
+    ``cfg.moe_aux_loss_coeff``.
+    """
+    x, moe_aux = forward_hidden(
+        cfg, params, tokens, position_ids=position_ids,
+        segment_ids=segment_ids, tokentype_ids=tokentype_ids, rng=rng,
+        deterministic=deterministic, rope=rope)
     logits = unembed(cfg, params, x)
     logits = logits.astype(jnp.float32)
     if return_aux:

@@ -139,3 +139,119 @@ def masked_mean_loss(per_token_loss: jax.Array, loss_mask: jax.Array):
     total = jnp.sum(per_token_loss * loss_mask)
     denom = jnp.maximum(jnp.sum(loss_mask), 1.0)
     return total / denom
+
+
+# ---------------------------------------------------------------------------
+# Fused LM head: blockwise linear + cross entropy that never materializes
+# the fp32 logits.  The plain path writes/reads a [b, s, vocab] fp32 tensor
+# several times (the dominant HBM cost of small-hidden models); here the
+# head matmul is streamed over vocab blocks with an online logsumexp in the
+# forward and recomputed blockwise in the backward (the capability analogue
+# of the reference's fused wgrad GEMM accumulation, SURVEY §2.2).
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy(
+    x: jax.Array,       # [n, h] hidden states (flattened tokens)
+    w: jax.Array,       # [h, v_padded] unembedding weight
+    labels: jax.Array,  # [n] int
+    vocab_size: int,
+    block: int = 8192,
+) -> jax.Array:
+    """Per-token CE of ``softmax(x @ w)`` without full fp32 logits."""
+    loss, _res = _flce_fwd_impl(x, w, labels, vocab_size, block)
+    return loss
+
+
+def _vocab_blocks(v_padded: int, block: int):
+    n_blocks = (v_padded + block - 1) // block
+    return n_blocks, n_blocks * block
+
+
+def _flce_fwd_impl(x, w, labels, vocab_size, block):
+    n, h = x.shape
+    v_padded = w.shape[1]
+    n_blocks, v_round = _vocab_blocks(v_padded, block)
+    # pad w on the vocab axis so the scan has uniform blocks; padded columns
+    # are masked to -inf below
+    if v_round != v_padded:
+        w = jnp.pad(w, ((0, 0), (0, v_round - v_padded)))
+    wb = w.reshape(h, n_blocks, block).transpose(1, 0, 2)  # [nb, h, block]
+
+    def body(carry, inp):
+        m, l, tgt = carry
+        w_blk, i = inp
+        logits = jax.lax.dot_general(
+            x, w_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [n, block]
+        col = i * block + jnp.arange(block)
+        logits = jnp.where(col[None, :] < vocab_size, logits, -jnp.inf)
+        blk_max = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        l = l * jnp.exp(m - new_m) + jnp.sum(
+            jnp.exp(logits - new_m[:, None]), axis=-1)
+        # target logit if it falls in this block
+        in_blk = (labels >= i * block) & (labels < (i + 1) * block)
+        idx = jnp.clip(labels - i * block, 0, block - 1)
+        tl = jnp.take_along_axis(logits, idx[:, None], axis=1)[:, 0]
+        tgt = jnp.where(in_blk, tl, tgt)
+        return (new_m, l, tgt), None
+
+    m0 = jnp.full((n,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((n,), jnp.float32)
+    t0 = jnp.zeros((n,), jnp.float32)
+    (m, l, tgt), _ = jax.lax.scan(
+        body, (m0, l0, t0), (wb, jnp.arange(n_blocks)))
+    lse = m + jnp.log(l)
+    # residuals keep the ORIGINAL w: saving the padded copy would hold a
+    # second full [h, v_round] array live through the whole backward
+    return lse - tgt, (x, labels, lse)
+
+
+def _flce_fwd(x, w, labels, vocab_size, block):
+    loss, (x_res, labels_res, lse) = _flce_fwd_impl(
+        x, w, labels, vocab_size, block)
+    return loss, (x_res, w, labels_res, lse)
+
+
+def _flce_bwd(vocab_size, block, res, g):
+    x, w, labels, lse = res
+    n, h = x.shape
+    orig_v = w.shape[1]
+    n_blocks, v_round = _vocab_blocks(orig_v, block)
+    if v_round != orig_v:
+        # re-pad locally (cheap; fuses) instead of having saved the padded
+        # copy in the residuals
+        w = jnp.pad(w, ((0, 0), (0, v_round - orig_v)))
+    wb = w.reshape(h, n_blocks, block).transpose(1, 0, 2)
+
+    def body(dx, inp):
+        w_blk, i = inp
+        logits = jax.lax.dot_general(
+            x, w_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        col = i * block + jnp.arange(block)
+        valid = col[None, :] < vocab_size
+        p = jnp.where(valid, jnp.exp(logits - lse[:, None]), 0.0)
+        onehot = (labels[:, None] == col[None, :]).astype(jnp.float32)
+        d_logits = (p - onehot) * g[:, None]          # [n, block] fp32
+        d_cast = d_logits.astype(w_blk.dtype)
+        dx = dx + jax.lax.dot_general(
+            d_cast, w_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dw_blk = jax.lax.dot_general(
+            x, d_cast, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [h, block]
+        return dx, dw_blk
+
+    dx0 = jnp.zeros((n, h), jnp.float32)
+    dx, dwb = jax.lax.scan(body, dx0, (wb, jnp.arange(n_blocks)))
+    dw = dwb.transpose(1, 0, 2).reshape(h, v_round)[:, :orig_v]
+    import numpy as _np
+
+    dlabels = _np.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return dx.astype(x.dtype), dw.astype(w.dtype), dlabels
+
+
+fused_linear_cross_entropy.defvjp(_flce_fwd, _flce_bwd)

@@ -62,15 +62,40 @@ def compute_loss(cfg: RuntimeConfig, params, batch: dict, rng=None,
     supports the instruction-tuning scalar-weighted masks of
     finetune.py:148-161), optional position_ids/segment_ids.
     """
-    logits, moe_aux = model_lib.forward(
-        cfg.model, params, batch["tokens"],
-        position_ids=batch.get("position_ids"),
-        segment_ids=batch.get("segment_ids"),
-        rng=rng, deterministic=deterministic, rope=rope, return_aux=True,
-    )
-    per_token = cross_entropy(
-        logits, batch["labels"], vocab_size=cfg.model.vocab_size
-    )
+    # Fused linear+CE head: streams the unembedding matmul over vocab
+    # blocks with an online logsumexp so the [b, s, vocab] fp32 logits are
+    # never materialized — a large HBM saving when the head dominates.
+    # Gated off under tp (vocab-sharded CE runs via GSPMD on the plain
+    # path) and cp (flattening the cp-sharded seq would reshard).
+    use_fused = (cfg.model.fused_lm_head
+                 and cfg.parallel.tensor_parallel == 1
+                 and cfg.parallel.context_parallel == 1)
+    if use_fused:
+        from ..models.model import forward_hidden, unembed_weight
+        from ..parallel.cross_entropy import fused_linear_cross_entropy
+
+        hidden, moe_aux = forward_hidden(
+            cfg.model, params, batch["tokens"],
+            position_ids=batch.get("position_ids"),
+            segment_ids=batch.get("segment_ids"),
+            rng=rng, deterministic=deterministic, rope=rope,
+        )
+        b, s, h = hidden.shape
+        per_token = fused_linear_cross_entropy(
+            hidden.reshape(b * s, h), unembed_weight(cfg.model, params),
+            batch["labels"].reshape(b * s), cfg.model.vocab_size,
+        ).reshape(b, s)
+    else:
+        logits, moe_aux = model_lib.forward(
+            cfg.model, params, batch["tokens"],
+            position_ids=batch.get("position_ids"),
+            segment_ids=batch.get("segment_ids"),
+            rng=rng, deterministic=deterministic, rope=rope,
+            return_aux=True,
+        )
+        per_token = cross_entropy(
+            logits, batch["labels"], vocab_size=cfg.model.vocab_size
+        )
     loss = masked_mean_loss(per_token, batch["loss_mask"])
     if cfg.model.num_experts > 0:
         loss = loss + cfg.model.moe_aux_loss_coeff * moe_aux
