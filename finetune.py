@@ -51,6 +51,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     g.add_argument("--model_size", default="7b")
     g.add_argument("--seq_length", type=int, default=None)
     g.add_argument("--rope_scaling_factor", type=float, default=1.0)
+    g.add_argument("--num_experts", type=int, default=0,
+                   help="MoE experts per layer (0 = dense)")
+    g.add_argument("--moe_top_k", type=int, default=2)
+    g.add_argument("--moe_capacity_factor", type=float, default=1.25)
+    g.add_argument("--moe_aux_loss_coeff", type=float, default=0.01)
     g.add_argument("--params_dtype", default="bfloat16",
                    choices=["float32", "bfloat16", "float16"])
     g.add_argument("--attention_impl", default="flash",
@@ -65,6 +70,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                    dest="pp")
     g.add_argument("--dp", "--data_parallel", type=int, default=0, dest="dp",
                    help="0 = infer from device count / (tp*pp*cp)")
+    g.add_argument("--ep", "--expert_parallel", type=int, default=1,
+                   help="expert-parallel axis size (MoE)")
     g.add_argument("--cp", "--context_parallel", type=int, default=1,
                    dest="cp")
     g.add_argument("--virtual_pipeline_stages", type=int, default=1)
@@ -153,6 +160,11 @@ def build_config(args):
         overrides["seq_length"] = args.seq_length
     if args.rope_scaling_factor != 1.0:
         overrides["rope_scaling_factor"] = args.rope_scaling_factor
+    if args.num_experts:
+        overrides.update(
+            num_experts=args.num_experts, moe_top_k=args.moe_top_k,
+            moe_capacity_factor=args.moe_capacity_factor,
+            moe_aux_loss_coeff=args.moe_aux_loss_coeff)
     builders = {
         "llama": lambda: llama1_config(args.model_size, **overrides),
         "llama2": lambda: llama2_config(args.model_size, **overrides),
@@ -165,13 +177,14 @@ def build_config(args):
 
     dp = args.dp
     if dp <= 0:
-        denom = args.tp * args.pp * args.cp
+        denom = args.tp * args.pp * args.cp * args.ep
         dp = max(1, len(jax.devices()) // denom)
     parallel = ParallelConfig(
         data_parallel=dp,
         pipeline_parallel=args.pp,
         tensor_parallel=args.tp,
         context_parallel=args.cp,
+        expert_parallel=args.ep,
         virtual_pipeline_stages=args.virtual_pipeline_stages,
         sequence_parallel=args.sequence_parallel,
         use_distributed_optimizer=args.use_distributed_optimizer,

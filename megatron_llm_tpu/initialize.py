@@ -45,9 +45,15 @@ def initialize_distributed(
         # rendezvous whenever one is present — silently running single-host
         # on a real cluster would train N divergent copies.
         hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        # SLURM: only count srun-launched *step* tasks (SLURM_STEP_NUM_TASKS
+        # + SLURM_PROCID) — a batch allocation with -n 8 that launches one
+        # python process must stay single-host
+        slurm_step = (
+            "SLURM_PROCID" in os.environ
+            and int(os.environ.get("SLURM_STEP_NUM_TASKS", "1") or 1) > 1)
         multi_worker = (
             len([h for h in hostnames.split(",") if h]) > 1
-            or int(os.environ.get("SLURM_NTASKS", "1") or 1) > 1
+            or slurm_step
             or int(os.environ.get("OMPI_COMM_WORLD_SIZE", "1") or 1) > 1
             or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") is not None
         )

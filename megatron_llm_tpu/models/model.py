@@ -91,11 +91,14 @@ def embed(cfg: ModelConfig, params: Params, tokens: jax.Array,
 def unembed(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
     """Project hidden states to (padded-)vocab logits
     (reference: parallel_lm_logits, megatron/model/language_model.py:24-53)."""
+    return x @ unembed_weight(cfg, params)
+
+
+def unembed_weight(cfg: ModelConfig, params: Params) -> jax.Array:
+    """[h, padded_vocab] unembedding matrix (tied or untied)."""
     if cfg.tie_embed_logits:
-        logits = x @ params["embedding"]["word"].T
-    else:
-        logits = x @ params["lm_head"]
-    return logits
+        return params["embedding"]["word"].T
+    return params["lm_head"]
 
 
 def forward_hidden(
@@ -140,13 +143,6 @@ def forward_hidden(
     x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps,
                    impl=cfg.norm_impl)
     return x, moe_aux
-
-
-def unembed_weight(cfg: ModelConfig, params: Params) -> jax.Array:
-    """[h, padded_vocab] unembedding matrix (tied or untied)."""
-    if cfg.tie_embed_logits:
-        return params["embedding"]["word"].T
-    return params["lm_head"]
 
 
 def forward(

@@ -166,8 +166,12 @@ def setup_train_state(
         # [accum, micro_batch, seq] leaves: batch over dp, seq over cp (the
         # cp axis is size 1 unless context parallelism is on).
         batch_sharding = NamedSharding(mesh, P(None, "dp", "cp"))
+        # the copy forces unique buffers: the backend can deduplicate
+        # eagerly-created identical constants (e.g. same-shape zero moment
+        # leaves) and donation rejects a buffer appearing twice
         state = jax.tree.map(
-            lambda x, s: jax.device_put(x, s), state, state_sharding)
+            lambda x, s: jax.device_put(jnp.array(x, copy=True), s),
+            state, state_sharding)
 
         # batch sharding is a pytree prefix: one sharding broadcast over
         # whatever keys the batch dict carries
