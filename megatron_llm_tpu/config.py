@@ -343,6 +343,18 @@ class RuntimeConfig:
             object.__setattr__(
                 self, "model",
                 dataclasses.replace(self.model, context_parallel_axis=None))
+        if self.model.fused_lm_head and (
+                self.parallel.tensor_parallel > 1
+                or self.parallel.context_parallel > 1
+                or self.parallel.pipeline_parallel > 1):
+            # validated here (not in the loss fn) because the pipelined
+            # path never reaches compute_loss at all
+            import warnings
+
+            warnings.warn(
+                "fused_lm_head=True is inactive under tp/cp/pp "
+                "parallelism; the plain logits+CE path will run",
+                stacklevel=2)
         if self.parallel.expert_parallel > 1:
             assert self.model.num_experts > 0, (
                 "expert_parallel > 1 requires a MoE model (num_experts > 0)")

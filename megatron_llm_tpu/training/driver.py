@@ -166,12 +166,9 @@ def setup_train_state(
         # [accum, micro_batch, seq] leaves: batch over dp, seq over cp (the
         # cp axis is size 1 unless context parallelism is on).
         batch_sharding = NamedSharding(mesh, P(None, "dp", "cp"))
-        # the copy forces unique buffers: the backend can deduplicate
-        # eagerly-created identical constants (e.g. same-shape zero moment
-        # leaves) and donation rejects a buffer appearing twice
         state = jax.tree.map(
-            lambda x, s: jax.device_put(jnp.array(x, copy=True), s),
-            state, state_sharding)
+            lambda x, s: jax.device_put(x, s), state, state_sharding)
+        state = _dedupe_buffers(state)
 
         # batch sharding is a pytree prefix: one sharding broadcast over
         # whatever keys the batch dict carries
@@ -183,6 +180,33 @@ def setup_train_state(
 def _put_batch(batch: dict, sharding) -> dict:
     return {k: jax.device_put(jnp.asarray(v), sharding)
             for k, v in batch.items()}
+
+
+def _dedupe_buffers(state: TrainState) -> TrainState:
+    """Materialize distinct buffers for the freshly-zeroed optimizer leaves.
+
+    The backend can deduplicate identical eagerly-created constants (the
+    same-shape zero moment/scaler/counter leaves) into one buffer, and
+    donation rejects a buffer appearing twice in a call.  Copying exactly
+    those leaves allocates only memory the train state needs anyway;
+    params and the fp32 master copies (unique, never aliased) are left
+    untouched, so peak HBM does not grow.
+    """
+    def cp(t):
+        if t is None:
+            return None
+        return jax.tree.map(lambda x: jnp.array(x, copy=True), t)
+
+    return state._replace(
+        opt=state.opt._replace(
+            step=cp(state.opt.step),
+            mu=cp(state.opt.mu),
+            nu=cp(state.opt.nu),
+            scaler=cp(state.opt.scaler),
+        ),
+        iteration=cp(state.iteration),
+        skipped=cp(state.skipped),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -646,14 +670,11 @@ def pretrain_custom(
 
     mesh = mesh_lib.build_mesh(cfg.parallel)
     state = init_train_state(cfg, params)
-    # Replicated params + dp-sharded batch.  The copy forces unique buffers:
-    # eagerly-created zero constants can be deduplicated by the backend, and
-    # donation rejects the same buffer appearing twice in the arguments
-    # (device_put alone no-ops on already-placed arrays).
+    # Replicated params + dp-sharded batch; aliased constant buffers are
+    # copied so donation never sees the same buffer twice.
     replicated = NamedSharding(mesh, P())
     state_sharding = jax.tree.map(lambda _: replicated, state)
-    state = jax.device_put(
-        jax.tree.map(lambda x: jnp.array(x, copy=True), state), replicated)
+    state = _dedupe_buffers(jax.device_put(state, replicated))
     batch_sharding = NamedSharding(mesh, P(None, "dp"))
     step_fn = make_train_step(cfg, mesh, state_sharding, batch_sharding,
                               loss_fn=loss_fn)

@@ -80,7 +80,11 @@ def init_opt_state(params: PyTree, cfg: OptimizerConfig,
     f32_zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
     master = None
     if _needs_master(params):
-        master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+        # copy=True: astype(f32) on an already-fp32 leaf (e.g. the MoE
+        # router in a bf16 model) would return the *same* array, and the
+        # param/master alias breaks buffer donation in the train step
+        master = jax.tree.map(
+            lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params)
     scaler = init_dynamic_scaler(cfg) if use_fp16_scaler else init_scaler(cfg)
     return OptState(
         step=jnp.zeros((), jnp.int32),

@@ -31,24 +31,6 @@ from . import schedule
 
 PyTree = Any
 
-_fused_head_warned = False
-
-
-def _warn_fused_head_disabled():
-    """fused_lm_head requested but gated off (tp/cp sharding — and note the
-    pipeline path never reaches compute_loss at all): say so once instead
-    of silently materializing the fp32 logits the user opted out of."""
-    global _fused_head_warned
-    if not _fused_head_warned:
-        _fused_head_warned = True
-        import warnings
-
-        warnings.warn(
-            "fused_lm_head=True is inactive: the fused head runs only with "
-            "tensor_parallel == context_parallel == 1 and no pipeline "
-            "parallelism; falling back to the plain logits+CE path.",
-            stacklevel=3)
-
 
 class TrainState(NamedTuple):
     params: PyTree
@@ -88,8 +70,6 @@ def compute_loss(cfg: RuntimeConfig, params, batch: dict, rng=None,
     use_fused = (cfg.model.fused_lm_head
                  and cfg.parallel.tensor_parallel == 1
                  and cfg.parallel.context_parallel == 1)
-    if cfg.model.fused_lm_head and not use_fused:
-        _warn_fused_head_disabled()
     if use_fused:
         from ..models.model import forward_hidden, unembed_weight
         from ..parallel.cross_entropy import fused_linear_cross_entropy
