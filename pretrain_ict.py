@@ -1,0 +1,108 @@
+"""ICT (inverse cloze task) bi-encoder pretraining entry point
+(reference: pretrain_ict.py).
+
+Corpus: the sentence-per-item .bin/.idx format of pretrain_bert.py.
+
+Example:
+  python pretrain_ict.py --data_path corpus --vocab_size 30522 \
+      --query_seq_length 64 --block_seq_length 256 --train_iters 1000
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from megatron_llm_tpu.config import (
+    ModelConfig, OptimizerConfig, ParallelConfig, RuntimeConfig, TrainConfig,
+)
+from megatron_llm_tpu.data.ict_dataset import ICTDataset, ICTSpecialTokens
+from megatron_llm_tpu.data.indexed_dataset import MMapIndexedDataset
+from megatron_llm_tpu.models import biencoder
+from megatron_llm_tpu.training.driver import pretrain_custom
+
+
+def get_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data_path", required=True)
+    p.add_argument("--vocab_size", type=int, required=True)
+    p.add_argument("--hidden_size", type=int, default=768)
+    p.add_argument("--num_layers", type=int, default=12)
+    p.add_argument("--num_attention_heads", type=int, default=12)
+    p.add_argument("--query_seq_length", type=int, default=64)
+    p.add_argument("--block_seq_length", type=int, default=256)
+    p.add_argument("--projection_dim", type=int, default=128)
+    p.add_argument("--shared_query_context_model", action="store_true")
+    p.add_argument("--pooling", default="mean", choices=["cls", "mean"],
+                   help="cls matches the reference (warm-started towers); "
+                        "mean trains from scratch")
+    p.add_argument("--micro_batch_size", type=int, default=8)
+    p.add_argument("--global_batch_size", type=int, default=32)
+    p.add_argument("--train_iters", type=int, default=1000)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--save", default=None)
+    p.add_argument("--save_interval", type=int, default=500)
+    p.add_argument("--log_interval", type=int, default=10)
+    p.add_argument("--data_parallel", type=int, default=1)
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--cls_id", type=int, default=None,
+                   help="default: vocab_size-3")
+    p.add_argument("--sep_id", type=int, default=None)
+    p.add_argument("--pad_id", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = get_args(argv)
+    model = ModelConfig(
+        vocab_size=args.vocab_size,
+        hidden_size=args.hidden_size,
+        num_layers=args.num_layers,
+        num_attention_heads=args.num_attention_heads,
+        num_kv_heads=args.num_attention_heads,
+        ffn_hidden_size=4 * args.hidden_size,
+        max_position_embeddings=max(args.query_seq_length,
+                                    args.block_seq_length),
+        norm_type="layernorm", activation="gelu",
+        position_embedding_type="absolute", use_bias=True,
+        tie_embed_logits=True, tokentype_size=2,
+        seq_length=args.block_seq_length,
+    )
+    cfg = RuntimeConfig(
+        model=model,
+        parallel=ParallelConfig(data_parallel=args.data_parallel),
+        optimizer=OptimizerConfig(lr=args.lr, clip_grad=1.0),
+        train=TrainConfig(
+            train_iters=args.train_iters,
+            micro_batch_size=args.micro_batch_size,
+            global_batch_size=args.global_batch_size,
+            seq_length=args.block_seq_length,
+            save=args.save, save_interval=args.save_interval,
+            log_interval=args.log_interval, seed=args.seed,
+        ),
+    ).validate()
+
+    special = ICTSpecialTokens(
+        cls=args.cls_id if args.cls_id is not None else args.vocab_size - 3,
+        sep=args.sep_id if args.sep_id is not None else args.vocab_size - 2,
+        pad=args.pad_id)
+    ds = ICTDataset(
+        MMapIndexedDataset(args.data_path),
+        args.query_seq_length, args.block_seq_length, special,
+        seed=args.seed)
+    params = biencoder.init_biencoder_params(
+        jax.random.key(args.seed), cfg.model,
+        projection_dim=args.projection_dim,
+        shared=args.shared_query_context_model)
+
+    def loss_fn(rcfg, p, mb, rng, deterministic):
+        return biencoder.retrieval_loss(rcfg.model, p, mb, rng,
+                                        deterministic,
+                                        pooling=args.pooling)
+
+    return pretrain_custom(cfg, ds, params, loss_fn)
+
+
+if __name__ == "__main__":
+    main()

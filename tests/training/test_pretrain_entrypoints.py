@@ -55,3 +55,19 @@ def test_pretrain_t5_entrypoint(corpus, tmp_path):
         "--train_iters", "3", "--log_interval", "1",
     ])
     assert int(state.iteration) == 3
+
+
+def test_pretrain_ict_entrypoint(corpus, tmp_path):
+    import pretrain_ict
+
+    state = pretrain_ict.main([
+        "--data_path", corpus,
+        "--vocab_size", "96",
+        "--hidden_size", "32", "--num_layers", "2",
+        "--num_attention_heads", "4",
+        "--query_seq_length", "16", "--block_seq_length", "48",
+        "--projection_dim", "16",
+        "--micro_batch_size", "4", "--global_batch_size", "4",
+        "--train_iters", "3", "--log_interval", "1",
+    ])
+    assert int(state.iteration) == 3
