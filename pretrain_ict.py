@@ -26,7 +26,7 @@ from megatron_llm_tpu.training.driver import pretrain_custom
 def get_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data_path", required=True)
-    p.add_argument("--vocab_size", type=int, required=True)
+    p.add_argument("--vocab_size", type=int, default=None)
     p.add_argument("--hidden_size", type=int, default=768)
     p.add_argument("--num_layers", type=int, default=12)
     p.add_argument("--num_attention_heads", type=int, default=12)
@@ -37,7 +37,12 @@ def get_args(argv=None):
     p.add_argument("--pooling", default="mean", choices=["cls", "mean"],
                    help="cls matches the reference (warm-started towers); "
                         "mean trains from scratch")
-    p.add_argument("--micro_batch_size", type=int, default=8)
+    p.add_argument("--remove_prob", type=float, default=0.9,
+                   help="probability the query sentence is removed from its "
+                        "block (1 - the reference's query_in_block_prob)")
+    # accum == 1 by default: retrieval_loss contrasts within a microbatch,
+    # so grad accumulation would shrink the in-batch-negative pool
+    p.add_argument("--micro_batch_size", type=int, default=32)
     p.add_argument("--global_batch_size", type=int, default=32)
     p.add_argument("--train_iters", type=int, default=1000)
     p.add_argument("--lr", type=float, default=1e-4)
@@ -46,17 +51,54 @@ def get_args(argv=None):
     p.add_argument("--log_interval", type=int, default=10)
     p.add_argument("--data_parallel", type=int, default=1)
     p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--tokenizer_model", default=None,
+                   help="HF tokenizer path/name: derives vocab + special "
+                        "ids (otherwise pass --vocab_size and, for real "
+                        "corpora, --cls_id/--sep_id)")
     p.add_argument("--cls_id", type=int, default=None,
-                   help="default: vocab_size-3")
-    p.add_argument("--sep_id", type=int, default=None)
-    p.add_argument("--pad_id", type=int, default=0)
+                   help="default: tokenizer cls id, else vocab_size-4 "
+                        "(pretrain_bert convention)")
+    p.add_argument("--sep_id", type=int, default=None,
+                   help="default: tokenizer sep id, else vocab_size-3")
+    p.add_argument("--pad_id", type=int, default=None)
     return p.parse_args(argv)
 
 
 def main(argv=None):
     args = get_args(argv)
+    if args.tokenizer_model:
+        from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+
+        tok = build_tokenizer("huggingface", args.tokenizer_model)
+        inner = tok.inner
+        vocab = tok.vocab_size
+        cls_id = (args.cls_id if args.cls_id is not None
+                  else inner.cls_token_id)
+        sep_id = (args.sep_id if args.sep_id is not None
+                  else inner.sep_token_id)
+        pad_id = (args.pad_id if args.pad_id is not None
+                  else (inner.pad_token_id or 0))
+    else:
+        assert args.vocab_size, "--vocab_size required without "            "--tokenizer_model"
+        vocab = args.vocab_size
+        # same reserved-id convention as pretrain_bert.py's tokenizer-less
+        # mode (cls=v-4, sep=v-3, mask=v-2)
+        cls_id = args.cls_id if args.cls_id is not None else vocab - 4
+        sep_id = args.sep_id if args.sep_id is not None else vocab - 3
+        pad_id = args.pad_id if args.pad_id is not None else 0
+
+    accum = args.global_batch_size // (args.micro_batch_size
+                                       * args.data_parallel)
+    if accum > 1:
+        import warnings
+
+        warnings.warn(
+            f"grad accumulation ({accum} microbatches) shrinks the "
+            f"in-batch-negative pool to micro_batch_size="
+            f"{args.micro_batch_size} per contrastive softmax")
+
     model = ModelConfig(
-        vocab_size=args.vocab_size,
+        vocab_size=vocab,
         hidden_size=args.hidden_size,
         num_layers=args.num_layers,
         num_attention_heads=args.num_attention_heads,
@@ -67,6 +109,7 @@ def main(argv=None):
         norm_type="layernorm", activation="gelu",
         position_embedding_type="absolute", use_bias=True,
         tie_embed_logits=True, tokentype_size=2,
+        hidden_dropout=0.1, attention_dropout=0.1,
         seq_length=args.block_seq_length,
     )
     cfg = RuntimeConfig(
@@ -83,14 +126,11 @@ def main(argv=None):
         ),
     ).validate()
 
-    special = ICTSpecialTokens(
-        cls=args.cls_id if args.cls_id is not None else args.vocab_size - 3,
-        sep=args.sep_id if args.sep_id is not None else args.vocab_size - 2,
-        pad=args.pad_id)
+    special = ICTSpecialTokens(cls=cls_id, sep=sep_id, pad=pad_id)
     ds = ICTDataset(
         MMapIndexedDataset(args.data_path),
         args.query_seq_length, args.block_seq_length, special,
-        seed=args.seed)
+        remove_prob=args.remove_prob, seed=args.seed)
     params = biencoder.init_biencoder_params(
         jax.random.key(args.seed), cfg.model,
         projection_dim=args.projection_dim,
