@@ -117,6 +117,10 @@ class ModelConfig:
     # K/V blocks rotated with ppermute (parallel/ring_attention.py).  Set by
     # the runtime when ParallelConfig.context_parallel > 1.
     context_parallel_axis: Optional[str] = None
+    # Balanced zigzag cp layout: the sequence arrives pre-permuted by
+    # zigzag_indices and causal ring work is ~halved.  Set by the runtime
+    # from ParallelConfig.context_parallel_layout.
+    context_parallel_zigzag: bool = False
     # Mixture-of-experts (extension beyond the reference, which has no MoE —
     # SURVEY §2.1 checklist).  num_experts == 0 → dense MLP everywhere.
     num_experts: int = 0
@@ -218,6 +222,10 @@ class ParallelConfig:
     expert_parallel: int = 1
     # context parallelism (ring attention over seq) — extension beyond reference
     context_parallel: int = 1
+    # "contiguous" (default) or "zigzag": the balanced layout gives each cp
+    # rank chunks (r, 2n-1-r) so causal ring work is ~halved
+    # (parallel/ring_attention.py zigzag section); training-path only
+    context_parallel_layout: str = "contiguous"
     # number of microbatches for pipeline / grad accumulation
     num_microbatches: int = 1
     # ZeRO-1: shard optimizer state over dp
@@ -240,6 +248,9 @@ class ParallelConfig:
         # to the plain activation layout).
         if self.pipeline_parallel > 1:
             assert self.num_microbatches >= 1
+        assert self.context_parallel_layout in ("contiguous", "zigzag"), (
+            f"unknown context_parallel_layout "
+            f"{self.context_parallel_layout!r}")
         return self
 
 
@@ -339,10 +350,26 @@ class RuntimeConfig:
             assert self.train.seq_length % self.parallel.context_parallel == 0, (
                 f"seq_length {self.train.seq_length} must divide by "
                 f"context_parallel {self.parallel.context_parallel}")
+            zigzag = self.parallel.context_parallel_layout == "zigzag"
+            if zigzag:
+                assert self.train.seq_length % (
+                    2 * self.parallel.context_parallel) == 0, (
+                    "zigzag layout needs seq_length divisible by 2*cp")
+                assert self.parallel.pipeline_parallel == 1, (
+                    "zigzag cp layout is not plumbed through the pipeline "
+                    "schedule; use the contiguous layout with pp > 1")
+            if self.model.context_parallel_zigzag != zigzag:
+                # set AND clear: a checkpointed zigzag config re-validated
+                # with layout="contiguous" must drop the sticky model flag
+                object.__setattr__(
+                    self, "model",
+                    dataclasses.replace(self.model,
+                                        context_parallel_zigzag=zigzag))
         elif self.model.context_parallel_axis is not None:
             object.__setattr__(
                 self, "model",
-                dataclasses.replace(self.model, context_parallel_axis=None))
+                dataclasses.replace(self.model, context_parallel_axis=None,
+                                    context_parallel_zigzag=False))
         if self.model.fused_lm_head and (
                 self.parallel.tensor_parallel > 1
                 or self.parallel.context_parallel > 1

@@ -224,6 +224,7 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
             dropout_rate=0.0 if side.deterministic else cfg.attention_dropout,
             dropout_rng=drop_rng,
             cp_axis=cfg.context_parallel_axis,
+            cp_zigzag=cfg.context_parallel_zigzag,
         )
     out = ctx.reshape(b, s, nq * d) @ p["wo"]
     if "bo" in p:

@@ -115,6 +115,7 @@ def attention(
     dropout_rng=None,
     bias=None,
     cp_axis: str | None = None,
+    cp_zigzag: bool = False,
     mesh=None,
 ) -> jax.Array:
     """Dispatcher: 'flash' → Pallas kernel (TPU), 'dot' → XLA einsum path.
@@ -134,6 +135,14 @@ def attention(
                 "ring attention (context parallelism) does not support "
                 "attention bias or attention dropout; set "
                 "attention_dropout=0 or disable context_parallel")
+        if cp_zigzag:
+            if not causal:
+                raise ValueError("zigzag cp layout is causal-only")
+            from ..parallel.ring_attention import ring_attention_zigzag
+            return ring_attention_zigzag(
+                q, k, v, mesh=mesh, axis_name=cp_axis,
+                segment_ids=segment_ids, softmax_scale=softmax_scale,
+            )
         from ..parallel.ring_attention import ring_attention
         return ring_attention(
             q, k, v, mesh=mesh, axis_name=cp_axis, causal=causal,

@@ -187,3 +187,184 @@ def ring_attention(
         axis_names={axis_name}, check_vma=False,
     )
     return wrapped(q, k, v, segment_ids)
+
+
+# ---------------------------------------------------------------------------
+# Zigzag (balanced) layout
+# ---------------------------------------------------------------------------
+#
+# With contiguous sharding the causal mask makes ring work triangular: at
+# ring step i only ranks r >= i hold a live block, so wall-clock stays
+# n full blocks while half the computed tiles are masked.  The zigzag
+# layout gives each rank TWO half-size chunks — global chunks (r, 2n-1-r)
+# — so every (rank, step) pair carries ~the same live work and fully-dead
+# sub-blocks are skipped with lax.cond, cutting causal attention time
+# roughly in half at large cp.  The sequence must be pre-permuted with
+# :func:`zigzag_indices` (tokens/labels/masks are tiny int/float arrays, so
+# the device-side gather is negligible); RoPE gets the permutation as
+# explicit position ids.  Per-token math is order-invariant, so training
+# losses need no un-permutation.
+
+
+def zigzag_indices(seq_len: int, cp: int) -> np.ndarray:
+    """Permutation π with zigzag[i] = x[π[i]]: chunk order
+    [0, 2n-1, 1, 2n-2, ...], so the cp-shard r holds chunks (r, 2n-1-r)."""
+    assert seq_len % (2 * cp) == 0, (
+        f"seq_len {seq_len} must divide by 2*cp={2 * cp}")
+    c = seq_len // (2 * cp)
+    order = []
+    for r in range(cp):
+        order.append(r)
+        order.append(2 * cp - 1 - r)
+    idx = np.concatenate([np.arange(ch * c, (ch + 1) * c) for ch in order])
+    return idx
+
+
+def inverse_zigzag_indices(seq_len: int, cp: int) -> np.ndarray:
+    return np.argsort(zigzag_indices(seq_len, cp))
+
+
+def ring_attention_zigzag_local(
+    q: jax.Array,  # [b, 2c, n_heads, d] — chunks (r, 2n-1-r)
+    k: jax.Array,  # [b, 2c, kv_heads, d]
+    v: jax.Array,
+    q_seg: Optional[jax.Array] = None,  # [b, 2c]
+    k_seg: Optional[jax.Array] = None,
+    *,
+    axis_name: str = CP,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Causal ring attention on zigzag-ordered shards (inside shard_map)."""
+    b, s2, nq, d = q.shape
+    c = s2 // 2
+    _, _, nkv, _ = k.shape
+    group = nq // nkv
+    if softmax_scale is None:
+        softmax_scale = 1.0 / float(np.sqrt(d))
+
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    has_seg = q_seg is not None
+    if has_seg and k_seg is None:
+        k_seg = q_seg
+    seg0 = k_seg if has_seg else jnp.zeros((b, s2), jnp.int32)
+
+    # split local q into its two chunks; chunk ids are traced scalars
+    qg = q.reshape(b, 2, c, nkv, group, d)
+    q_chunks = (qg[:, 0], qg[:, 1])          # [b, c, nkv, g, d] each
+    q_ids = (my, 2 * n - 1 - my)
+    q_seg_chunks = ((q_seg[:, :c], q_seg[:, c:]) if has_seg else (None, None))
+
+    local_causal = jnp.tril(jnp.ones((c, c), bool))
+
+    def sub_block(qc_id, q_blk, qs, kc_id, k_blk, v_blk, ks, m, l, acc):
+        """Fold one (q-chunk, k-chunk) pair; skipped when kc > qc."""
+
+        def compute(args):
+            m, l, acc = args
+            scores = jnp.einsum(
+                "bqhgd,bkhd->bhgqk", q_blk, k_blk,
+                preferred_element_type=jnp.float32) * softmax_scale
+            keep = jnp.where(kc_id == qc_id, local_causal, True)
+            scores = jnp.where(keep[None, None, None], scores, -jnp.inf)
+            if has_seg:
+                same = qs[:, :, None] == ks[:, None, :]
+                scores = jnp.where(same[:, None, None], scores, -jnp.inf)
+            blk_max = jnp.max(scores, axis=-1)
+            new_m = jnp.maximum(m, blk_max)
+            safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+            corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+            p = jnp.exp(scores - safe_m[..., None])
+            l = l * corr + jnp.sum(p, axis=-1)
+            corr_a = jnp.transpose(corr, (0, 3, 1, 2))[..., None]
+            pv = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_blk.dtype),
+                            v_blk, preferred_element_type=jnp.float32)
+            return new_m, l, acc * corr_a + pv
+
+        return jax.lax.cond(kc_id <= qc_id, compute,
+                            lambda args: args, (m, l, acc))
+
+    def process_step(states, kb, vb, sb, i):
+        src = (my - i) % n
+        k_ids = (src, 2 * n - 1 - src)
+        kg = kb.reshape(b, 2, c, nkv, d)
+        vg = vb.reshape(b, 2, c, nkv, d)
+        s_halves = (sb[:, :c], sb[:, c:])
+
+        new_states = []
+        for qi_, (q_blk, qc_id, qs) in enumerate(
+                zip(q_chunks, q_ids, q_seg_chunks)):
+            m, l, acc = states[qi_]
+            for ki_ in range(2):
+                m, l, acc = sub_block(
+                    qc_id, q_blk, qs, k_ids[ki_], kg[:, ki_], vg[:, ki_],
+                    s_halves[ki_] if has_seg else None, m, l, acc)
+            new_states.append((m, l, acc))
+        return tuple(new_states)
+
+    def body(carry, i):
+        states, kb, vb, sb = carry
+        states = process_step(states, kb, vb, sb, i)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        if has_seg:
+            sb = jax.lax.ppermute(sb, axis_name, perm)
+        return (states, kb, vb, sb), None
+
+    def init_state():
+        return (jnp.full((b, nkv, group, c), -jnp.inf, jnp.float32),
+                jnp.zeros((b, nkv, group, c), jnp.float32),
+                jnp.zeros((b, c, nkv, group, d), jnp.float32))
+
+    # n-1 rotations in the scan; the final block is folded outside so the
+    # last rotation's collectives are never issued (same peel as the
+    # contiguous ring above)
+    init = ((init_state(), init_state()), k, v, seg0)
+    (states, kb, vb, sb), _ = jax.lax.scan(body, init, jnp.arange(n - 1))
+    states = process_step(states, kb, vb, sb, jnp.int32(n - 1))
+
+    outs = []
+    for m, l, acc in states:
+        l_a = jnp.transpose(l, (0, 3, 1, 2))[..., None]
+        o = jnp.where(l_a > 0.0, acc / jnp.where(l_a > 0.0, l_a, 1.0), 0.0)
+        outs.append(o.reshape(b, c, nq, d))
+    return jnp.concatenate(outs, axis=1).astype(q.dtype)
+
+
+def ring_attention_zigzag(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = CP,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """shard_map wrapper over zigzag-ordered, cp-sharded inputs."""
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is not None and axis_name in getattr(ctx, "manual_axes", ()):
+        return ring_attention_zigzag_local(
+            q, k, v, segment_ids, segment_ids, axis_name=axis_name,
+            softmax_scale=softmax_scale)
+    if ctx is not None and not ctx.empty:
+        mesh = ctx
+    elif mesh is None:
+        mesh = mesh_lib.current_mesh()
+    if mesh is None:
+        raise ValueError("ring_attention_zigzag needs a mesh")
+
+    fn = partial(ring_attention_zigzag_local, axis_name=axis_name,
+                 softmax_scale=softmax_scale)
+    seq = P(None, axis_name)
+    if segment_ids is None:
+        wrapped = jax.shard_map(
+            lambda q_, k_, v_: fn(q_, k_, v_),
+            mesh=mesh, in_specs=(seq, seq, seq), out_specs=seq,
+            axis_names={axis_name}, check_vma=False)
+        return wrapped(q, k, v)
+    wrapped = jax.shard_map(
+        lambda q_, k_, v_, s_: fn(q_, k_, v_, s_, s_),
+        mesh=mesh, in_specs=(seq, seq, seq, seq), out_specs=seq,
+        axis_names={axis_name}, check_vma=False)
+    return wrapped(q, k, v, segment_ids)

@@ -227,6 +227,9 @@ def make_eval_step(cfg: RuntimeConfig, metric_names=(), mesh=None,
         # make_train_step; jit may trace long after the caller's block).
         import contextlib
 
+        from .step import zigzag_permute_batch
+
+        batch = zigzag_permute_batch(cfg, batch)
         ctx = (mesh_lib.use_mesh(mesh) if mesh is not None
                else contextlib.nullcontext())
         with ctx:

@@ -54,6 +54,33 @@ def init_train_state(cfg: RuntimeConfig, params: PyTree) -> TrainState:
     )
 
 
+def zigzag_permute_batch(cfg: RuntimeConfig, batch: dict) -> dict:
+    """Zigzag cp layout: permute the (tiny int/float) batch arrays into
+    chunk order [r, 2n-1-r] per cp shard and hand RoPE the global
+    positions.  Per-token CE, masked means and the registry metrics are
+    order-invariant, so losses need no un-permutation.  No-op unless
+    ``cfg.model.context_parallel_zigzag``.  Used by BOTH the train loss and
+    the eval step — the model's attention is unconditionally zigzag once
+    the flag is set, so any natural-order batch would be silently wrong.
+    """
+    if not cfg.model.context_parallel_zigzag:
+        return batch
+    from ..parallel.ring_attention import zigzag_indices
+
+    pi = zigzag_indices(batch["tokens"].shape[-1],
+                        cfg.parallel.context_parallel)
+    pos = batch.get("position_ids")
+    batch = dict(batch)
+    for key in ("tokens", "labels", "loss_mask", "segment_ids"):
+        if batch.get(key) is not None:
+            batch[key] = batch[key][..., pi]
+    batch["position_ids"] = (
+        pos[..., pi] if pos is not None
+        else jnp.broadcast_to(jnp.asarray(pi, jnp.int32),
+                              batch["tokens"].shape))
+    return batch
+
+
 def compute_loss(cfg: RuntimeConfig, params, batch: dict, rng=None,
                  deterministic: bool = True, rope=None):
     """Forward + masked LM loss for one microbatch.
@@ -67,6 +94,8 @@ def compute_loss(cfg: RuntimeConfig, params, batch: dict, rng=None,
     # never materialized — a large HBM saving when the head dominates.
     # Gated off under tp (vocab-sharded CE runs via GSPMD on the plain
     # path) and cp (flattening the cp-sharded seq would reshard).
+    batch = zigzag_permute_batch(cfg, batch)
+
     use_fused = (cfg.model.fused_lm_head
                  and cfg.parallel.tensor_parallel == 1
                  and cfg.parallel.context_parallel == 1)

@@ -169,3 +169,179 @@ def test_train_step_with_context_parallelism():
     # pipeline (pp=2) combined with ring attention (cp=2)
     loss_pp_cp = run(2, pp=2, dp=1)
     np.testing.assert_allclose(loss_pp_cp, loss_ref, rtol=1e-3, atol=1e-3)
+
+
+def test_train_step_with_zigzag_layout():
+    """context_parallel_layout='zigzag' reproduces the cp=1 loss (the batch
+    permutation + global position ids + balanced ring compose exactly)."""
+    from megatron_llm_tpu.config import (
+        OptimizerConfig, ParallelConfig, RuntimeConfig, TrainConfig,
+        tiny_config,
+    )
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.training.driver import setup_train_state
+
+    gen = np.random.default_rng(11)
+    tokens = gen.integers(0, 64, (1, 4, 32))
+    batch = {
+        "tokens": jnp.asarray(tokens, jnp.int32),
+        "labels": jnp.asarray(np.roll(tokens, -1, axis=-1), jnp.int32),
+        "loss_mask": jnp.ones((1, 4, 32), jnp.float32),
+    }
+
+    def run(cp, layout="contiguous"):
+        cfg = RuntimeConfig(
+            model=tiny_config(),
+            parallel=ParallelConfig(data_parallel=2, context_parallel=cp,
+                                    context_parallel_layout=layout),
+            optimizer=OptimizerConfig(lr=1e-3, clip_grad=1.0),
+            train=TrainConfig(
+                train_iters=2, micro_batch_size=2, global_batch_size=4,
+                seq_length=32, save=None,
+            ),
+        ).validate()
+        if layout == "zigzag":
+            assert cfg.model.context_parallel_zigzag
+        params = model_lib.init_params(jax.random.key(3), cfg.model)
+        art = setup_train_state(cfg, params=params)
+        _, metrics = art.step_fn(art.state, batch, None)
+        return float(metrics["loss"]), float(metrics["grad_norm"])
+
+    loss_ref, gn_ref = run(1)
+    loss_zz, gn_zz = run(4, "zigzag")
+    np.testing.assert_allclose(loss_zz, loss_ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gn_zz, gn_ref, rtol=1e-3, atol=1e-4)
+
+
+def test_zigzag_indices_roundtrip():
+    from megatron_llm_tpu.parallel.ring_attention import (
+        inverse_zigzag_indices, zigzag_indices,
+    )
+
+    for s, cp in [(32, 4), (64, 8), (48, 2)]:
+        pi = zigzag_indices(s, cp)
+        inv = inverse_zigzag_indices(s, cp)
+        x = np.arange(s)
+        np.testing.assert_array_equal(x[pi][inv], x)
+        # shard r holds chunks (r, 2cp-1-r)
+        c = s // (2 * cp)
+        for r in range(cp):
+            shard = pi[r * 2 * c:(r + 1) * 2 * c]
+            assert (shard[:c] == np.arange(r * c, (r + 1) * c)).all()
+            hi = 2 * cp - 1 - r
+            assert (shard[c:] == np.arange(hi * c, (hi + 1) * c)).all()
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+def test_zigzag_ring_matches_dot_causal(devices, rng, cp):
+    from megatron_llm_tpu.parallel.ring_attention import (
+        inverse_zigzag_indices, ring_attention_zigzag, zigzag_indices,
+    )
+
+    mesh = cp_mesh(devices, cp)
+    q, k, v = make_qkv(rng)
+    want = dot_product_attention(q, k, v, causal=True)
+
+    s = q.shape[1]
+    pi = zigzag_indices(s, cp)
+    inv = inverse_zigzag_indices(s, cp)
+    spec = NamedSharding(mesh, P(None, "cp"))
+    qz, kz, vz = (jax.device_put(x[:, pi], spec) for x in (q, k, v))
+    got_z = jax.jit(
+        lambda a, b_, c: ring_attention_zigzag(a, b_, c, mesh=mesh)
+    )(qz, kz, vz)
+    got = np.asarray(got_z)[:, inv]
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-5, rtol=1e-5)
+
+
+def test_zigzag_ring_gradients_match(devices, rng):
+    from megatron_llm_tpu.parallel.ring_attention import (
+        inverse_zigzag_indices, ring_attention_zigzag, zigzag_indices,
+    )
+
+    cp = 4
+    mesh = cp_mesh(devices, cp)
+    q, k, v = make_qkv(rng, s=32)
+    s = q.shape[1]
+    pi = zigzag_indices(s, cp)
+    inv = inverse_zigzag_indices(s, cp)
+    tgt = jnp.asarray(rng.normal(size=q.shape), jnp.float32)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum((dot_product_attention(q_, k_, v_, causal=True)
+                        - tgt) ** 2)
+
+    def loss_zz(q_, k_, v_):
+        out = ring_attention_zigzag(q_[:, pi], k_[:, pi], v_[:, pi],
+                                    mesh=mesh)[:, inv]
+        return jnp.sum((out - tgt) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_zz = jax.jit(jax.grad(loss_zz, argnums=(0, 1, 2)))(q, k, v)
+    for a, b_ in zip(g_ref, g_zz):
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(a),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_zigzag_ring_segment_ids(devices, rng):
+    from megatron_llm_tpu.parallel.ring_attention import (
+        inverse_zigzag_indices, ring_attention_zigzag, zigzag_indices,
+    )
+
+    cp = 4
+    mesh = cp_mesh(devices, cp)
+    b, s = 2, 32
+    q, k, v = make_qkv(rng, b=b, s=s)
+    seg = jnp.asarray(
+        np.stack([np.r_[[0] * 10, [1] * 22], np.r_[[0] * 20, [1] * 12]]),
+        jnp.int32)
+    want = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+    pi = zigzag_indices(s, cp)
+    inv = inverse_zigzag_indices(s, cp)
+    got = jax.jit(
+        lambda a, b_, c, s_: ring_attention_zigzag(a, b_, c, mesh=mesh,
+                                                   segment_ids=s_)
+    )(q[:, pi], k[:, pi], v[:, pi], seg[:, pi])
+    np.testing.assert_allclose(np.asarray(got)[:, inv], np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_eval_step_with_zigzag_layout():
+    """Regression: the eval path must apply the same zigzag permutation as
+    the train loss (natural-order eval batches were silently wrong)."""
+    from megatron_llm_tpu.config import (
+        OptimizerConfig, ParallelConfig, RuntimeConfig, TrainConfig,
+        tiny_config,
+    )
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.parallel import mesh as mesh_lib2
+    from megatron_llm_tpu.training.driver import make_eval_step
+
+    gen = np.random.default_rng(21)
+    tokens = gen.integers(0, 64, (4, 32))
+    batch = {
+        "tokens": jnp.asarray(tokens, jnp.int32),
+        "labels": jnp.asarray(np.roll(tokens, -1, axis=-1), jnp.int32),
+        "loss_mask": jnp.ones((4, 32), jnp.float32),
+    }
+
+    def run(cp, layout="contiguous"):
+        cfg = RuntimeConfig(
+            model=tiny_config(),
+            parallel=ParallelConfig(context_parallel=cp,
+                                    context_parallel_layout=layout),
+            optimizer=OptimizerConfig(),
+            train=TrainConfig(train_iters=1, micro_batch_size=4,
+                              global_batch_size=4, seq_length=32,
+                              save=None),
+        ).validate()
+        params = model_lib.init_params(jax.random.key(3), cfg.model)
+        mesh = mesh_lib2.build_mesh(cfg.parallel)
+        step = make_eval_step(cfg, (), mesh)
+        with mesh_lib2.use_mesh(mesh):
+            out = step(params, batch)
+        return float(out["lm_loss"])
+
+    ref = run(1)
+    zz = run(4, "zigzag")
+    np.testing.assert_allclose(zz, ref, rtol=1e-5, atol=1e-5)
