@@ -72,6 +72,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="0 = infer from device count / (tp*pp*cp)")
     g.add_argument("--ep", "--expert_parallel", type=int, default=1,
                    help="expert-parallel axis size (MoE)")
+    g.add_argument("--cp_layout", "--context_parallel_layout",
+                   default="contiguous", choices=["contiguous", "zigzag"],
+                   help="zigzag balances causal ring-attention work "
+                        "(~2x faster cp attention; pp=1 only)")
     g.add_argument("--cp", "--context_parallel", type=int, default=1,
                    dest="cp")
     g.add_argument("--virtual_pipeline_stages", type=int, default=1)
@@ -184,6 +188,7 @@ def build_config(args):
         pipeline_parallel=args.pp,
         tensor_parallel=args.tp,
         context_parallel=args.cp,
+        context_parallel_layout=args.cp_layout,
         expert_parallel=args.ep,
         virtual_pipeline_stages=args.virtual_pipeline_stages,
         sequence_parallel=args.sequence_parallel,

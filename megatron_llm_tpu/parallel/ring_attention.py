@@ -151,15 +151,19 @@ def ring_attention(
     dp/tp shardings on batch/heads stay GSPMD-managed (partial-manual
     shard_map, the same pattern parallel/pipeline.py uses for 'pp').
     """
-    # Inside another shard_map that already bound the cp axis as Manual
-    # (the pipeline binds {pp, cp} when context parallelism is on), axes
-    # can't be re-bound — the inputs are already local shards, so run the
-    # local ring body directly.
+    fn = partial(ring_attention_local, axis_name=axis_name, causal=causal,
+                 softmax_scale=softmax_scale)
+    return _dispatch_ring(fn, q, k, v, segment_ids, mesh, axis_name)
+
+
+def _dispatch_ring(fn, q, k, v, segment_ids, mesh, axis_name):
+    """Shared wrapper: run ``fn`` directly when the cp axis is already
+    Manual (inside the pipeline's shard_map — axes can't be re-bound), else
+    resolve a mesh (context abstract mesh / current_mesh) and shard_map it
+    with the seq dim manual over ``axis_name``."""
     ctx = jax.sharding.get_abstract_mesh()
     if ctx is not None and axis_name in getattr(ctx, "manual_axes", ()):
-        return ring_attention_local(
-            q, k, v, segment_ids, segment_ids, axis_name=axis_name,
-            causal=causal, softmax_scale=softmax_scale)
+        return fn(q, k, v, segment_ids, segment_ids)
     if ctx is not None and not ctx.empty:
         # Auto context mesh (tracing under jit with a mesh context): the
         # nested shard_map must use exactly the context mesh object.
@@ -168,11 +172,9 @@ def ring_attention(
         mesh = mesh_lib.current_mesh()
     if mesh is None:
         raise ValueError(
-            "ring_attention needs a mesh (pass mesh= or enter "
+            "ring attention needs a mesh (pass mesh= or enter "
             "parallel.mesh.use_mesh)")
 
-    fn = partial(ring_attention_local, axis_name=axis_name, causal=causal,
-                 softmax_scale=softmax_scale)
     seq = P(None, axis_name)
     if segment_ids is None:
         wrapped = jax.shard_map(
@@ -342,29 +344,6 @@ def ring_attention_zigzag(
     softmax_scale: Optional[float] = None,
 ) -> jax.Array:
     """shard_map wrapper over zigzag-ordered, cp-sharded inputs."""
-    ctx = jax.sharding.get_abstract_mesh()
-    if ctx is not None and axis_name in getattr(ctx, "manual_axes", ()):
-        return ring_attention_zigzag_local(
-            q, k, v, segment_ids, segment_ids, axis_name=axis_name,
-            softmax_scale=softmax_scale)
-    if ctx is not None and not ctx.empty:
-        mesh = ctx
-    elif mesh is None:
-        mesh = mesh_lib.current_mesh()
-    if mesh is None:
-        raise ValueError("ring_attention_zigzag needs a mesh")
-
     fn = partial(ring_attention_zigzag_local, axis_name=axis_name,
                  softmax_scale=softmax_scale)
-    seq = P(None, axis_name)
-    if segment_ids is None:
-        wrapped = jax.shard_map(
-            lambda q_, k_, v_: fn(q_, k_, v_),
-            mesh=mesh, in_specs=(seq, seq, seq), out_specs=seq,
-            axis_names={axis_name}, check_vma=False)
-        return wrapped(q, k, v)
-    wrapped = jax.shard_map(
-        lambda q_, k_, v_, s_: fn(q_, k_, v_, s_, s_),
-        mesh=mesh, in_specs=(seq, seq, seq, seq), out_specs=seq,
-        axis_names={axis_name}, check_vma=False)
-    return wrapped(q, k, v, segment_ids)
+    return _dispatch_ring(fn, q, k, v, segment_ids, mesh, axis_name)

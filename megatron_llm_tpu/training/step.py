@@ -71,7 +71,8 @@ def zigzag_permute_batch(cfg: RuntimeConfig, batch: dict) -> dict:
                         cfg.parallel.context_parallel)
     pos = batch.get("position_ids")
     batch = dict(batch)
-    for key in ("tokens", "labels", "loss_mask", "segment_ids"):
+    for key in ("tokens", "labels", "loss_mask", "segment_ids",
+                "assistant_mask", "pad_mask"):
         if batch.get(key) is not None:
             batch[key] = batch[key][..., pi]
     batch["position_ids"] = (
@@ -205,6 +206,11 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
     if loss_fn is not None and cfg.parallel.pipeline_parallel > 1:
         raise NotImplementedError(
             "custom loss_fn is not supported with pipeline parallelism")
+    if loss_fn is not None and cfg.model.context_parallel_zigzag:
+        # the zigzag batch permutation lives in compute_loss; a custom loss
+        # would silently run zigzag attention on natural-order tokens
+        raise NotImplementedError(
+            "custom loss_fn is not supported with the zigzag cp layout")
     train_iters = cfg.train.train_iters
     it = state.iteration
     rng = None
