@@ -20,12 +20,17 @@ class MetricInput:
     """Lazily-derived per-batch quantities shared across metrics
     (reference MetricInput, metrics.py:62-99)."""
 
-    def __init__(self, batch: dict, logits: jax.Array,
-                 per_token_loss: jax.Array):
+    def __init__(self, batch: dict, logits: Optional[jax.Array],
+                 per_token_loss: jax.Array,
+                 correct: Optional[jax.Array] = None):
         self.batch = batch  # tokens/labels/loss_mask (+segment/assistant masks)
-        self.logits = logits  # [b, s, vocab]
+        self.logits = logits  # [b, s, vocab]; may be None if `correct` given
         self.per_token_loss = per_token_loss  # [b, s]
         self._predictions: Optional[jax.Array] = None
+        # Precomputed argmax-correctness [b, s]: the pipelined eval step
+        # (pp > 1) streams the head inside the tick loop, so full logits
+        # never exist outside the pipeline — it supplies `correct` directly.
+        self._correct = correct
 
     @property
     def loss_mask(self) -> jax.Array:
@@ -44,11 +49,17 @@ class MetricInput:
     @property
     def predictions(self) -> jax.Array:
         if self._predictions is None:
+            if self.logits is None:
+                raise ValueError(
+                    "MetricInput built without logits (pipelined eval) — "
+                    "only correctness-based metrics are available")
             self._predictions = jnp.argmax(self.logits, axis=-1)
         return self._predictions
 
     @property
     def correct(self) -> jax.Array:
+        if self._correct is not None:
+            return self._correct
         return (self.predictions == self.batch["labels"]).astype(jnp.float32)
 
 
@@ -93,7 +104,9 @@ def validate_metric_names(names) -> None:
             f"unknown metrics {unknown}; available: {sorted(METRICS)}")
 
 
-def compute_metrics(names, batch: dict, logits: jax.Array,
-                    per_token_loss: jax.Array) -> dict[str, jax.Array]:
-    inp = MetricInput(batch, logits, per_token_loss)
+def compute_metrics(names, batch: dict, logits: Optional[jax.Array],
+                    per_token_loss: jax.Array,
+                    correct: Optional[jax.Array] = None
+                    ) -> dict[str, jax.Array]:
+    inp = MetricInput(batch, logits, per_token_loss, correct=correct)
     return {n: METRICS[n](inp) for n in names}

@@ -30,6 +30,21 @@ Schedule shape (T = ticks):
 Bubble fraction = (pp-1)/(M·vpp + pp - 1): interleaving divides the bubble
 by vpp exactly as in the reference's interleaved 1F1B.
 
+Memory design (docs/pipeline_memory.md derives and measures this):
+microbatches are *streamed*.  The shard_map boundary carries only int32
+tokens/labels/masks and scalar losses — stage 0 embeds microbatch ``t`` on
+demand inside the tick and the last stage runs the CE head on each finished
+microbatch inside the tick, so no ``[M, mb, s, h]`` hidden-state buffer
+(input, output, or fp32 boundary copy) ever exists.  Per-device activation
+memory is T boundary tensors ``[mb, s_local, h]`` (scan residuals, compute
+dtype) + the model's own remat-policy residuals per tick + (vpp>1 only) the
+``[M, mb, s_local, h]`` circular re-entry buffer.  The reference's 1F1B
+bounds in-flight microbatches at ≤pp (schedules.py:606-722); the streamed
+scan holds M·vpp boundary tensors instead, which at BASELINE config-5 shapes
+(70B, s=4096, mb=1, pp=8, M=16) is ~1.5 GB bf16 per device — small next to
+params+opt state, and the price of getting the backward schedule for free
+from ``jax.grad``.
+
 Layer→stage assignment matches the reference (megatron/model/
 transformer.py:1015-1060): chunk v on stage s holds global layers
 ``[(v·pp + s)·lpc, (v·pp + s + 1)·lpc)`` — i.e. ``layers.reshape(vpp, pp,
@@ -48,7 +63,7 @@ from ..config import ModelConfig, ParallelConfig, RuntimeConfig
 from ..models.transformer import AttnSideInputs, stack_forward
 from ..models import model as model_lib
 from ..ops.norms import norm_apply
-from .cross_entropy import cross_entropy, masked_mean_loss
+from .cross_entropy import cross_entropy
 from . import mesh as mesh_lib
 
 PyTree = Any
@@ -154,63 +169,221 @@ def _stage_tick(cfg: ModelConfig, chunks: PyTree, chunk_idx, x, side,
     return stack_forward(cfg, chunk, x, side, rng)
 
 
-def pipeline_apply(
+# ---------------------------------------------------------------------------
+# Analytic activation-memory model (validated by
+# tests/parallel/test_pipeline_memory.py; derived in docs/pipeline_memory.md)
+# ---------------------------------------------------------------------------
+
+
+def pipeline_activation_bytes(
     cfg: ModelConfig,
-    staged_layers: PyTree,  # [vpp, pp, lpc, ...] sharded P(None,'pp',None,…)
-    x_mb: jax.Array,  # [M, mb, s, h] microbatched hidden states
-    side_mb: AttnSideInputs,  # leaves with leading [M] dim or None
+    *,
+    pp: int,
+    vpp: int,
+    M: int,
+    mb: int,
+    seq_shard: int,
+    recompute: str = "full",
+) -> dict:
+    """Estimated per-device activation memory of one pipelined train step.
+
+    ``seq_shard`` is the per-device sequence length *after* sequence/context
+    sharding (s / (tp_sp · cp)).  Returns the individual terms plus an
+    ``upper_bound`` with 2× slack that the memory test asserts against
+    ``compile().memory_analysis().temp_size_in_bytes``.
+
+    Terms (B = compute-dtype bytes, T = M·vpp + pp - 1, lpc = layers/chunk):
+
+    - ``boundary``: the scan saves each tick's input and output boundary
+      tensor [mb, seq_shard, h] for the backward replay → 2·T·mb·s·h·B.
+    - ``layer_residuals``: per-tick per-layer saved values, governed by the
+      remat policy: 'full' saves only each layer's checkpoint input (c=1),
+      'selective' keeps a few mlp/attn boundaries (c≈4), 'none' keeps all
+      internals (c≈4 + 3·ffn/h, GLU counted).
+    - ``circ``: the vpp>1 circular re-entry buffer, M·mb·s·h·B.
+    - ``head``: transient fp32 logits blocks, ≈3·mb·s·V·4 (fwd value,
+      softmax, dlogits — the head is checkpointed so these never stack
+      across ticks).
+    - ``io_grads``: fp32 cotangent accumulators for the replicated
+      embedding/head params, ≈2·V·h·4.
+    """
+    h = cfg.hidden_size
+    lpc = cfg.num_layers // (pp * vpp)
+    T = M * vpp + pp - 1
+    B = 2 if cfg.dtype == jnp.bfloat16 else 4
+    v = cfg.padded_vocab_size()
+
+    per_boundary = mb * seq_shard * h * B
+    boundary = 2 * T * per_boundary
+    c = {"full": 1.0,
+         "selective": 4.0,
+         "none": 4.0 + 3.0 * cfg.ffn_size / h}[recompute]
+    layer_residuals = int(T * lpc * c * per_boundary)
+    circ = (M * per_boundary) if vpp > 1 else 0
+    head = 3 * mb * seq_shard * v * 4
+    io_grads = 2 * v * h * 4
+    terms = {
+        "boundary": boundary,
+        "layer_residuals": layer_residuals,
+        "circ": circ,
+        "head": head,
+        "io_grads": io_grads,
+    }
+    terms["total"] = sum(terms.values())
+    terms["upper_bound"] = 2 * terms["total"]
+    return terms
+
+
+# ---------------------------------------------------------------------------
+# Full-model pipelined loss (streamed)
+# ---------------------------------------------------------------------------
+
+
+def pipeline_loss(
+    cfg: RuntimeConfig,
+    params: PyTree,  # pipeline layout (to_pipeline_params)
+    batch: dict,  # leaves [M, mb, ...]
     *,
     mesh,
-    pp: int,
-    vpp: int = 1,
     rng: Optional[jax.Array] = None,
-) -> tuple:
-    """Run all M microbatches through the pipelined decoder stack.
+    rope=None,
+    return_stats: bool = False,
+):
+    """Mean masked LM loss over M microbatches through the pipeline.
 
-    Returns ``(hidden [M, mb, s, h] replicated over 'pp', moe_aux scalar)``
-    — moe_aux sums the per-layer MoE load-balance losses over all layers and
-    microbatches (0 for dense models).
+    Mirrors the per-microbatch loss averaging of the reference schedules
+    (schedules.py:129-139 collects per-microbatch losses; training.py:444-452
+    averages).  Embedding and CE head are *streamed inside the tick loop*:
+    stage 0 embeds microbatch ``t`` on demand and the last stage runs the
+    head on each finished microbatch — the wall-clock equivalent of the
+    reference's first/last-stage placement, without ever materializing
+    ``[M, mb, s, h]`` hidden-state buffers on every device, and the
+    tied-embedding all-reduce of module.py:52-121 becomes unnecessary
+    (the tied embedding is one logical array whose cotangents from the
+    embed and head use sites accumulate through the shard_map transpose).
+
+    ``return_stats`` additionally returns per-token fp32 eval statistics
+    ``{"per_token_loss": [M, mb, s], "correct": [M, mb, s]}`` so the
+    registry metrics (metrics.py) work under pp > 1 — the reference computes
+    metrics at any parallelism (megatron/metrics.py:62-110).
     """
-    M = x_mb.shape[0]
+    model_cfg = cfg.model
+    parallel = cfg.parallel
+    pp = parallel.pipeline_parallel
+    vpp = parallel.virtual_pipeline_stages
+
+    if rope is None:
+        from ..models.transformer import rope_tables
+        rope = rope_tables(model_cfg)
+    cos, sin = rope
+
+    tokens = batch["tokens"]  # [M, mb, s]
+    M = tokens.shape[0]
     if vpp > 1:
         assert M >= pp, (
             f"interleaved pipeline needs num_microbatches ≥ pp ({M} < {pp})"
         )
     T = M * vpp + pp - 1
-
     ring = [(s, (s + 1) % pp) for s in range(pp)]
+    compute_dtype = model_cfg.dtype
 
-    compute_dtype = x_mb.dtype
+    embed_rng = stack_rng = None
+    if rng is not None:
+        embed_rng, stack_rng = jax.random.split(rng)
+    deterministic = rng is None
 
-    def pipelined(chunks, x_all, pos_mb, seg_mb):
+    # Per-use-site cast to compute dtype: callers may hold fp32 params so
+    # that cross-tick cotangent accumulation (the scan transposes) runs in
+    # fp32, matching _accumulate_grads' per-microbatch fp32 sum.
+    def cast(tree):
+        return jax.tree.map(lambda x: x.astype(model_cfg.dtype), tree)
+
+    position_ids = batch.get("position_ids")
+    cp_axis = model_cfg.context_parallel_axis
+    if cp_axis is not None and position_ids is None:
+        # Inside the manual-cp pipeline body each shard sees only its local
+        # sequence chunk, so RoPE needs explicit *global* positions.
+        s = tokens.shape[-1]
+        position_ids = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32),
+                                        tokens.shape)
+
+    # Embedding + head params cross the shard_map boundary replicated over
+    # the manual axes (auto axes — tp — still shard them via GSPMD).
+    io_params = {"embedding": params["embedding"],
+                 "final_norm": params["final_norm"]}
+    if "lm_head" in params:
+        io_params["lm_head"] = params["lm_head"]
+
+    labels = batch["labels"]
+    loss_mask = batch["loss_mask"]
+    seg = batch.get("segment_ids")
+
+    def pipelined(chunks, io_p, tokens, labels, loss_mask, pos_mb, seg_mb):
         # chunks: [vpp, 1, lpc, ...] (pp axis manual) → squeeze stage dim
         chunks_local = jax.tree.map(lambda c: c[:, 0], chunks)
-        # The boundary crossing runs in f32 (see call site); compute in the
-        # model dtype inside.
-        x_all = x_all.astype(compute_dtype)
         stage = jax.lax.axis_index(PP)
-        side_all = AttnSideInputs(
-            rope_cos=side_mb.rope_cos, rope_sin=side_mb.rope_sin,
-            position_ids=pos_mb, segment_ids=seg_mb,
-            deterministic=side_mb.deterministic,
-        )
 
-        mb_shape = x_all.shape[1:]
-        outputs = jnp.zeros((M,) + mb_shape, x_all.dtype)
-        circ = (jnp.zeros((M,) + mb_shape, x_all.dtype)
+        mb_shape = tokens.shape[1:] + (model_cfg.hidden_size,)
+        circ = (jnp.zeros((M,) + mb_shape, compute_dtype)
                 if vpp > 1 else None)
+        stats0 = None
+        if return_stats:
+            stats0 = (jnp.zeros(tokens.shape, jnp.float32),   # per-token CE
+                      jnp.zeros(tokens.shape, jnp.float32))   # argmax correct
+
+        def cp_sum(x):
+            return jax.lax.psum(x, cp_axis) if cp_axis is not None else x
+
+        def head_fn(h, lab, msk):
+            """Final norm → unembed → CE on one finished microbatch.
+
+            Runs on every device each tick (SPMD); the result is masked to
+            the last stage.  Checkpointed so the [mb, s, vocab] fp32 logits
+            are a transient of each tick, not a saved residual.
+            """
+            hp = cast(io_p)
+            h = norm_apply(model_cfg.norm_type, h, hp["final_norm"],
+                           model_cfg.norm_eps, impl=model_cfg.norm_impl)
+            logits = model_lib.unembed(model_cfg, hp, h).astype(jnp.float32)
+            per_token = cross_entropy(logits, lab,
+                                      vocab_size=model_cfg.vocab_size)
+            msk = msk.astype(jnp.float32)
+            # masked mean with cp-global sums (the head runs inside the
+            # manual-cp region, so seq reductions need explicit psums)
+            num = cp_sum(jnp.sum(per_token * msk))
+            den = jnp.maximum(cp_sum(jnp.sum(msk)), 1.0)
+            correct = None
+            if return_stats:
+                correct = (jnp.argmax(logits, axis=-1) == lab
+                           ).astype(jnp.float32)
+            return num / den, per_token, correct
+
+        head_fn = jax.checkpoint(head_fn, prevent_cse=False)
 
         def tick(carry, t):
-            state, circ, outputs, aux_sum = carry
+            state, circ, aux_sum, loss_sum, stats = carry
             # Which microbatch / chunk this stage works on at tick t.
             rel = t - stage  # ticks since this stage first saw work
             m_idx = jnp.clip(rel, 0, None) % M
             chunk_idx = jnp.clip(rel // M, 0, vpp - 1)
 
-            # Stage-0 input: fresh microbatch while t < M, then wrapped
-            # microbatches from circular storage.
-            fresh = jax.lax.dynamic_index_in_dim(
-                x_all, jnp.minimum(t, M - 1), 0, keepdims=False)
+            # Stage-0 input: embed a fresh microbatch on demand while t < M,
+            # then wrapped microbatches from circular storage.  The embed is
+            # computed everywhere and selected on stage 0 — its cotangent is
+            # zero elsewhere (the jnp.where transpose), so embedding grads
+            # are exact.
+            t_in = jnp.minimum(t, M - 1)
+            tok = jax.lax.dynamic_index_in_dim(tokens, t_in, 0,
+                                               keepdims=False)
+            pos_in = (None if pos_mb is None else
+                      jax.lax.dynamic_index_in_dim(pos_mb, t_in, 0,
+                                                   keepdims=False))
+            er = (None if embed_rng is None
+                  else jax.random.fold_in(embed_rng, t_in))
+            fresh = model_lib.embed(
+                model_cfg, {"embedding": cast(io_p["embedding"])},
+                tok, pos_in, None, er, deterministic,
+            ).astype(compute_dtype)
             if circ is not None:
                 wrapped = jax.lax.dynamic_index_in_dim(
                     circ, t % M, 0, keepdims=False)
@@ -220,40 +393,54 @@ def pipeline_apply(
             current = jnp.where(stage == 0, inp, state)
 
             tick_rng = None
-            if rng is not None:
+            if stack_rng is not None:
                 # unique stream per (microbatch, ring position)
                 tick_rng = jax.random.fold_in(
-                    jax.random.fold_in(rng, m_idx),
+                    jax.random.fold_in(stack_rng, m_idx),
                     chunk_idx * pp + stage)
 
             sel_side = AttnSideInputs(
-                rope_cos=side_all.rope_cos, rope_sin=side_all.rope_sin,
-                position_ids=(None if side_all.position_ids is None else
+                rope_cos=cos, rope_sin=sin,
+                position_ids=(None if pos_mb is None else
                               jax.lax.dynamic_index_in_dim(
-                                  side_all.position_ids, m_idx, 0,
-                                  keepdims=False)),
-                segment_ids=(None if side_all.segment_ids is None else
+                                  pos_mb, m_idx, 0, keepdims=False)),
+                segment_ids=(None if seg_mb is None else
                              jax.lax.dynamic_index_in_dim(
-                                 side_all.segment_ids, m_idx, 0,
-                                 keepdims=False)),
-                deterministic=side_all.deterministic,
+                                 seg_mb, m_idx, 0, keepdims=False)),
+                deterministic=deterministic,
             )
 
-            out, tick_aux = _stage_tick(cfg, chunks_local, chunk_idx,
+            out, tick_aux = _stage_tick(model_cfg, chunks_local, chunk_idx,
                                         current, sel_side, tick_rng)
             # Bubble ticks (warmup garbage / cooldown re-runs) must not
             # contribute MoE aux loss.
             tick_valid = (rel >= 0) & (rel < M * vpp)
             aux_sum = aux_sum + jnp.where(tick_valid, tick_aux, 0.0)
 
-            # Last stage collects finished microbatches (final chunk only).
+            # Streamed head: the microbatch finishing at tick t (last
+            # chunk, last stage) goes through norm→unembed→CE right here.
             out_idx = t - (vpp - 1) * M - (pp - 1)
-            valid = out_idx >= 0
+            head_valid = (out_idx >= 0) & (stage == pp - 1)
             w_idx = jnp.clip(out_idx, 0, M - 1)
-            existing = jax.lax.dynamic_index_in_dim(
-                outputs, w_idx, 0, keepdims=False)
-            outputs = jax.lax.dynamic_update_index_in_dim(
-                outputs, jnp.where(valid, out, existing), w_idx, 0)
+            lab_m = jax.lax.dynamic_index_in_dim(labels, w_idx, 0,
+                                                 keepdims=False)
+            msk_m = jax.lax.dynamic_index_in_dim(loss_mask, w_idx, 0,
+                                                 keepdims=False)
+            mb_loss, per_tok, correct = head_fn(out, lab_m, msk_m)
+            loss_sum = loss_sum + jnp.where(head_valid, mb_loss, 0.0)
+
+            if stats is not None:
+                pt_buf, ok_buf = stats
+                sel = head_valid.astype(jnp.float32)
+                pt_old = jax.lax.dynamic_index_in_dim(pt_buf, w_idx, 0,
+                                                      keepdims=False)
+                ok_old = jax.lax.dynamic_index_in_dim(ok_buf, w_idx, 0,
+                                                      keepdims=False)
+                pt_buf = jax.lax.dynamic_update_index_in_dim(
+                    pt_buf, sel * per_tok + (1 - sel) * pt_old, w_idx, 0)
+                ok_buf = jax.lax.dynamic_update_index_in_dim(
+                    ok_buf, sel * correct + (1 - sel) * ok_old, w_idx, 0)
+                stats = (pt_buf, ok_buf)
 
             # Rotate the ring: stage s → s+1; stage 0 receives the wrap
             # from the last stage.
@@ -269,171 +456,57 @@ def pipeline_apply(
                 circ = jax.lax.dynamic_update_index_in_dim(
                     circ, jnp.where(c_valid, shifted, c_existing), c_idx, 0)
 
-            return (shifted, circ, outputs, aux_sum), None
+            return (shifted, circ, aux_sum, loss_sum, stats), None
 
-        init = (jnp.zeros(mb_shape, x_all.dtype), circ, outputs,
-                jnp.zeros((), jnp.float32))
-        (_, _, outputs, aux_sum), _ = jax.lax.scan(tick, init, jnp.arange(T))
+        init = (jnp.zeros(mb_shape, compute_dtype), circ,
+                jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+                stats0)
+        (_, _, aux_sum, loss_sum, stats), _ = jax.lax.scan(
+            tick, init, jnp.arange(T))
 
-        # Only the last stage's buffer holds real data; make the result
-        # invariant over 'pp' with a masked psum (cheap: [M, mb, s, h] once).
-        # The psum runs in f32: XLA's CPU AllReducePromotion pass crashes on
-        # bf16 all-reduces emitted by partial-auto shard_map (repro'd on
-        # jax 0.9.0 CPU), and one f32 transfer of the boundary tensor is
-        # noise next to the per-tick ring traffic.
-        mask = (stage == pp - 1).astype(jnp.float32)
-        out32 = jax.lax.psum(outputs.astype(jnp.float32) * mask, PP)
+        # Only the last stage accumulated real losses; the psums make the
+        # scalars (and the small [M, mb, s] eval stats) pp-invariant.  All
+        # boundary collectives here are fp32 — partial-auto shard_map lowers
+        # bf16 all-reduces to a form that crashes XLA:CPU's
+        # AllReducePromotion pass (jax 0.9.0), and the streamed design only
+        # ever reduces fp32 scalars/stats anyway.
+        loss_total = jax.lax.psum(loss_sum, PP)
         # Each (stage, chunk) processed every microbatch exactly once, so
         # the pp-sum of the local aux sums covers all L layers × M
         # microbatches; cp shards see equal token slices → mean over cp.
         aux = jax.lax.psum(aux_sum, PP)
         if cp_axis is not None:
             aux = jax.lax.pmean(aux, cp_axis)
-        return out32.astype(outputs.dtype), aux
+        if stats is not None:
+            stats = tuple(jax.lax.psum(b, PP) for b in stats)
+        return loss_total, aux, stats
 
-    layer_in_specs = jax.tree.map(
-        lambda _: P(None, PP), staged_layers)
-    pos = side_mb.position_ids
-    seg = side_mb.segment_ids
-    # With context parallelism the cp axis joins the manual set: activations
-    # stay seq-sharded through the stage bodies and ring attention
-    # (parallel/ring_attention.py) runs its ppermute ring directly inside
-    # this shard_map (axes can't be re-bound by a nested one).
-    cp_axis = cfg.context_parallel_axis
+    layer_in_specs = jax.tree.map(lambda _: P(None, PP), params["layers"])
     if cp_axis is not None:
         manual_axes = {PP, cp_axis}
-        x_spec = P(None, None, cp_axis, None)  # [M, mb, s, h]
         side_spec = P(None, None, cp_axis)  # [M, mb, s]
-        assert pos is not None, (
-            "pipeline with context parallelism needs explicit global "
-            "position_ids (pipeline_loss supplies them)")
+        assert position_ids is not None
     else:
         manual_axes = {PP}
-        x_spec = side_spec = P()
+        side_spec = P()
+    stats_spec = (side_spec, side_spec) if return_stats else None
     fn = jax.shard_map(
         pipelined,
         mesh=mesh,
-        in_specs=(layer_in_specs, x_spec, side_spec, side_spec),
-        out_specs=(x_spec, P()),
+        in_specs=(layer_in_specs, P(), side_spec, side_spec, side_spec,
+                  side_spec, side_spec),
+        out_specs=(P(), P(), stats_spec),
         axis_names=manual_axes,
         check_vma=False,
     )
-    # The replicated (P()) input's transpose is a psum of its cotangent over
-    # 'pp'; cross the boundary in f32 — partial-auto shard_map lowers bf16
-    # all-reduces to a form that crashes XLA:CPU's AllReducePromotion pass
-    # (jax 0.9.0), and f32 here also gives exact cotangent accumulation.
-    out, moe_aux = fn(staged_layers, x_mb.astype(jnp.float32), pos, seg)
-    return out.astype(compute_dtype), moe_aux
+    loss_total, moe_aux, stats = fn(params["layers"], io_params, tokens,
+                                    labels, loss_mask, position_ids, seg)
 
-
-# ---------------------------------------------------------------------------
-# Full-model pipelined loss
-# ---------------------------------------------------------------------------
-
-
-def pipeline_loss(
-    cfg: RuntimeConfig,
-    params: PyTree,  # pipeline layout (to_pipeline_params)
-    batch: dict,  # leaves [M, mb, ...]
-    *,
-    mesh,
-    rng: Optional[jax.Array] = None,
-    rope=None,
-):
-    """Mean masked LM loss over M microbatches through the pipeline.
-
-    Mirrors the per-microbatch loss averaging of the reference schedules
-    (schedules.py:129-139 collects per-microbatch losses; training.py:444-452
-    averages).  The embedding/unembedding run replicated over 'pp' — the
-    wall-clock equivalent of the reference's first/last-stage placement, and
-    the tied-embedding all-reduce of module.py:52-121 becomes unnecessary.
-    """
-    model_cfg = cfg.model
-    parallel = cfg.parallel
-    pp = parallel.pipeline_parallel
-    vpp = parallel.virtual_pipeline_stages
-
-    if rope is None:
-        from ..models.transformer import rope_tables
-        rope = rope_tables(model_cfg)
-    cos, sin = rope
-
-    tokens = batch["tokens"]  # [M, mb, s]
-    M = tokens.shape[0]
-
-    embed_rng = stack_rng = None
-    if rng is not None:
-        embed_rng, stack_rng = jax.random.split(rng)
-
-    deterministic = rng is None
-
-    # Per-use-site cast to compute dtype: callers may hold fp32 params so
-    # that cross-microbatch cotangent accumulation (the scan transposes)
-    # runs in fp32, matching _accumulate_grads' per-microbatch fp32 sum.
-    def cast(tree):
-        return jax.tree.map(lambda x: x.astype(model_cfg.dtype), tree)
-
-    # Embedding, scanned per microbatch so embedding-weight cotangents
-    # accumulate across microbatches at the caller's (fp32) precision.
-    def embed_one(_, m):
-        tok = tokens[m]
-        pos = (None if batch.get("position_ids") is None
-               else batch["position_ids"][m])
-        er = (None if embed_rng is None
-              else jax.random.fold_in(embed_rng, m))
-        x = model_lib.embed(model_cfg,
-                            {"embedding": cast(params["embedding"])},
-                            tok, pos, None, er, deterministic)
-        return None, x
-
-    _, x_mb = jax.lax.scan(embed_one, None, jnp.arange(M))
-
-    position_ids = batch.get("position_ids")
-    if model_cfg.context_parallel_axis is not None and position_ids is None:
-        # Inside the manual-cp pipeline body each shard sees only its local
-        # sequence chunk, so RoPE needs explicit *global* positions.
-        s = tokens.shape[-1]
-        position_ids = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32),
-                                        tokens.shape)
-
-    side_mb = AttnSideInputs(
-        rope_cos=cos, rope_sin=sin,
-        position_ids=position_ids,
-        segment_ids=batch.get("segment_ids"),
-        deterministic=deterministic,
-    )
-
-    h_mb, moe_aux = pipeline_apply(
-        model_cfg, params["layers"], x_mb, side_mb,
-        mesh=mesh, pp=pp, vpp=vpp, rng=stack_rng,
-    )
-
-    # Head: scan microbatches so only one microbatch of logits is live.
-    head_params = {"final_norm": params["final_norm"]}
-    if "lm_head" in params:
-        head_params["lm_head"] = params["lm_head"]
-    else:
-        head_params["embedding"] = params["embedding"]
-
-    def head(carry, inp):
-        h, labels, mask = inp
-        hp = cast(head_params)
-        h = norm_apply(model_cfg.norm_type, h, hp["final_norm"],
-                       model_cfg.norm_eps, impl=model_cfg.norm_impl)
-        logits = model_lib.unembed(model_cfg, hp, h).astype(jnp.float32)
-        per_token = cross_entropy(logits, labels,
-                                  vocab_size=model_cfg.vocab_size)
-        loss = masked_mean_loss(per_token, mask)
-        return carry + loss, None
-
-    head = jax.checkpoint(head, prevent_cse=False)
-    total, _ = jax.lax.scan(
-        head, jnp.zeros((), jnp.float32),
-        (h_mb, batch["labels"], batch["loss_mask"]),
-    )
-    loss = total / M
+    loss = loss_total / M
     if model_cfg.num_experts > 0:
         # moe_aux sums over all layers and microbatches; per-microbatch mean
         # matches the non-pipelined compute_loss accounting.
         loss = loss + model_cfg.moe_aux_loss_coeff * moe_aux / M
+    if return_stats:
+        return loss, {"per_token_loss": stats[0], "correct": stats[1]}
     return loss

@@ -256,19 +256,41 @@ def make_eval_step(cfg: RuntimeConfig, metric_names=(), mesh=None,
     return jax.jit(eval_step, **kwargs)
 
 
-def make_pipeline_eval_step(cfg: RuntimeConfig, mesh):
-    """Forward-only loss via the pipelined schedule for pp > 1 (params are
-    in staged form and only the last stage sees logits, so the registry
-    metrics are unavailable — loss only, like the reference's pipelined
-    evaluate which reduces losses from the final stage)."""
+def make_pipeline_eval_step(cfg: RuntimeConfig, mesh, metric_names=()):
+    """Forward-only loss + registry metrics via the pipelined schedule for
+    pp > 1.  The streamed pipeline head (parallel/pipeline.py) emits
+    per-token fp32 loss and argmax-correctness stats from the last stage, so
+    every registry metric works at any parallelism — matching the reference
+    (megatron/metrics.py:62-110 computes metrics wherever logits land)."""
     from ..parallel import pipeline as pipe
 
+    metrics_lib.validate_metric_names(metric_names)
     rope = rope_tables(cfg.model)
 
     def eval_step(params, batch):
-        loss = pipe.pipeline_loss(cfg, params, batch, mesh=mesh, rng=None,
-                                  rope=rope)
-        return {"lm_loss": loss}
+        if not metric_names:
+            # no registry metrics requested: skip the per-tick argmax and
+            # the [M, mb, s] stat buffers entirely
+            loss = pipe.pipeline_loss(cfg, params, batch, mesh=mesh,
+                                      rng=None, rope=rope)
+            return {"lm_loss": loss}
+        loss, stats = pipe.pipeline_loss(
+            cfg, params, batch, mesh=mesh, rng=None, rope=rope,
+            return_stats=True)
+        out = {"lm_loss": loss}
+        if metric_names:
+            # flatten [M, mb, ...] → [M*mb, ...]: metrics are per-token
+            # reductions, invariant to the microbatch grouping
+            def flat(v):
+                return jnp.reshape(v, (-1,) + v.shape[2:])
+
+            flat_batch = {k: flat(v) for k, v in batch.items()
+                          if v is not None}
+            out.update(metrics_lib.compute_metrics(
+                metric_names, flat_batch, None,
+                flat(stats["per_token_loss"]),
+                correct=flat(stats["correct"])))
+        return out
 
     return jax.jit(eval_step)
 
@@ -490,9 +512,10 @@ def pretrain(
     eval_batch_sharding = None
     if valid_dataset is not None or test_dataset is not None:
         if cfg.parallel.pipeline_parallel > 1:
-            # pipelined eval: loss from the last stage only, no registry
-            # metrics; keeps the [accum, micro, ...] batch layout
-            eval_step = make_pipeline_eval_step(cfg, art.mesh)
+            # pipelined eval: streamed per-token stats from the last stage
+            # drive the full metric registry; keeps [accum, micro, ...]
+            eval_step = make_pipeline_eval_step(
+                cfg, art.mesh, tuple(cfg.train.metrics))
             eval_flatten = False
             eval_batch_sharding = art.batch_sharding
         else:
