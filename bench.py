@@ -9,6 +9,13 @@ counting 6·N·D + attention FLOPs with the reference's recompute settings.
 A single v5e chip cannot hold 7B training state, so the bench trains a
 Llama-architecture model sized to the chip and reports **MFU**, which is the
 hardware-normalized apples-to-apples number; vs_baseline = our MFU / 0.12.
+
+Besides the headline (seq 1024, the reference's finetune config), the JSON
+carries a seq-length MFU curve through 32k (BASELINE config 4's long-context
+regime, exercising the Pallas flash kernel fwd+bwd) and a KV-cache decode
+throughput row.  Sweep provenance (v5e, 2026-07): head_dim 128 beats 64 by
++24% MFU (MXU lane width); mb=12 beats 8/16 by ~1%; the fused LM head and
+block_q/k ∈ {512, 2048} variants measured slower — defaults kept.
 """
 
 from __future__ import annotations
@@ -36,7 +43,29 @@ def _model_flops_per_token(cfg, seq_len: int) -> float:
     return 3.0 * fwd  # fwd + bwd
 
 
-def main() -> None:
+def _bench_model(seq: int, recompute: str):
+    from megatron_llm_tpu.config import llama2_config
+
+    # Llama-architecture model sized to one chip.  8 heads × d=128 (not
+    # 16 × 64): the 128-wide head dim matches the MXU lane width and
+    # measures ~24% faster at identical params/FLOPs.
+    return llama2_config(
+        "7b",
+        hidden_size=1024,
+        num_layers=24,
+        num_attention_heads=8,
+        num_kv_heads=8,
+        ffn_hidden_size=2816,
+        seq_length=seq,
+        max_position_embeddings=seq,
+        params_dtype="bfloat16",
+        attention_impl="flash",
+        recompute=recompute,
+    )
+
+
+def _train_point(seq: int, mb: int, recompute: str, iters: int, peak: float):
+    """One training-throughput measurement → (tokens/sec, mfu, loss)."""
     import jax
     import jax.numpy as jnp
 
@@ -45,32 +74,12 @@ def main() -> None:
         ParallelConfig,
         RuntimeConfig,
         TrainConfig,
-        llama2_config,
     )
     from megatron_llm_tpu.models import model as model_lib
     from megatron_llm_tpu.training.step import init_train_state, make_train_step
 
-    # seq 1024 matches the reference's headline finetune config (BASELINE.md:
-    # Llama-2-7B at seq 1024); mb 8 is the measured single-chip sweet spot.
-    seq = 1024
-    mb = 8
-    model = llama2_config(
-        "7b",
-        hidden_size=1024,
-        num_layers=24,
-        num_attention_heads=16,
-        num_kv_heads=16,
-        ffn_hidden_size=2816,
-        seq_length=seq,
-        max_position_embeddings=seq,
-        params_dtype="bfloat16",
-        # "flash" falls back to the einsum path until the Pallas kernel
-        # lands; request it so the bench picks the kernel up automatically.
-        attention_impl="flash",
-        recompute="selective",
-    )
     cfg = RuntimeConfig(
-        model=model,
+        model=_bench_model(seq, recompute),
         parallel=ParallelConfig(),
         optimizer=OptimizerConfig(lr=1e-4, clip_grad=1.0),
         train=TrainConfig(train_iters=100, micro_batch_size=mb,
@@ -100,16 +109,54 @@ def main() -> None:
     # through the donated state, so the fetch transitively waits for all of
     # them.  (block_until_ready proved unreliable for independent outputs
     # over the axon-tunneled backend; a host read is unambiguous.)
-    iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, batch, key)
-    float(metrics["loss"])
+    loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     tokens_per_sec = iters * mb * seq / dt
-    flops_per_token = _model_flops_per_token(cfg.model, seq)
-    achieved = tokens_per_sec * flops_per_token
+    mfu = tokens_per_sec * _model_flops_per_token(cfg.model, seq) / peak
+    return tokens_per_sec, mfu, loss, n_params
+
+
+def _decode_point():
+    """KV-cache greedy decode throughput (tokens/sec) on the bench model."""
+    import jax
+    import jax.numpy as jnp
+
+    import dataclasses
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.generation.generation import generate_tokens
+
+    b, prompt_len, gen_len = 8, 128, 128
+    cfg = _bench_model(prompt_len + gen_len, "selective")
+    # decode runs the einsum attention over the cache (flash needs no bwd
+    # here and the cache path uses masked dot attention)
+    cfg = dataclasses.replace(cfg, attention_impl="dot")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+
+    rng = np.random.default_rng(1)
+    tokens = np.zeros((b, prompt_len + gen_len), np.int32)
+    tokens[:, :prompt_len] = rng.integers(1, cfg.vocab_size,
+                                          (b, prompt_len))
+    tokens = jnp.asarray(tokens)
+    lengths = jnp.full((b,), prompt_len, jnp.int32)
+
+    out = generate_tokens(cfg, params, tokens, lengths,
+                          use_eos_stop=False)  # warmup/compile
+    jax.device_get(out.tokens)
+    t0 = time.perf_counter()
+    out = generate_tokens(cfg, params, tokens, lengths, use_eos_stop=False)
+    jax.device_get(out.tokens)
+    dt = time.perf_counter() - t0
+    return b * gen_len / dt
+
+
+def main() -> None:
+    import jax
+
     platform = jax.devices()[0].device_kind
     peaks = {  # bf16 peak FLOP/s per chip
         "v5 lite": 197e12, "v5e": 197e12,
@@ -118,19 +165,38 @@ def main() -> None:
     }
     kind = platform.lower().replace("tpu ", "")
     peak = next((v for k, v in peaks.items() if k in kind), 197e12)
-    mfu = achieved / peak
-    baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
 
+    # Headline: seq 1024 (the reference's finetune config), measured
+    # single-chip sweet spot mb=12, selective recompute.
+    tps, mfu, loss, n_params = _train_point(1024, 12, "selective", 20, peak)
+
+    # MFU-vs-seq curve (BASELINE config 4 regime at 32k): selective remat
+    # while it fits, full remat beyond 8k.
+    curve = [{"seq_length": 1024, "mfu": round(mfu, 4),
+              "tokens_per_sec": round(tps, 1)}]
+    for seq, mb, rc, iters in ((4096, 3, "selective", 10),
+                               (8192, 1, "selective", 10),
+                               (16384, 1, "full", 5),
+                               (32768, 1, "full", 5)):
+        c_tps, c_mfu, _, _ = _train_point(seq, mb, rc, iters, peak)
+        curve.append({"seq_length": seq, "mfu": round(c_mfu, 4),
+                      "tokens_per_sec": round(c_tps, 1)})
+
+    decode_tps = _decode_point()
+
+    baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     print(json.dumps({
         "metric": "mfu",
         "value": round(mfu, 4),
         "unit": "fraction_of_peak",
         "vs_baseline": round(mfu / baseline_mfu, 3),
-        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "tokens_per_sec_per_chip": round(tps, 1),
         "model_params": n_params,
-        "seq_length": seq,
+        "seq_length": 1024,
         "device": platform,
-        "loss": float(metrics["loss"]),
+        "loss": loss,
+        "mfu_vs_seq": curve,
+        "decode_tokens_per_sec": round(decode_tps, 1),
     }))
 
 

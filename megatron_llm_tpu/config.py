@@ -106,6 +106,10 @@ class ModelConfig:
     # "dot" is the default until the Pallas kernel covers all shapes; "flash"
     # falls back to "dot" with a warning when the kernel is unavailable.
     attention_impl: str = "dot"
+    # Pallas flash-attention tile sizes (attention_impl="flash").  1024² is
+    # the validated default; the bench sweep (bench.py) tunes per shape.
+    flash_block_q: int = 1024
+    flash_block_k: int = 1024
     # norm impl: "pallas" (fused RMSNorm/LayerNorm kernel) | "xla" (jnp
     # math XLA fuses into neighbors; the default — XLA's fusion is already
     # near-bandwidth-bound for norms).
@@ -121,6 +125,15 @@ class ModelConfig:
     # zigzag_indices and causal ring work is ~halved.  Set by the runtime
     # from ParallelConfig.context_parallel_layout.
     context_parallel_zigzag: bool = False
+    # Megatron sequence parallelism (reference:
+    # core/tensor_parallel/layers.py:225-296): norm/dropout regions run with
+    # the sequence dim sharded 1/tp.  Expressed as sharding constraints on
+    # the residual stream at layer boundaries (models/transformer.py) from
+    # which GSPMD derives the all-gather-before-matmul /
+    # reduce-scatter-after-matmul pattern those reference layers hand-code.
+    # Set (to the tp mesh axis name) by the runtime when
+    # ParallelConfig.sequence_parallel and tensor_parallel > 1.
+    sequence_parallel_axis: Optional[str] = None
     # Mixture-of-experts (extension beyond the reference, which has no MoE —
     # SURVEY §2.1 checklist).  num_experts == 0 → dense MLP everywhere.
     num_experts: int = 0
@@ -370,6 +383,15 @@ class RuntimeConfig:
                 self, "model",
                 dataclasses.replace(self.model, context_parallel_axis=None,
                                     context_parallel_zigzag=False))
+        # Wire sequence parallelism into the model as a residual-stream
+        # constraint axis (set AND clear, same re-validation contract as cp).
+        sp_axis = ("tp" if (self.parallel.sequence_parallel
+                            and self.parallel.tensor_parallel > 1) else None)
+        if self.model.sequence_parallel_axis != sp_axis:
+            object.__setattr__(
+                self, "model",
+                dataclasses.replace(self.model,
+                                    sequence_parallel_axis=sp_axis))
         if self.model.fused_lm_head and (
                 self.parallel.tensor_parallel > 1
                 or self.parallel.context_parallel > 1

@@ -134,10 +134,15 @@ def forward_hidden(
 
     x = embed(cfg, params, tokens, position_ids, tokentype_ids,
               embed_rng, deterministic)
+    # cp is a GSPMD-auto axis on this (non-pipelined) path, so it joins the
+    # sequence-sharding constraint alongside the sequence-parallel tp axis.
+    seq_axes = tuple(a for a in (cfg.context_parallel_axis,
+                                 cfg.sequence_parallel_axis) if a)
     side = AttnSideInputs(
         rope_cos=cos, rope_sin=sin,
         position_ids=position_ids, segment_ids=segment_ids,
         deterministic=deterministic,
+        seq_shard_axes=seq_axes,
     )
     x, moe_aux = stack_forward(cfg, params["layers"], x, side, stack_rng)
     x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps,

@@ -27,7 +27,9 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import ModelConfig, ParallelConfig
-from .transformer import Params
+
+Params = dict  # same alias as models.transformer (kept import-free so the
+               # transformer can import this module's helpers)
 
 TP = "tp"
 PP = "pp"

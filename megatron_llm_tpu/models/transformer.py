@@ -136,6 +136,26 @@ class AttnSideInputs:
     # reference AttnMaskType.padding, megatron/model/enums.py).  Padding is
     # expressed through segment_ids (pad tokens get their own segment).
     causal: bool = True
+    # Mesh axes the sequence dim of the residual stream is constrained to at
+    # layer boundaries — Megatron sequence parallelism (reference:
+    # core/tensor_parallel/layers.py:225-296).  Callers set this from
+    # cfg.sequence_parallel_axis (+ the cp axis when cp is GSPMD-auto; the
+    # pipeline omits cp because cp is manual inside its shard_map).
+    seq_shard_axes: tuple = ()
+
+
+def seq_constrain(x: jax.Array, axes: tuple):
+    """Constrain [b, s, h] activations to seq-sharding over ``axes``.
+
+    Batch/hidden dims stay UNCONSTRAINED so GSPMD keeps whatever dp/ep
+    layout is already in flight.  No-op outside a mesh context (delegates
+    to models.sharding.constrain)."""
+    if not axes:
+        return x
+    from .sharding import constrain
+
+    U = jax.sharding.PartitionSpec.UNCONSTRAINED
+    return constrain(x, jax.sharding.PartitionSpec(U, tuple(axes), U))
 
 
 def _dropout(x, rate, rng, deterministic):
@@ -225,6 +245,8 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
             dropout_rng=drop_rng,
             cp_axis=cfg.context_parallel_axis,
             cp_zigzag=cfg.context_parallel_zigzag,
+            block_q=cfg.flash_block_q,
+            block_k=cfg.flash_block_k,
         )
     out = ctx.reshape(b, s, nq * d) @ p["wo"]
     if "bo" in p:
@@ -278,6 +300,12 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
     (ParallelTransformerLayer.forward).  Returns ``(out, moe_aux)``; with
     ``kv_cache`` returns ``(out, moe_aux, new_cache)``.
     """
+    # Sequence parallelism: the residual stream enters/leaves each layer
+    # seq-sharded; GSPMD turns this into the all-gather-before-qkv /
+    # reduce-scatter-after-wo/w_down pattern the reference's
+    # ColumnParallel(gather_output=False, sequence_parallel=True) layers
+    # hand-code (core/tensor_parallel/layers.py:225-296).
+    x = seq_constrain(x, side.seq_shard_axes)
     residual = x
     h1 = norm_apply(cfg.norm_type, x, p["input_norm"], cfg.norm_eps,
                     impl=cfg.norm_impl)
@@ -314,6 +342,7 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
             m = _dropout(m, cfg.hidden_dropout,
                          jax.random.fold_in(layer_rng, 3), det)
         result = x + m
+    result = seq_constrain(result, side.seq_shard_axes)
     if kv_cache is not None:
         return result, aux, new_cache
     return result, aux

@@ -117,6 +117,8 @@ def attention(
     cp_axis: str | None = None,
     cp_zigzag: bool = False,
     mesh=None,
+    block_q: int = 1024,
+    block_k: int = 1024,
 ) -> jax.Array:
     """Dispatcher: 'flash' → Pallas kernel (TPU), 'dot' → XLA einsum path.
 
@@ -161,6 +163,7 @@ def attention(
             return flash_attention(
                 q, k, v, causal=causal, segment_ids=segment_ids,
                 softmax_scale=softmax_scale,
+                block_q=block_q, block_k=block_k,
             )
     return dot_product_attention(
         q, k, v, causal=causal, segment_ids=segment_ids,

@@ -5,9 +5,9 @@ Reference mapping (megatron/schedules.py:18-722):
 - ``forward_backward_no_pipelining`` (schedules.py:213) → the plain
   microbatch ``lax.scan`` in ``training/step.py`` (pp = 1).
 - ``forward_backward_pipelining_without_interleaving`` — 1F1B
-  (schedules.py:606) → ``pipeline_apply`` with ``vpp = 1``.
+  (schedules.py:606) → ``pipeline_loss`` with ``vpp = 1``.
 - ``forward_backward_pipelining_with_interleaving`` — virtual stages
-  (schedules.py:253) → ``pipeline_apply`` with ``vpp > 1`` (the circular
+  (schedules.py:253) → ``pipeline_loss`` with ``vpp > 1`` (the circular
   schedule: each device holds ``vpp`` layer chunks and every microbatch
   passes around the ring ``vpp`` times).
 - ``p2p_communication.py``'s batched isend/irecv between stage neighbours →
@@ -317,6 +317,10 @@ def pipeline_loss(
     labels = batch["labels"]
     loss_mask = batch["loss_mask"]
     seg = batch.get("segment_ids")
+    # cp is *manual* inside this shard_map, so only the (auto) tp
+    # sequence-parallel axis may appear in residual-stream constraints.
+    sp_axes = ((model_cfg.sequence_parallel_axis,)
+               if model_cfg.sequence_parallel_axis else ())
 
     def pipelined(chunks, io_p, tokens, labels, loss_mask, pos_mb, seg_mb):
         # chunks: [vpp, 1, lpc, ...] (pp axis manual) → squeeze stage dim
@@ -408,6 +412,7 @@ def pipeline_loss(
                              jax.lax.dynamic_index_in_dim(
                                  seg_mb, m_idx, 0, keepdims=False)),
                 deterministic=deterministic,
+                seq_shard_axes=sp_axes,
             )
 
             out, tick_aux = _stage_tick(model_cfg, chunks_local, chunk_idx,

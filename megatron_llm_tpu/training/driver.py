@@ -278,18 +278,18 @@ def make_pipeline_eval_step(cfg: RuntimeConfig, mesh, metric_names=()):
             cfg, params, batch, mesh=mesh, rng=None, rope=rope,
             return_stats=True)
         out = {"lm_loss": loss}
-        if metric_names:
-            # flatten [M, mb, ...] → [M*mb, ...]: metrics are per-token
-            # reductions, invariant to the microbatch grouping
-            def flat(v):
-                return jnp.reshape(v, (-1,) + v.shape[2:])
 
-            flat_batch = {k: flat(v) for k, v in batch.items()
-                          if v is not None}
-            out.update(metrics_lib.compute_metrics(
-                metric_names, flat_batch, None,
-                flat(stats["per_token_loss"]),
-                correct=flat(stats["correct"])))
+        # flatten [M, mb, ...] → [M*mb, ...]: metrics are per-token
+        # reductions, invariant to the microbatch grouping
+        def flat(v):
+            return jnp.reshape(v, (-1,) + v.shape[2:])
+
+        flat_batch = {k: flat(v) for k, v in batch.items()
+                      if v is not None}
+        out.update(metrics_lib.compute_metrics(
+            metric_names, flat_batch, None,
+            flat(stats["per_token_loss"]),
+            correct=flat(stats["correct"])))
         return out
 
     return jax.jit(eval_step)
