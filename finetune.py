@@ -62,6 +62,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                    choices=["flash", "dot"])
     g.add_argument("--recompute", default="selective",
                    choices=["none", "selective", "full"])
+    g.add_argument("--hidden_dropout", type=float, default=None,
+                   help="residual dropout rate (default: model preset)")
+    g.add_argument("--lima_dropout", action="store_true",
+                   help="layer-dependent dropout ramp 0->hidden_dropout "
+                        "(LIMA, reference transformer.py:964-971)")
+    g.add_argument("--drop_path_rate", type=float, default=0.0,
+                   help="stochastic-depth rate at the last layer "
+                        "(reference DropPath, transformer.py:43-64)")
 
     g = p.add_argument_group("parallelism")
     g.add_argument("--tp", "--tensor_parallel", type=int, default=1,
@@ -164,6 +172,17 @@ def build_config(args):
         overrides["seq_length"] = args.seq_length
     if args.rope_scaling_factor != 1.0:
         overrides["rope_scaling_factor"] = args.rope_scaling_factor
+    if args.hidden_dropout is not None:
+        overrides["hidden_dropout"] = args.hidden_dropout
+    if args.lima_dropout:
+        if not args.hidden_dropout:
+            raise SystemExit(
+                "--lima_dropout ramps 0 -> hidden_dropout across layers, "
+                "but hidden_dropout is 0 (the preset default) - pass a "
+                "nonzero --hidden_dropout for it to have any effect")
+        overrides["lima_dropout"] = True
+    if args.drop_path_rate:
+        overrides["drop_path_rate"] = args.drop_path_rate
     if args.num_experts:
         overrides.update(
             num_experts=args.num_experts, moe_top_k=args.moe_top_k,

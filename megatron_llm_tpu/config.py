@@ -110,6 +110,14 @@ class ModelConfig:
     # the validated default; the bench sweep (bench.py) tunes per shape.
     flash_block_q: int = 1024
     flash_block_k: int = 1024
+    # LIMA layer-dependent dropout (Zhou et al 2023; reference
+    # transformer.py:964-971): hidden dropout ramps linearly from 0 at the
+    # first layer to hidden_dropout at the last.
+    lima_dropout: bool = False
+    # Stochastic depth (reference DropPath, transformer.py:43-64): the
+    # residual branch of layer i is dropped per *sample* with probability
+    # linspace(0, drop_path_rate, L)[i].
+    drop_path_rate: float = 0.0
     # norm impl: "pallas" (fused RMSNorm/LayerNorm kernel) | "xla" (jnp
     # math XLA fuses into neighbors; the default — XLA's fusion is already
     # near-bandwidth-bound for norms).

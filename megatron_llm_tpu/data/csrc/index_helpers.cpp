@@ -161,4 +161,86 @@ int64_t build_bert_mapping(const int32_t* sent_sizes,
   return rows;
 }
 
+/* ICT/REALM block mapping (behavioral spec: megatron/data/helpers.cpp
+ * build_blocks_mapping_impl, :454-694): greedily pack each document's
+ * sentences into blocks of target length (max_seq_length - title_size),
+ * emitting rows of (first_sentence, one_past_last, doc, block_id).
+ * Documents containing any sentence longer than long_sentence_len are
+ * skipped entirely; blocks need >= min_num_sent sentences (2, or 1 with
+ * use_one_sent_blocks).  Rows are Fisher-Yates-shuffled with
+ * mt19937_64(seed+1), matching the reference stream.
+ *
+ * Two-pass C ABI: pass out == NULL to count rows, then call again with the
+ * allocated buffer (rows*4 int32).  Returns the row count. */
+int64_t build_blocks_mapping(const int64_t* doc_sent_idx, int64_t num_docs,
+                             const int32_t* sent_sizes,
+                             const int32_t* title_sizes, int32_t num_epochs,
+                             int64_t max_num_samples,
+                             int32_t max_seq_length,
+                             int32_t long_sentence_len,
+                             int32_t use_one_sent_blocks, uint32_t seed,
+                             int32_t* out) {
+  const int32_t min_num_sent = use_one_sent_blocks ? 1 : 2;
+  const bool second = (out != NULL);
+  int64_t map_index = 0;
+
+  for (int32_t epoch = 0; epoch < num_epochs; ++epoch) {
+    int32_t block_id = 0;
+    if (map_index >= max_num_samples) break;
+    for (int64_t doc = 0; doc < num_docs; ++doc) {
+      const int64_t sent_first = doc_sent_idx[doc];
+      const int64_t sent_last = doc_sent_idx[doc + 1];
+      const int32_t target_seq_len =
+          max_seq_length - title_sizes[doc];
+      int64_t prev_start_index = sent_first;
+      int64_t num_remain_sent = sent_last - sent_first;
+
+      bool contains_long_sentence = false;
+      if (num_remain_sent >= min_num_sent) {
+        for (int64_t s = sent_first; s < sent_last; ++s) {
+          if (sent_sizes[s] > long_sentence_len) {
+            contains_long_sentence = true;
+            break;
+          }
+        }
+      }
+      if (num_remain_sent < min_num_sent || contains_long_sentence) continue;
+
+      int32_t seq_len = 0;
+      int32_t num_sent = 0;
+      for (int64_t s = sent_first; s < sent_last; ++s) {
+        seq_len += sent_sizes[s];
+        ++num_sent;
+        --num_remain_sent;
+        if (((seq_len >= target_seq_len) &&
+             (num_remain_sent >= min_num_sent) &&
+             (num_sent >= min_num_sent)) ||
+            (num_remain_sent == 0)) {
+          if (second) {
+            const int64_t o = 4 * map_index;
+            out[o] = static_cast<int32_t>(prev_start_index);
+            out[o + 1] = static_cast<int32_t>(s + 1);
+            out[o + 2] = static_cast<int32_t>(doc);
+            out[o + 3] = block_id;
+          }
+          ++map_index;
+          ++block_id;
+          prev_start_index = s + 1;
+          seq_len = 0;
+          num_sent = 0;
+        }
+      }
+    }
+  }
+
+  if (second) {
+    std::mt19937_64 gen64(seed + 1);
+    for (int64_t i = map_index - 1; i > 0; --i) {
+      const int64_t j = static_cast<int64_t>(gen64() % (i + 1));
+      for (int k = 0; k < 4; ++k) std::swap(out[4 * i + k], out[4 * j + k]);
+    }
+  }
+  return map_index;
+}
+
 }  /* extern "C" */

@@ -10,12 +10,15 @@ a toolchain; tests assert native == fallback.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 from pathlib import Path
 from typing import Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 _SRC = Path(__file__).parent / "csrc" / "index_helpers.cpp"
 _LIB_DIR = Path(__file__).parent / "csrc"
@@ -45,6 +48,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
     _lib_tried = True
     if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
         if not _compile_library():
+            # MUST log on this path too: it is the common fallback trigger
+            # (no toolchain), and the numpy path draws different RNG
+            # streams → different sample composition (advisor finding).
+            logger.info("index_helpers: using numpy fallback "
+                        "implementation (native compile unavailable)")
             return None
     try:
         lib = ctypes.CDLL(str(_LIB))
@@ -65,12 +73,29 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
             ctypes.c_uint32, ctypes.POINTER(ctypes.c_int32)]
+        lib.build_blocks_mapping.restype = ctypes.c_int64
+        lib.build_blocks_mapping.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_uint32, ctypes.POINTER(ctypes.c_int32)]
         _lib = lib
     except (OSError, AttributeError):
         # AttributeError: a stale .so predating a newly added symbol —
         # degrade to the numpy fallbacks rather than crashing callers.
         _lib = None
+    # The native and numpy paths draw DIFFERENT RNG streams (std::mt19937
+    # vs numpy Generator), so sample composition depends on which is
+    # active — say so once, loudly enough for run logs (advisor finding,
+    # round 1).
+    logger.info("index_helpers: using %s implementation",
+                "native C++" if _lib is not None else "numpy fallback")
     return _lib
+
+
+def native_available() -> bool:
+    """True iff the native library is in use (affects mapping RNG streams)."""
+    return get_lib() is not None
 
 
 def _as_ptr(a: np.ndarray, ctype):
@@ -215,3 +240,83 @@ def build_bert_mapping(sent_sizes: np.ndarray, doc_sent_idx: np.ndarray,
         ctypes.c_double(short_seq_prob), num_epochs, seed,
         _as_ptr(out, ctypes.c_int32))
     return out[:rows].copy()
+
+
+# ---------------------------------------------------------------------------
+# build_blocks_mapping (ICT/REALM blocks; reference helpers.cpp:454-694)
+# ---------------------------------------------------------------------------
+
+
+def build_blocks_mapping_py(doc_sent_idx: np.ndarray,
+                            sent_sizes: np.ndarray,
+                            title_sizes: np.ndarray,
+                            num_epochs: int, max_num_samples: int,
+                            max_seq_length: int,
+                            long_sentence_len: int = 512,
+                            use_one_sent_blocks: bool = False,
+                            seed: int = 0) -> np.ndarray:
+    """Pure-numpy fallback; same packing semantics as the native version
+    (different shuffle RNG stream — numpy Generator vs mt19937_64)."""
+    min_num_sent = 1 if use_one_sent_blocks else 2
+    rows = []
+    for epoch in range(num_epochs):
+        block_id = 0
+        if len(rows) >= max_num_samples:
+            break
+        for doc in range(len(doc_sent_idx) - 1):
+            first = int(doc_sent_idx[doc])
+            last = int(doc_sent_idx[doc + 1])
+            target = max_seq_length - int(title_sizes[doc])
+            n_remain = last - first
+            if n_remain < min_num_sent:
+                continue
+            if np.any(sent_sizes[first:last] > long_sentence_len):
+                continue
+            start, seq_len, num_sent = first, 0, 0
+            for s in range(first, last):
+                seq_len += int(sent_sizes[s])
+                num_sent += 1
+                n_remain -= 1
+                if ((seq_len >= target and n_remain >= min_num_sent
+                     and num_sent >= min_num_sent) or n_remain == 0):
+                    rows.append((start, s + 1, doc, block_id))
+                    block_id += 1
+                    start, seq_len, num_sent = s + 1, 0, 0
+    out = np.asarray(rows, dtype=np.int32).reshape(-1, 4)
+    np.random.default_rng(seed + 1).shuffle(out, axis=0)
+    return out
+
+
+def build_blocks_mapping(doc_sent_idx: np.ndarray, sent_sizes: np.ndarray,
+                         title_sizes: np.ndarray, num_epochs: int = 1,
+                         max_num_samples: int = 2**62,
+                         max_seq_length: int = 512,
+                         long_sentence_len: int = 512,
+                         use_one_sent_blocks: bool = False,
+                         seed: int = 0) -> np.ndarray:
+    """[rows, 4] of (first_sentence, one_past_last, doc, block_id),
+    shuffled — the reference's exact ICT/REALM block packing including
+    per-document title-length targets and long-sentence document rejection
+    (helpers.cpp:454-694)."""
+    doc_sent_idx = np.ascontiguousarray(doc_sent_idx, dtype=np.int64)
+    sent_sizes = np.ascontiguousarray(sent_sizes, dtype=np.int32)
+    title_sizes = np.ascontiguousarray(title_sizes, dtype=np.int32)
+    num_docs = len(doc_sent_idx) - 1
+    assert len(title_sizes) == num_docs, (len(title_sizes), num_docs)
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "build_blocks_mapping"):
+        return build_blocks_mapping_py(
+            doc_sent_idx, sent_sizes, title_sizes, num_epochs,
+            max_num_samples, max_seq_length, long_sentence_len,
+            use_one_sent_blocks, seed)
+    args = [
+        _as_ptr(doc_sent_idx, ctypes.c_int64), num_docs,
+        _as_ptr(sent_sizes, ctypes.c_int32),
+        _as_ptr(title_sizes, ctypes.c_int32),
+        num_epochs, ctypes.c_int64(max_num_samples), max_seq_length,
+        long_sentence_len, int(use_one_sent_blocks), seed,
+    ]
+    n = lib.build_blocks_mapping(*args, None)
+    out = np.empty((n, 4), dtype=np.int32)
+    lib.build_blocks_mapping(*args, _as_ptr(out, ctypes.c_int32))
+    return out

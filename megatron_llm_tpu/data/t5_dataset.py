@@ -36,7 +36,7 @@ class T5Dataset:
                  special: T5SpecialTokens,
                  masked_lm_prob: float = 0.15, mean_span_length: int = 3,
                  max_sentinels: int = 100, num_epochs: int = 1,
-                 seed: int = 0):
+                 seed: int = 0, sentinel_ids=None):
         self.ds = indexed
         self.enc_len = enc_seq_length
         self.dec_len = dec_seq_length
@@ -46,6 +46,15 @@ class T5Dataset:
         self.mean_span = mean_span_length
         self.max_sentinels = max_sentinels
         self.seed = seed
+        # Explicit sentinel ids (e.g. a real tokenizer's <extra_id_i>
+        # additional_special_tokens) — without them the *last* vocab ids
+        # are assumed, which can collide with live vocab on real
+        # tokenizers (advisor finding, round 1).
+        self.sentinel_ids = (None if sentinel_ids is None
+                             else [int(s) for s in sentinel_ids])
+        if self.sentinel_ids is not None:
+            self.max_sentinels = min(self.max_sentinels,
+                                     len(self.sentinel_ids))
         self.mapping = build_bert_mapping(
             np.asarray(indexed.sizes), np.asarray(indexed.doc_idx),
             max_num_tokens=enc_seq_length, short_seq_prob=0.0,
@@ -55,6 +64,8 @@ class T5Dataset:
         return len(self.mapping)
 
     def sentinel(self, i: int) -> int:
+        if self.sentinel_ids is not None:
+            return self.sentinel_ids[i]
         return self.vocab_size - 1 - i
 
     def __getitem__(self, idx: int) -> dict:

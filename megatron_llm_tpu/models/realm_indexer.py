@@ -1,0 +1,198 @@
+"""REALM evidence-index builder: one pass over an evidence corpus, batched
+context-tower embedding, sharded save + merge.
+
+Reference parity: megatron/indexer.py:1-123 (IndexBuilder) +
+megatron/data/realm_index.py (OpenRetreivalDataStore; the FaissMIPSIndex is
+replaced by exact MIPS — on TPU a [queries, dim]·[dim, blocks] matmul *is*
+the index, and exact search is both faster and simpler than an ANN
+structure at the corpus sizes a single slice holds; descope of the FAISS
+dependency is deliberate).
+
+The store keys embeddings by ``block_id`` — the unique id emitted by
+``build_blocks_mapping`` (data/index_helpers.py) and carried in every
+ICTDataset sample's ``block_data`` row.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from . import biencoder
+
+logger = logging.getLogger(__name__)
+
+
+class BlockDataStore:
+    """block_id → embedding store with shard/merge semantics
+    (reference OpenRetreivalDataStore, realm_index.py:17-116)."""
+
+    def __init__(self, embedding_path: Optional[str] = None):
+        self.embed_data: dict[int, np.ndarray] = {}
+        self.path = Path(embedding_path) if embedding_path else None
+
+    def add_block_data(self, block_ids, embeds,
+                       allow_overwrite: bool = False) -> None:
+        for bid, vec in zip(np.asarray(block_ids).tolist(),
+                            np.asarray(embeds)):
+            if not allow_overwrite and int(bid) in self.embed_data:
+                raise ValueError(f"duplicate block id {bid}")
+            self.embed_data[int(bid)] = np.asarray(vec)
+
+    def clear(self) -> None:
+        self.embed_data = {}
+
+    # -- persistence (npz instead of the reference's pickle) ---------------
+
+    def _shard_file(self, rank: int) -> Path:
+        assert self.path is not None, "embedding_path not set"
+        return self.path.with_suffix(f".shard{rank}.npz")
+
+    def save_shard(self, rank: int = 0) -> Path:
+        f = self._shard_file(rank)
+        f.parent.mkdir(parents=True, exist_ok=True)
+        ids = np.asarray(sorted(self.embed_data), np.int64)
+        vecs = np.stack([self.embed_data[int(i)] for i in ids]) if len(ids) \
+            else np.zeros((0, 0), np.float32)
+        np.savez(f, ids=ids, vecs=vecs)
+        return f
+
+    def merge_shards_and_save(self) -> Path:
+        """Rank-0 merge of every shard file into the final store
+        (reference realm_index.py:86-116)."""
+        assert self.path is not None
+        merged: dict[int, np.ndarray] = {}
+        shards = sorted(self.path.parent.glob(
+            self.path.name + ".shard*.npz"))
+        # path.with_suffix drops the extension; match both spellings
+        shards += sorted(self.path.parent.glob(
+            self.path.stem + ".shard*.npz"))
+        for f in dict.fromkeys(shards):
+            data = np.load(f)
+            for bid, vec in zip(data["ids"], data["vecs"]):
+                merged[int(bid)] = vec
+        ids = np.asarray(sorted(merged), np.int64)
+        vecs = np.stack([merged[int(i)] for i in ids])
+        np.savez(self.path, ids=ids, vecs=vecs)
+        self.embed_data = dict(zip(ids.tolist(), vecs))
+        return self.path
+
+    @classmethod
+    def load(cls, embedding_path: str) -> "BlockDataStore":
+        store = cls(embedding_path)
+        data = np.load(store.path)
+        store.embed_data = dict(zip(data["ids"].tolist(), data["vecs"]))
+        return store
+
+    def as_arrays(self):
+        ids = np.asarray(sorted(self.embed_data), np.int64)
+        vecs = np.stack([self.embed_data[int(i)] for i in ids])
+        return ids, vecs
+
+
+class IndexBuilder:
+    """One epoch over the evidence dataset → BlockDataStore
+    (reference IndexBuilder.build_and_save_index, indexer.py:72-123).
+
+    ``dataset``: ICTDataset-like — ``mapping`` rows (start, end, doc,
+    block_id) + ``get_block(start, end, doc)`` → (tokens, pad_mask).
+    Multi-process builds give each process a ``rank``/``world`` slice of
+    the rows; shards merge on rank 0.
+    """
+
+    def __init__(self, cfg: ModelConfig, params, dataset,
+                 embedding_path: Optional[str] = None,
+                 batch_size: int = 32, log_interval: int = 100,
+                 rank: int = 0, world: int = 1, pooling: str = "cls"):
+        self.cfg = cfg
+        self.params = params
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.log_interval = log_interval
+        self.rank, self.world = rank, world
+        self.store = BlockDataStore(embedding_path)
+        self._proj_c = biencoder._context_proj(params)
+        self._tower = biencoder.context_tower(params)
+        self._embed = jax.jit(
+            lambda t, m, p: biencoder.embed_text(
+                cfg, self._tower, t, m, p, pooling=pooling))
+
+    def build(self) -> BlockDataStore:
+        rows = np.asarray(self.dataset.mapping)[self.rank::self.world]
+        # multi-epoch mappings repeat every block with the same block_id
+        # (ids reset per epoch, matching the reference helpers.cpp:527);
+        # the index needs each block once
+        seen: set[int] = set()
+        bs = self.batch_size
+        iteration = 0
+        total = 0
+        for i in range(0, len(rows), bs):
+            chunk = rows[i:i + bs]
+            toks, masks, ids = [], [], []
+            for start, end, doc, block_id in chunk:
+                if int(block_id) in seen:
+                    continue
+                seen.add(int(block_id))
+                t, m = self.dataset.get_block(int(start), int(end), int(doc))
+                toks.append(t)
+                masks.append(m)
+                ids.append(int(block_id))
+            if not toks:
+                continue
+            got = len(toks)
+            if got < bs:  # pad the ragged tail so the jit compiles once
+                toks += [np.zeros_like(toks[0])] * (bs - got)
+                masks += [np.zeros_like(masks[0])] * (bs - got)
+            embeds = np.asarray(self._embed(
+                jnp.asarray(np.stack(toks)), jnp.asarray(np.stack(masks)),
+                self._proj_c))[:got]
+            self.store.add_block_data(ids, embeds)
+            iteration += 1
+            total += got * self.world
+            if iteration % self.log_interval == 0:
+                logger.info("indexer batch %d | ~total %d", iteration, total)
+        return self.store
+
+    def build_and_save_index(self) -> BlockDataStore:
+        """build → save shard → (rank 0) merge, mirroring the reference's
+        save_shard / barrier / merge_shards_and_save sequence."""
+        self.build()
+        if self.store.path is None:
+            return self.store
+        self.store.save_shard(self.rank)
+        if self.world > 1:
+            try:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("realm_index_shards")
+            except Exception:
+                pass
+        if self.rank == 0:
+            self.store.merge_shards_and_save()
+        return self.store
+
+
+def mips_search(block_vecs: np.ndarray, query_vecs: np.ndarray,
+                top_k: int):
+    """Exact maximum-inner-product search → (ids_idx [q, k], scores).
+
+    The reference wraps FAISS (realm_index.py:118-226); exact MIPS by
+    matmul covers the same contract on TPU/CPU."""
+    scores = np.asarray(jnp.asarray(query_vecs, jnp.float32)
+                        @ jnp.asarray(block_vecs, jnp.float32).T)
+    top_k = min(top_k, scores.shape[-1])
+    if top_k < scores.shape[-1]:
+        # O(N) partition then sort only the k winners (N can be millions)
+        part = np.argpartition(-scores, top_k - 1, axis=-1)[:, :top_k]
+    else:
+        part = np.broadcast_to(np.arange(top_k), scores.shape).copy()
+    part_scores = np.take_along_axis(scores, part, axis=-1)
+    order = np.argsort(-part_scores, axis=-1)
+    idx = np.take_along_axis(part, order, axis=-1)
+    return idx, np.take_along_axis(part_scores, order, axis=-1)

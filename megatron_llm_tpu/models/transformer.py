@@ -159,10 +159,38 @@ def seq_constrain(x: jax.Array, axes: tuple):
 
 
 def _dropout(x, rate, rng, deterministic):
-    if deterministic or rate == 0.0 or rng is None:
+    """Inverted dropout; ``rate`` may be a traced scalar (LIMA per-layer
+    ramp) — the zero-rate short-circuit only applies to static rates."""
+    if deterministic or rng is None:
         return x
-    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
-    return jnp.where(keep, x / (1.0 - rate), 0.0)
+    if isinstance(rate, (int, float)) and rate == 0.0:
+        return x
+    keep_p = 1.0 - rate
+    keep = jax.random.bernoulli(rng, keep_p, x.shape)
+    return jnp.where(keep, x / keep_p, 0.0)
+
+
+def _drop_path(x, rate, rng, deterministic):
+    """Stochastic depth: zero the whole residual branch per *sample*
+    (reference DropPath, megatron/model/transformer.py:43-64)."""
+    if deterministic or rng is None:
+        return x
+    keep_p = 1.0 - rate
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    keep = jax.random.bernoulli(rng, keep_p, shape)
+    return jnp.where(keep, x / keep_p, 0.0)
+
+
+def _layer_rates(cfg: ModelConfig, layer_idx):
+    """Per-layer (hidden_dropout, drop_path) rates for global layer
+    ``layer_idx`` (may be traced — the scanned stack and the pipeline pass
+    the running index).  linspace(0, rate, L) semantics as the reference
+    (transformer.py:962-971)."""
+    denom = max(cfg.num_layers - 1, 1)
+    frac = layer_idx / denom
+    hidden = (cfg.hidden_dropout * frac if cfg.lima_dropout
+              else cfg.hidden_dropout)
+    return hidden, cfg.drop_path_rate * frac
 
 
 def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
@@ -283,7 +311,11 @@ def mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
 
 
 def _mlp_dispatch(cfg: ModelConfig, p: Params, x: jax.Array):
-    """Dense or routed MLP → ``(out, aux_loss)`` (aux is 0 for dense)."""
+    """Dense or routed MLP → ``(out, aux)``.
+
+    ``aux`` is a scalar 0 for dense models and the MoE stats dict
+    {aux, dropped, load} for routed ones (models/moe.py); accumulate with
+    ``jax.tree.map`` and read the loss term via ``moe.aux_loss_of``."""
     if cfg.num_experts > 0:
         from .moe import moe_block
 
@@ -293,13 +325,37 @@ def _mlp_dispatch(cfg: ModelConfig, p: Params, x: jax.Array):
 
 def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
                   side: AttnSideInputs, layer_rng=None,
-                  kv_cache: Optional[tuple] = None):
+                  kv_cache: Optional[tuple] = None,
+                  layer_idx=None):
     """One pre-LN residual block, sequential or Falcon-parallel.
 
     Parity: megatron/model/transformer.py:695-817
     (ParallelTransformerLayer.forward).  Returns ``(out, moe_aux)``; with
     ``kv_cache`` returns ``(out, moe_aux, new_cache)``.
+
+    ``layer_idx`` (global layer number, may be traced) drives the LIMA
+    dropout ramp and per-layer drop-path rate; None → flat rates.
     """
+    if layer_idx is not None and (cfg.lima_dropout
+                                  or cfg.drop_path_rate > 0.0):
+        hidden_dropout, dp_rate = _layer_rates(cfg, layer_idx)
+    else:
+        hidden_dropout, dp_rate = cfg.hidden_dropout, 0.0
+
+    def branch_drop(out, salt):
+        """dropout then stochastic-depth on a residual branch (reference
+        order: residual + drop_path(dropout(out)), transformer.py:717-734).
+        """
+        if layer_rng is None:
+            return out
+        out = _dropout(out, hidden_dropout,
+                       jax.random.fold_in(layer_rng, salt),
+                       side.deterministic)
+        if isinstance(dp_rate, (int, float)) and dp_rate == 0.0:
+            return out
+        return _drop_path(out, dp_rate,
+                          jax.random.fold_in(layer_rng, salt + 2),
+                          side.deterministic)
     # Sequence parallelism: the residual stream enters/leaves each layer
     # seq-sharded; GSPMD turns this into the all-gather-before-qkv /
     # reduce-scatter-after-wo/w_down pattern the reference's
@@ -316,7 +372,6 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
     else:
         attn_out = attention_block(cfg, p["attn"], h1, side, layer_rng)
 
-    det = side.deterministic
     if cfg.parallel_attn:
         if cfg.parallel_layernorm:
             mlp_in = norm_apply(cfg.norm_type, x, p["mlp_norm"],
@@ -324,24 +379,13 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
         else:
             mlp_in = h1
         mlp_out, aux = _mlp_dispatch(cfg, p["mlp"], mlp_in)
-        out = attn_out + mlp_out
-        if layer_rng is not None:
-            out = _dropout(out, cfg.hidden_dropout,
-                           jax.random.fold_in(layer_rng, 2), det)
-        result = residual + out
+        result = residual + branch_drop(attn_out + mlp_out, 2)
     else:
-        a = attn_out
-        if layer_rng is not None:
-            a = _dropout(a, cfg.hidden_dropout,
-                         jax.random.fold_in(layer_rng, 2), det)
-        x = residual + a
+        x = residual + branch_drop(attn_out, 2)
         h2 = norm_apply(cfg.norm_type, x, p["post_attn_norm"],
                         cfg.norm_eps, impl=cfg.norm_impl)
         m, aux = _mlp_dispatch(cfg, p["mlp"], h2)
-        if layer_rng is not None:
-            m = _dropout(m, cfg.hidden_dropout,
-                         jax.random.fold_in(layer_rng, 3), det)
-        result = x + m
+        result = x + branch_drop(m, 3)
     result = seq_constrain(result, side.seq_shard_axes)
     if kv_cache is not None:
         return result, aux, new_cache
@@ -360,11 +404,13 @@ def _remat_policy(cfg: ModelConfig):
 
 
 def stack_forward(cfg: ModelConfig, stacked: Params, x: jax.Array,
-                  side: AttnSideInputs, base_rng=None):
+                  side: AttnSideInputs, base_rng=None, layer_offset=0):
     """Run all layers with lax.scan over the stacked parameter pytree.
 
     Returns ``(hidden, moe_aux)`` — the aux load-balance loss summed over
-    layers (0 for dense models).
+    layers (0 for dense models).  ``layer_offset`` is the global index of
+    the first layer in ``stacked`` (nonzero for pipeline chunks) so the
+    LIMA/drop-path per-layer rate ramps stay global.
     """
 
     def body(carry, inp):
@@ -373,8 +419,9 @@ def stack_forward(cfg: ModelConfig, stacked: Params, x: jax.Array,
         rng = None
         if base_rng is not None:
             rng = jax.random.fold_in(base_rng, idx)
-        h, aux = layer_forward(cfg, layer_params, h, side, rng)
-        return (h, idx + 1, aux_sum + aux), None
+        h, aux = layer_forward(cfg, layer_params, h, side, rng,
+                               layer_idx=layer_offset + idx)
+        return (h, idx + 1, jax.tree.map(jnp.add, aux_sum, aux)), None
 
     policy = _remat_policy(cfg)
     if policy is not None:
@@ -382,8 +429,13 @@ def stack_forward(cfg: ModelConfig, stacked: Params, x: jax.Array,
     elif cfg.recompute != "none":
         body = jax.checkpoint(body, prevent_cse=False)
 
-    (x, _, aux), _ = jax.lax.scan(
-        body, (x, 0, jnp.zeros((), jnp.float32)), (stacked,))
+    if cfg.num_experts > 0:
+        from .moe import stats_zero
+
+        aux0 = stats_zero(cfg)
+    else:
+        aux0 = jnp.zeros((), jnp.float32)
+    (x, _, aux), _ = jax.lax.scan(body, (x, 0, aux0), (stacked,))
     return x, aux
 
 

@@ -144,11 +144,13 @@ def pipeline_param_specs(specs: PyTree, parallel: ParallelConfig) -> PyTree:
 
 
 def _stage_tick(cfg: ModelConfig, chunks: PyTree, chunk_idx, x, side,
-                rng):
+                rng, layer_offset=0):
     """Apply this device's current layer chunk to one microbatch.
 
     ``chunks``: [vpp, lpc, ...] local layer params; ``chunk_idx`` selects
     which virtual chunk this tick runs (traced, device-varying).
+    ``layer_offset`` is the chunk's first *global* layer index (keeps the
+    LIMA/drop-path per-layer ramps global across stages).
 
     The cast to compute dtype happens *here*, per tick: when the caller holds
     fp32 params, the scan transpose then accumulates each tick's (bf16)
@@ -166,7 +168,8 @@ def _stage_tick(cfg: ModelConfig, chunks: PyTree, chunk_idx, x, side,
         return c.astype(cfg.dtype)
 
     chunk = jax.tree_util.tree_map_with_path(index_and_cast, chunks)
-    return stack_forward(cfg, chunk, x, side, rng)
+    return stack_forward(cfg, chunk, x, side, rng,
+                         layer_offset=layer_offset)
 
 
 # ---------------------------------------------------------------------------
@@ -415,12 +418,16 @@ def pipeline_loss(
                 seq_shard_axes=sp_axes,
             )
 
-            out, tick_aux = _stage_tick(model_cfg, chunks_local, chunk_idx,
-                                        current, sel_side, tick_rng)
+            lpc = model_cfg.num_layers // (pp * vpp)
+            out, tick_aux = _stage_tick(
+                model_cfg, chunks_local, chunk_idx, current, sel_side,
+                tick_rng, layer_offset=(chunk_idx * pp + stage) * lpc)
             # Bubble ticks (warmup garbage / cooldown re-runs) must not
-            # contribute MoE aux loss.
+            # contribute MoE aux loss/stats.
             tick_valid = (rel >= 0) & (rel < M * vpp)
-            aux_sum = aux_sum + jnp.where(tick_valid, tick_aux, 0.0)
+            aux_sum = jax.tree.map(
+                lambda a, t: a + jnp.where(tick_valid, t, 0.0),
+                aux_sum, tick_aux)
 
             # Streamed head: the microbatch finishing at tick t (last
             # chunk, last stage) goes through norm→unembed→CE right here.
@@ -463,8 +470,14 @@ def pipeline_loss(
 
             return (shifted, circ, aux_sum, loss_sum, stats), None
 
+        if model_cfg.num_experts > 0:
+            from ..models.moe import stats_zero
+
+            aux0 = stats_zero(model_cfg)
+        else:
+            aux0 = jnp.zeros((), jnp.float32)
         init = (jnp.zeros(mb_shape, compute_dtype), circ,
-                jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+                aux0, jnp.zeros((), jnp.float32),
                 stats0)
         (_, _, aux_sum, loss_sum, stats), _ = jax.lax.scan(
             tick, init, jnp.arange(T))
@@ -509,9 +522,11 @@ def pipeline_loss(
 
     loss = loss_total / M
     if model_cfg.num_experts > 0:
+        from ..models.moe import aux_loss_of
+
         # moe_aux sums over all layers and microbatches; per-microbatch mean
         # matches the non-pipelined compute_loss accounting.
-        loss = loss + model_cfg.moe_aux_loss_coeff * moe_aux / M
+        loss = loss + model_cfg.moe_aux_loss_coeff * aux_loss_of(moe_aux) / M
     if return_stats:
         return loss, {"per_token_loss": stats[0], "correct": stats[1]}
     return loss

@@ -108,6 +108,15 @@ class ClassificationDataset:
         if label_map is None:
             labels = sorted({r[2] for r in self.rows})
             label_map = {l: i for i, l in enumerate(labels)}
+        else:
+            # Fail fast on labels absent from a train-derived map: a
+            # KeyError from __getitem__ mid-eval would throw away the whole
+            # run after training completed (advisor finding, round 1).
+            unknown = sorted({r[2] for r in self.rows} - set(label_map))
+            if unknown:
+                raise ValueError(
+                    f"labels {unknown} not present in the provided "
+                    f"label_map (known: {sorted(label_map)})")
         self.label_map = label_map
 
     @property

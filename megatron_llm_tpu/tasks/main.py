@@ -29,8 +29,16 @@ def main(argv: Optional[list] = None) -> int:
 
         cmain(rest)
         return 0
+    if task == "orqa":
+        from .orqa import main as omain
+
+        return omain(rest)
+    if task == "msdp":
+        from .msdp import main as mmain
+
+        return mmain(rest)
     raise SystemExit(f"unknown --task {task!r}; choose from wikitext, "
-                     "lambada, classification")
+                     "lambada, classification, orqa, msdp")
 
 
 if __name__ == "__main__":

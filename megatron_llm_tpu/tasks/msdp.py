@@ -1,0 +1,169 @@
+"""Multi-Stage Dialogue Prompting (MSDP): knowledge + response generation
+and unigram-F1 evaluation.
+
+Reference parity: tasks/msdp/ — ``prompt.py`` builds few-shot prompts from
+a prompt file and a tab-separated test file (``topic\tturn1 [SEP] turn2
+...\tknowledge``), generates with the LM, and ``evaluate.py``/``metrics.py``
+score generations against gold sentences with normalized unigram F1.
+
+The two prompt formats (reference prompt.py:38-140):
+- knowledge: per-(topic + last turn) few-shot examples ending with
+  ``( last_turn ) topic =>``
+- response: a fixed few-shot prefix plus
+  ``Topic: t. User says: u We know that: k System replies:``
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from collections import Counter
+from typing import Optional, Sequence
+
+
+# ---------------------------------------------------------------------------
+# Prompt construction (reference tasks/msdp/prompt.py:38-140)
+# ---------------------------------------------------------------------------
+
+
+def read_prompts(prompt_path: str, prompt_type: str, n_example: int):
+    """knowledge → {key: few-shot prefix}; response → single prefix."""
+    if prompt_type == "knowledge":
+        prompt_examples_dict = {}
+        with open(prompt_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                line_dict = json.loads(line)
+                key = list(line_dict.keys())[0]
+                if key not in prompt_examples_dict:
+                    prompt = ""
+                    for instance in line_dict[key]:
+                        prompt += instance.strip() + " \n"
+                    prompt_examples_dict[key] = prompt
+        return prompt_examples_dict
+    prompt = ""
+    with open(prompt_path) as f:
+        for instance in f.readlines()[:n_example]:
+            prompt += instance.strip() + " \n"
+    return prompt
+
+
+def parse_test_sample(line: str):
+    """``topic\tturns [SEP]-joined\t[knowledge]`` → (topic, turns, knowledge)."""
+    splits = line.strip().split("\t")
+    topic = splits[0]
+    turns = splits[1].split(" [SEP] ")
+    knowledge = splits[2] if len(splits) > 2 else ""
+    return topic, turns, knowledge
+
+
+def build_knowledge_input(prompt_dict: dict, topic: str,
+                          turns: Sequence[str]) -> str:
+    last_turn = turns[-1]
+    key = topic + " " + last_turn
+    return prompt_dict[key] + "( " + last_turn + " ) " + topic + " =>"
+
+
+def build_response_input(prompt: str, topic: str, turns: Sequence[str],
+                         knowledge: str) -> str:
+    last_turn = " ".join(turns[-1].split())
+    knowledge = " ".join(knowledge.split())
+    return (prompt + "Topic: " + topic + ". "
+            + "User says: " + last_turn + " "
+            + "We know that: " + knowledge + " "
+            + "System replies:")
+
+
+def generate_samples_from_file(
+    generate_fn,
+    prompt_file: str,
+    prompt_type: str,
+    sample_input_file: str,
+    sample_output_file: str,
+    num_prompt_examples: int = 10,
+) -> int:
+    """Drive ``generate_fn(prompt_text) -> generation_text`` over the test
+    file, writing one generation per line (reference
+    generate_samples_by_prompting_input_from_file, prompt.py:155-285).
+    Returns the number of samples processed."""
+    assert prompt_type in ("knowledge", "response")
+    prompts = read_prompts(prompt_file, prompt_type, num_prompt_examples)
+    n = 0
+    with open(sample_input_file) as fin, \
+            open(sample_output_file, "w") as fout:
+        for line in fin:
+            if not line.strip():
+                continue
+            topic, turns, knowledge = parse_test_sample(line)
+            if prompt_type == "knowledge":
+                inputs = build_knowledge_input(prompts, topic, turns)
+            else:
+                inputs = build_response_input(prompts, topic, turns,
+                                              knowledge)
+            generation = generate_fn(inputs)
+            # keep the first line of the continuation (the reference stops
+            # generation at "\n")
+            fout.write(generation.split("\n")[0].strip() + "\n")
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Evaluation (reference tasks/msdp/metrics.py + evaluate.py — normalized
+# unigram precision/recall/F1 between guess and answer files)
+# ---------------------------------------------------------------------------
+
+_ARTICLES = re.compile(r"\b(a|an|the)\b")
+
+
+def normalize_answer(s: str) -> str:
+    s = s.lower()
+    s = "".join(c if c.isalnum() or c.isspace() else " " for c in s)
+    s = _ARTICLES.sub(" ", s)
+    return " ".join(s.split())
+
+
+def f1_score(guess: str, answer: str) -> float:
+    g = normalize_answer(guess).split()
+    a = normalize_answer(answer).split()
+    if not g or not a:
+        return float(g == a)
+    common = Counter(g) & Counter(a)
+    num_same = sum(common.values())
+    if num_same == 0:
+        return 0.0
+    precision = num_same / len(g)
+    recall = num_same / len(a)
+    return 2 * precision * recall / (precision + recall)
+
+
+def evaluate_f1(guess_file: str, answer_file: str) -> float:
+    """Mean unigram F1 over paired lines (reference evaluate.py:11-38)."""
+    with open(guess_file) as f:
+        guesses = [l.strip() for l in f if l.strip() != ""]
+    with open(answer_file) as f:
+        answers = [l.strip() for l in f if l.strip() != ""]
+    assert len(guesses) == len(answers), (len(guesses), len(answers))
+    if not guesses:
+        return 0.0
+    return sum(f1_score(g, a) for g, a in zip(guesses, answers)) / len(guesses)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pe = sub.add_parser("evaluate", help="F1 of guess vs answer file")
+    pe.add_argument("--guess_file", required=True)
+    pe.add_argument("--answer_file", required=True)
+    ns = p.parse_args(argv)
+    if ns.cmd == "evaluate":
+        print(json.dumps({"f1": evaluate_f1(ns.guess_file, ns.answer_file)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,157 @@
+"""Open-retrieval QA (ORQA) evaluation: top-k retrieval accuracy.
+
+Reference parity: tasks/orqa/evaluate_utils.py (ORQAEvaluator) +
+tasks/orqa/unsupervised/qa_utils.py's calculate_matches — given a question
+set with gold answer strings and an evidence corpus, embed questions with
+the biencoder query tower, retrieve top-k evidence blocks by exact MIPS
+(models/realm_indexer.py), and report the fraction of questions whose
+answer string appears in at least one of the top-k blocks.
+
+The answer matching here is an original implementation (simple
+unicode/case/whitespace normalization + token-subsequence containment) —
+the reference vendors DPR's regex matcher, which is CC-BY-NC licensed and
+deliberately not reproduced.
+
+Question file format (reference NQ tsv, tasks/orqa/unsupervised/nq.py):
+one question per line, ``question\t["answer 1", "answer 2", ...]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import unicodedata
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def normalize_text(s: str) -> str:
+    s = unicodedata.normalize("NFD", s)
+    s = "".join(c for c in s if unicodedata.category(c) != "Mn")
+    return " ".join(
+        "".join(c.lower() if c.isalnum() else " " for c in s).split())
+
+
+def has_answer(block_text: str, answers: Sequence[str]) -> bool:
+    """True iff any normalized answer occurs as a token subsequence of the
+    normalized block text."""
+    block_tokens = normalize_text(block_text).split()
+    n = len(block_tokens)
+    for ans in answers:
+        a = normalize_text(ans).split()
+        if not a:
+            continue
+        m = len(a)
+        for i in range(n - m + 1):
+            if block_tokens[i:i + m] == a:
+                return True
+    return False
+
+
+def read_nq_file(path: str):
+    """→ (questions [str], answers [list[str]]) from the tsv format."""
+    questions, answers = [], []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            q, ans = line.split("\t", 1)
+            try:
+                parsed = ast.literal_eval(ans)
+            except (ValueError, SyntaxError):
+                parsed = [ans]
+            if isinstance(parsed, str):
+                parsed = [parsed]
+            questions.append(q)
+            answers.append([str(a) for a in parsed])
+    return questions, answers
+
+
+def calculate_topk_hits(retrieved_texts: Sequence[Sequence[str]],
+                        answers: Sequence[Sequence[str]],
+                        top_ks: Sequence[int] = (1, 5, 20, 100)) -> dict:
+    """calculate_matches equivalent: hit@k = fraction of questions whose
+    gold answer appears in any of the first k retrieved blocks."""
+    assert len(retrieved_texts) == len(answers)
+    max_k = max(top_ks)
+    # first rank (0-based) at which the answer appears, or max_k
+    first_hit = []
+    for blocks, ans in zip(retrieved_texts, answers):
+        rank = max_k
+        for i, b in enumerate(blocks[:max_k]):
+            if has_answer(b, ans):
+                rank = i
+                break
+        first_hit.append(rank)
+    first_hit = np.asarray(first_hit)
+    return {f"top{k}_accuracy": float(np.mean(first_hit < k))
+            for k in top_ks}
+
+
+def evaluate_retriever(
+    cfg,
+    params,
+    questions: Sequence[str],
+    answers: Sequence[Sequence[str]],
+    block_texts: Sequence[str],
+    block_vecs: np.ndarray,
+    encode_question,
+    top_ks: Sequence[int] = (1, 5, 20),
+) -> dict:
+    """End-to-end unsupervised ORQA eval (reference ORQAEvaluator.evaluate,
+    tasks/orqa/evaluate_utils.py:78-135).
+
+    ``encode_question(questions) -> [n, d]`` abstracts tokenization —
+    callers bind their tokenizer + biencoder query tower (see
+    tests/tasks/test_orqa.py for the recipe).
+    """
+    from ..models.realm_indexer import mips_search
+
+    q_vecs = np.asarray(encode_question(questions))
+    idx, _scores = mips_search(np.asarray(block_vecs), q_vecs,
+                               top_k=max(top_ks))
+    retrieved = [[block_texts[j] for j in row] for row in idx]
+    stats = calculate_topk_hits(retrieved, answers, top_ks)
+    return stats
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--qa_file", required=True,
+                   help="tsv: question\\t[answers]")
+    p.add_argument("--evidence_texts", required=True,
+                   help="jsonl with {'id': int, 'text': str} per block")
+    p.add_argument("--embedding_path", required=True,
+                   help="BlockDataStore npz from the REALM indexer")
+    p.add_argument("--query_embeds", required=True,
+                   help="npy [n, d] precomputed question embeddings (run "
+                        "the biencoder query tower via tools/ or a "
+                        "notebook; kept separate so this CLI needs no "
+                        "checkpoint plumbing)")
+    p.add_argument("--top_ks", type=int, nargs="+", default=[1, 5, 20])
+    ns = p.parse_args(argv)
+
+    from ..models.realm_indexer import BlockDataStore, mips_search
+
+    questions, answers = read_nq_file(ns.qa_file)
+    texts = {}
+    with open(ns.evidence_texts) as f:
+        for line in f:
+            row = json.loads(line)
+            texts[int(row["id"])] = row["text"]
+    store = BlockDataStore.load(ns.embedding_path)
+    ids, vecs = store.as_arrays()
+    q_vecs = np.load(ns.query_embeds)
+    idx, _ = mips_search(vecs, q_vecs, top_k=max(ns.top_ks))
+    retrieved = [[texts[int(ids[j])] for j in row] for row in idx]
+    stats = calculate_topk_hits(retrieved, answers, ns.top_ks)
+    print(json.dumps(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -406,8 +406,21 @@ def training_log(cfg: RuntimeConfig, log: _LogState, metrics: dict,
         f" grad norm: {grad_norm:.3f} |"
         f" number of skipped iterations: {log.skipped_total:3d} |"
     )
+    if "moe_dropped_frac" in metrics:
+        line += (
+            f" moe dropped frac: {float(metrics['moe_dropped_frac']):.4f} |"
+            f" moe load imbalance: "
+            f"{float(metrics['moe_load_imbalance']):.3f} |")
     print_rank_0(line)
     if writer is not None:
+        if "moe_dropped_frac" in metrics:
+            writer.add_scalar("train/moe_dropped_frac",
+                              float(metrics["moe_dropped_frac"]), iteration)
+            writer.add_scalar("train/moe_load_imbalance",
+                              float(metrics["moe_load_imbalance"]),
+                              iteration)
+            writer.add_scalar("train/moe_aux_loss",
+                              float(metrics["moe_aux_loss"]), iteration)
         writer.add_scalar("train/lm_loss", avg_loss, iteration)
         writer.add_scalar("train/learning_rate", lr, iteration)
         writer.add_scalar("train/grad_norm", grad_norm, iteration)

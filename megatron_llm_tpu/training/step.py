@@ -83,12 +83,15 @@ def zigzag_permute_batch(cfg: RuntimeConfig, batch: dict) -> dict:
 
 
 def compute_loss(cfg: RuntimeConfig, params, batch: dict, rng=None,
-                 deterministic: bool = True, rope=None):
+                 deterministic: bool = True, rope=None,
+                 return_moe_stats: bool = False):
     """Forward + masked LM loss for one microbatch.
 
     ``batch``: tokens [b,s], labels [b,s], loss_mask [b,s] (float weights —
     supports the instruction-tuning scalar-weighted masks of
     finetune.py:148-161), optional position_ids/segment_ids.
+    ``return_moe_stats`` additionally returns the layer-summed MoE stats
+    dict (models/moe.py) for routing observability.
     """
     # Fused linear+CE head: streams the unembedding matmul over vocab
     # blocks with an online logsumexp so the [b, s, vocab] fp32 logits are
@@ -128,7 +131,11 @@ def compute_loss(cfg: RuntimeConfig, params, batch: dict, rng=None,
         )
     loss = masked_mean_loss(per_token, batch["loss_mask"])
     if cfg.model.num_experts > 0:
-        loss = loss + cfg.model.moe_aux_loss_coeff * moe_aux
+        from ..models.moe import aux_loss_of
+
+        loss = loss + cfg.model.moe_aux_loss_coeff * aux_loss_of(moe_aux)
+    if return_moe_stats:
+        return loss, moe_aux
     return loss
 
 
@@ -142,34 +149,54 @@ def _accumulate_grads(cfg: RuntimeConfig, params, batch, rng, rope,
     ``pretrain`` (training.py:55), used by the BERT/T5 entry points.
     """
     accum = jax.tree.leaves(batch)[0].shape[0]
+    want_moe = loss_fn is None and cfg.model.num_experts > 0
 
     def scaled_loss_fn(p, mb, mb_rng):
         if loss_fn is not None:
             loss = loss_fn(cfg, p, mb, mb_rng, mb_rng is None)
+            stats = None
+        elif want_moe:
+            loss, stats = compute_loss(cfg, p, mb, rng=mb_rng,
+                                       deterministic=(mb_rng is None),
+                                       rope=rope, return_moe_stats=True)
         else:
             loss = compute_loss(cfg, p, mb, rng=mb_rng,
                                 deterministic=(mb_rng is None), rope=rope)
-        return loss * loss_scale, loss
+            stats = None
+        return loss * loss_scale, (loss, stats)
 
     grad_fn = jax.value_and_grad(scaled_loss_fn, has_aux=True)
 
     def body(carry, mb_and_idx):
-        grads_acc, loss_acc = carry
+        grads_acc, loss_acc, stats_acc = carry
         mb, idx = mb_and_idx
         mb_rng = jax.random.fold_in(rng, idx) if rng is not None else None
-        (_, loss), grads = grad_fn(params, mb, mb_rng)
+        (_, (loss, stats)), grads = grad_fn(params, mb, mb_rng)
         grads_acc = jax.tree.map(
             lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
-        return (grads_acc, loss_acc + loss), None
+        if stats is not None:
+            stats_acc = jax.tree.map(
+                lambda a, s: a + jax.lax.stop_gradient(s), stats_acc, stats)
+        return (grads_acc, loss_acc + loss, stats_acc), None
 
     zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-    (grads, loss_sum), _ = jax.lax.scan(
-        body, (zeros, jnp.zeros((), jnp.float32)),
+    stats0 = None
+    if want_moe:
+        from ..models.moe import stats_zero
+
+        stats0 = stats_zero(cfg.model)
+    (grads, loss_sum, stats_sum), _ = jax.lax.scan(
+        body, (zeros, jnp.zeros((), jnp.float32), stats0),
         (batch, jnp.arange(accum)),
     )
     inv = 1.0 / accum
     grads = jax.tree.map(lambda g: g * inv, grads)
-    return grads, loss_sum * inv
+    # normalize layer-and-microbatch sums to per-layer means
+    moe_stats = None
+    if stats_sum is not None:
+        norm = 1.0 / (accum * cfg.model.num_layers)
+        moe_stats = jax.tree.map(lambda s: s * norm, stats_sum)
+    return grads, loss_sum * inv, moe_stats
 
 
 def _pipeline_grads(cfg: RuntimeConfig, params, batch, rng, rope,
@@ -220,12 +247,15 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
     scaler = state.opt.scaler
     loss_scale = scaler.scale if scaler is not None else jnp.float32(1.0)
 
+    moe_stats = None
     if cfg.parallel.pipeline_parallel > 1:
+        # MoE routing stats are not fanned out of the pipelined schedule —
+        # only the aux loss crosses the shard_map boundary
         grads, loss = _pipeline_grads(cfg, state.params, batch, rng, rope,
                                       loss_scale, mesh)
     else:
-        grads, loss = _accumulate_grads(cfg, state.params, batch, rng, rope,
-                                        loss_scale, loss_fn)
+        grads, loss, moe_stats = _accumulate_grads(
+            cfg, state.params, batch, rng, rope, loss_scale, loss_fn)
     # unscale (reference: optimizer.py:384-404 unscale-and-check-inf)
     grads = jax.tree.map(lambda g: g / loss_scale, grads)
     grad_norm = opt_lib.global_grad_norm(grads)
@@ -276,6 +306,16 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
         "skipped": found_inf.astype(jnp.int32),
         "loss_scale": loss_scale,
     }
+    if moe_stats is not None:
+        # dropped: mean fraction of (token, choice) assignments lost to
+        # capacity overflow; imbalance: E·max(f_e) — 1.0 when perfectly
+        # balanced (capacity-factor tuning signals, VERDICT weak #8)
+        E = cfg.model.num_experts
+        load = moe_stats["load"]
+        metrics["moe_dropped_frac"] = moe_stats["dropped"]
+        metrics["moe_load_imbalance"] = (
+            E * jnp.max(load) / jnp.maximum(jnp.sum(load), 1e-9))
+        metrics["moe_aux_loss"] = moe_stats["aux"]
     return new_state, metrics
 
 

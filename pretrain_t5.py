@@ -25,7 +25,13 @@ from megatron_llm_tpu.training.driver import pretrain_custom
 def get_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data_path", required=True)
-    p.add_argument("--vocab_size", type=int, required=True)
+    p.add_argument("--vocab_size", type=int, default=None,
+                   help="override (skips loading the tokenizer); sentinels "
+                        "then fall back to the top vocab ids and "
+                        "pad==bos==0, eos=1")
+    p.add_argument("--tokenizer_model", default=None,
+                   help="HF tokenizer (e.g. t5-small): derives vocab size, "
+                        "bos/eos/pad and the <extra_id_i> sentinel ids")
     p.add_argument("--hidden_size", type=int, default=768)
     p.add_argument("--num_layers", type=int, default=12)
     p.add_argument("--num_decoder_layers", type=int, default=None)
@@ -84,13 +90,33 @@ def t5_loss_fn(cfg, params, mb, rng, deterministic):
 
 def main(argv=None):
     args = get_args(argv)
+    sentinel_ids = None
+    if args.vocab_size is not None:
+        # tokenizer-less fallback: pad==bos==0, eos=1, sentinels = top
+        # vocab ids (T5's extra_ids layout for a freshly built vocab)
+        special = T5SpecialTokens(bos=0, eos=1, pad=0)
+    else:
+        if args.tokenizer_model is None:
+            raise SystemExit("pass --tokenizer_model or --vocab_size")
+        from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+
+        tok = build_tokenizer("huggingface", args.tokenizer_model)
+        inner = tok.inner
+        args.vocab_size = tok.vocab_size
+        pad = inner.pad_token_id if inner.pad_token_id is not None else 0
+        special = T5SpecialTokens(
+            bos=pad,  # T5 decoder starts with the pad token
+            eos=inner.eos_token_id, pad=pad)
+        extra = [inner.convert_tokens_to_ids(t)
+                 for t in getattr(inner, "additional_special_tokens", [])]
+        sentinel_ids = [i for i in extra if i is not None] or None
     cfg = t5_runtime_config(args)
-    special = T5SpecialTokens(bos=0, eos=1, pad=0)
     ds = T5Dataset(
         MMapIndexedDataset(args.data_path),
         args.encoder_seq_length, args.decoder_seq_length,
         cfg.model.vocab_size, special,
-        masked_lm_prob=args.masked_lm_prob, seed=args.seed)
+        masked_lm_prob=args.masked_lm_prob, seed=args.seed,
+        sentinel_ids=sentinel_ids)
     params = encdec.init_t5_params(jax.random.key(args.seed), cfg.model)
     return pretrain_custom(cfg, ds, params, t5_loss_fn)
 

@@ -1,0 +1,129 @@
+"""LIMA layer-dependent dropout + DropPath stochastic depth.
+
+Reference: megatron/model/transformer.py:43-64 (DropPath) and :962-971
+(linspace per-layer rate ramps).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.models.transformer import (
+    _drop_path,
+    _layer_rates,
+    rope_tables,
+)
+
+
+def _cfg(**kw):
+    return tiny_config(params_dtype="float32", recompute="none",
+                       seq_length=16, max_position_embeddings=16, **kw)
+
+
+def test_layer_rate_ramp_matches_linspace():
+    cfg = _cfg(num_layers=4, hidden_dropout=0.4, lima_dropout=True,
+               drop_path_rate=0.2)
+    hs, dps = zip(*[_layer_rates(cfg, i) for i in range(4)])
+    np.testing.assert_allclose(hs, np.linspace(0.0, 0.4, 4), rtol=1e-6)
+    np.testing.assert_allclose(dps, np.linspace(0.0, 0.2, 4), rtol=1e-6)
+
+
+def test_lima_first_layer_gets_zero_dropout():
+    """With one layer, the LIMA ramp is [0.0] (linspace(0, p, 1)): the
+    non-deterministic forward must equal the deterministic one even at a
+    high nominal dropout rate — layer-0 truly gets rate 0."""
+    cfg = _cfg(num_layers=1, hidden_dropout=0.9, lima_dropout=True)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32)
+    det = model_lib.forward(cfg, params, tokens)
+    # embedding dropout also runs off hidden_dropout — zero it by comparing
+    # through the stack only (embed rng split still happens)
+    stoch = model_lib.forward(cfg, params, tokens,
+                              rng=jax.random.key(7), deterministic=False)
+    # embedding dropout is NOT LIMA-ramped (reference ramps layer dropout
+    # only), so the outputs differ there; check the *stack* path instead
+    from megatron_llm_tpu.models.transformer import (
+        AttnSideInputs, stack_forward)
+
+    cos, sin = rope_tables(cfg)
+    x = model_lib.embed(cfg, {"embedding": params["embedding"]}, tokens)
+    side = AttnSideInputs(rope_cos=cos, rope_sin=sin, deterministic=False)
+    out_stoch, _ = stack_forward(cfg, params["layers"], x, side,
+                                 jax.random.key(3))
+    side_det = AttnSideInputs(rope_cos=cos, rope_sin=sin,
+                              deterministic=True)
+    out_det, _ = stack_forward(cfg, params["layers"], x, side_det, None)
+    np.testing.assert_allclose(np.asarray(out_stoch), np.asarray(out_det),
+                               rtol=1e-6, atol=1e-6)
+    del det, stoch
+
+
+def test_lima_off_keeps_flat_dropout():
+    """Same single-layer setup without LIMA: dropout must actually fire."""
+    cfg = _cfg(num_layers=1, hidden_dropout=0.9, lima_dropout=False)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32)
+    from megatron_llm_tpu.models.transformer import (
+        AttnSideInputs, stack_forward)
+
+    cos, sin = rope_tables(cfg)
+    x = model_lib.embed(cfg, {"embedding": params["embedding"]}, tokens)
+    side = AttnSideInputs(rope_cos=cos, rope_sin=sin, deterministic=False)
+    out_stoch, _ = stack_forward(cfg, params["layers"], x, side,
+                                 jax.random.key(3))
+    side_det = AttnSideInputs(rope_cos=cos, rope_sin=sin,
+                              deterministic=True)
+    out_det, _ = stack_forward(cfg, params["layers"], x, side_det, None)
+    assert not np.allclose(np.asarray(out_stoch), np.asarray(out_det),
+                           rtol=1e-3)
+
+
+def test_drop_path_per_sample_semantics():
+    """DropPath zeroes whole samples of the branch and rescales the rest
+    by 1/keep — reference transformer.py:52-64."""
+    x = jnp.ones((512, 3, 4), jnp.float32)
+    out = np.asarray(_drop_path(x, 0.5, jax.random.key(0),
+                                deterministic=False))
+    # each sample is either all-zero or all-2.0
+    per_sample = out.reshape(512, -1)
+    is_zero = np.all(per_sample == 0.0, axis=1)
+    is_scaled = np.all(np.isclose(per_sample, 2.0), axis=1)
+    assert np.all(is_zero | is_scaled)
+    frac = is_zero.mean()
+    assert 0.35 < frac < 0.65, frac  # ~Bernoulli(0.5)
+
+
+def test_droppath_training_smoke_grads_finite():
+    """Grads flow through lima+drop_path training (scan + remat path)."""
+    from megatron_llm_tpu.config import (
+        OptimizerConfig, ParallelConfig, RuntimeConfig, TrainConfig)
+    from megatron_llm_tpu.training.step import compute_loss
+
+    cfg = tiny_config(params_dtype="float32", recompute="selective",
+                      seq_length=16, max_position_embeddings=16,
+                      num_layers=4, hidden_dropout=0.2, lima_dropout=True,
+                      drop_path_rate=0.3)
+    runtime = RuntimeConfig(model=cfg, parallel=ParallelConfig(),
+                            optimizer=OptimizerConfig(),
+                            train=TrainConfig(seq_length=cfg.seq_length))
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    g = np.random.default_rng(5)
+    batch = {
+        "tokens": jnp.asarray(g.integers(0, cfg.vocab_size, (2, 16)),
+                              jnp.int32),
+        "labels": jnp.asarray(g.integers(0, cfg.vocab_size, (2, 16)),
+                              jnp.int32),
+        "loss_mask": jnp.ones((2, 16), jnp.float32),
+    }
+    loss, grads = jax.value_and_grad(
+        lambda p: compute_loss(runtime, p, batch, rng=jax.random.key(2),
+                               deterministic=False))(params)
+    assert np.isfinite(float(loss))
+    for leaf in jax.tree.leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf)))

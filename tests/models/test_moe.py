@@ -44,11 +44,16 @@ def test_moe_block_shapes_and_aux():
     p = moe_lib.init_moe_params(jax.random.key(0), cfg)
     x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 32, 32)),
                     jnp.float32)
-    out, aux = moe_lib.moe_block(cfg, p, x)
+    out, stats = moe_lib.moe_block(cfg, p, x)
     assert out.shape == x.shape
+    aux = moe_lib.aux_loss_of(stats)
     assert np.isfinite(float(aux))
     # aux ≥ 1 (it is E·Σf·p with Σf = Σp = 1; minimum at uniform balance)
     assert float(aux) >= 0.99
+    # observability stats: dropped fraction in [0,1], loads sum to 1
+    assert 0.0 <= float(stats["dropped"]) <= 1.0
+    np.testing.assert_allclose(float(jnp.sum(stats["load"])), 1.0,
+                               rtol=1e-6)
 
 
 def test_moe_top1_selects_single_expert():
@@ -89,11 +94,13 @@ def test_moe_capacity_drops_overflow():
     p["router"] = jnp.zeros_like(p["router"])  # uniform probs → all pick e0
     x = jnp.asarray(np.random.default_rng(2).normal(size=(1, 32, 32)),
                     jnp.float32)
-    out, aux = moe_lib.moe_block(cfg, p, x)
+    out, stats = moe_lib.moe_block(cfg, p, x)
     out = np.asarray(out)
     assert np.abs(out[0, 0]).sum() > 0  # first token served by expert 0
     np.testing.assert_array_equal(out[0, 1:], 0.0)  # overflow dropped
-    assert np.isfinite(float(aux))
+    assert np.isfinite(float(moe_lib.aux_loss_of(stats)))
+    # 31 of 32 assignments overflow the C=1 capacity
+    np.testing.assert_allclose(float(stats["dropped"]), 31 / 32, rtol=1e-6)
 
 
 def test_moe_model_forward_and_grad():
@@ -107,7 +114,7 @@ def test_moe_model_forward_and_grad():
 
     def loss(p):
         lg, a = model_lib.forward(cfg, p, tokens, return_aux=True)
-        return jnp.mean(lg ** 2) + 0.01 * a
+        return jnp.mean(lg ** 2) + 0.01 * moe_lib.aux_loss_of(a)
 
     grads = jax.grad(loss)(params)
     gn = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree.leaves(grads))
@@ -160,9 +167,70 @@ def test_moe_train_step_ep():
         params = model_lib.init_params(jax.random.key(0), cfg.model)
         art = setup_train_state(cfg, params=params)
         _, metrics = art.step_fn(art.state, batch, None)
-        return float(metrics["loss"])
+        return float(metrics["loss"]), metrics
 
-    loss_ep = run(4)
-    loss_ref = run(1)
+    loss_ep, metrics = run(4)
+    loss_ref, _ = run(1)
     assert np.isfinite(loss_ep)
     np.testing.assert_allclose(loss_ep, loss_ref, rtol=1e-4, atol=1e-4)
+    # routing observability surfaces in the train metrics
+    assert 0.0 <= float(metrics["moe_dropped_frac"]) <= 1.0
+    assert float(metrics["moe_load_imbalance"]) >= 0.99
+    assert np.isfinite(float(metrics["moe_aux_loss"]))
+
+
+def test_dispatch_memory_scaling():
+    """The grouped dispatch tensors must be E-independent (E·C is constant
+    at fixed group size): XLA temp bytes equal at E=4 vs E=16 — the
+    documented E-scaling property (models/moe.py docstring)."""
+    def temp_bytes(E):
+        cfg = moe_cfg(num_experts=E, hidden_size=64, ffn_hidden_size=128,
+                      seq_length=256, max_position_embeddings=256)
+        p = moe_lib.init_moe_params(jax.random.key(0), cfg)
+        x = jnp.zeros((2, 256, 64), jnp.float32)
+        c = jax.jit(
+            lambda p, x: moe_lib.moe_block(cfg, p, x)).lower(p, x).compile()
+        return c.memory_analysis().temp_size_in_bytes
+
+    b4, b16 = temp_bytes(4), temp_bytes(16)
+    assert abs(b16 - b4) / b4 < 0.1, (b4, b16)
+
+
+def test_moe_through_pipeline():
+    """MoE stats/aux tree flows through the pipelined schedule (pp=2)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from megatron_llm_tpu.parallel import pipeline as pipe
+
+    cfg = tiny_config(num_layers=4, num_experts=4, moe_top_k=2,
+                      params_dtype="float32", recompute="none",
+                      seq_length=32, max_position_embeddings=32)
+    parallel = ParallelConfig(pipeline_parallel=2, num_microbatches=3)
+    runtime = RuntimeConfig(model=cfg, parallel=parallel,
+                            optimizer=OptimizerConfig(),
+                            train=TrainConfig(seq_length=32)).validate()
+    mesh = mesh_lib.build_mesh(parallel)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    p_params = pipe.to_pipeline_params(params, parallel)
+    specs = pipe.pipeline_param_specs(
+        shard_lib.param_specs(cfg, parallel), parallel)
+    p_params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        p_params, specs, is_leaf=lambda v: isinstance(v, P))
+    g = np.random.default_rng(0)
+    batch = {
+        "tokens": jnp.asarray(
+            g.integers(0, cfg.vocab_size, (3, 2, 32)), jnp.int32),
+        "labels": jnp.asarray(
+            g.integers(0, cfg.vocab_size, (3, 2, 32)), jnp.int32),
+        "loss_mask": jnp.ones((3, 2, 32), jnp.float32),
+    }
+    with mesh_lib.use_mesh(mesh):
+        loss = jax.jit(
+            lambda p, b: pipe.pipeline_loss(runtime, p, b, mesh=mesh)
+        )(p_params, batch)
+        grads = jax.jit(jax.grad(
+            lambda p: pipe.pipeline_loss(runtime, p, batch, mesh=mesh)
+        ))(p_params)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(grads))

@@ -1,0 +1,191 @@
+"""ORQA retrieval eval + MSDP prompting/F1 (reference tasks/orqa, tasks/msdp)
+and the REALM index builder (reference megatron/indexer.py)."""
+
+import json
+
+import numpy as np
+
+from megatron_llm_tpu.tasks import msdp, orqa
+
+
+def test_orqa_normalize_and_has_answer():
+    assert orqa.normalize_text("The Quick,  Brown-Fox!") == \
+        "the quick brown fox"
+    assert orqa.has_answer("He was born in París in 1822.", ["Paris"])
+    assert orqa.has_answer("the answer is forty two", ["forty two"])
+    assert not orqa.has_answer("fortytwo concatenated", ["forty two"])
+    assert not orqa.has_answer("some text", ["missing"])
+
+
+def test_orqa_topk_hits():
+    retrieved = [
+        ["no match here", "Paris is the capital of France", "x"],
+        ["nothing", "still nothing", "nope"],
+    ]
+    answers = [["Paris"], ["berlin"]]
+    stats = orqa.calculate_topk_hits(retrieved, answers, top_ks=(1, 2, 3))
+    assert stats["top1_accuracy"] == 0.0
+    assert stats["top2_accuracy"] == 0.5
+    assert stats["top3_accuracy"] == 0.5
+
+
+def test_orqa_nq_file_roundtrip(tmp_path):
+    f = tmp_path / "nq.tsv"
+    f.write_text('who wrote hamlet\t["Shakespeare", "W. Shakespeare"]\n'
+                 "capital of france\t['Paris']\n")
+    qs, ans = orqa.read_nq_file(str(f))
+    assert qs == ["who wrote hamlet", "capital of france"]
+    assert ans[0] == ["Shakespeare", "W. Shakespeare"]
+    assert ans[1] == ["Paris"]
+
+
+def test_orqa_evaluate_retriever_end_to_end():
+    """Questions retrieve blocks by exact MIPS over toy embeddings."""
+    block_texts = ["the sky is blue", "grass is green", "snow is white"]
+    block_vecs = np.eye(3, 4, dtype=np.float32)
+    answers = [["blue"], ["green"]]
+
+    def encode_question(questions):
+        # question i points at block i by construction
+        return np.eye(len(questions), 4, dtype=np.float32)
+
+    stats = orqa.evaluate_retriever(
+        None, None, ["q0", "q1"], answers, block_texts, block_vecs,
+        encode_question, top_ks=(1, 2))
+    assert stats["top1_accuracy"] == 1.0
+
+
+def test_msdp_prompts(tmp_path):
+    kfile = tmp_path / "kprompts.jsonl"
+    kfile.write_text(json.dumps(
+        {"cars i like cars": ["( i like cars ) cars => they go fast",
+                              "( they are red ) cars => red ones"]}) + "\n")
+    prompts = msdp.read_prompts(str(kfile), "knowledge", 10)
+    inp = msdp.build_knowledge_input(prompts, "cars", ["i like cars"])
+    assert inp.endswith("( i like cars ) cars =>")
+    assert "they go fast" in inp
+
+    rfile = tmp_path / "rprompts.txt"
+    rfile.write_text("example one\nexample two\nexample three\n")
+    rprompt = msdp.read_prompts(str(rfile), "response", 2)
+    assert "example one" in rprompt and "example three" not in rprompt
+    inp = msdp.build_response_input(rprompt, "cars", ["hello", "i like cars"],
+                                    "cars are vehicles")
+    assert inp.endswith("System replies:")
+    assert "We know that: cars are vehicles" in inp
+
+
+def test_msdp_generate_from_file(tmp_path):
+    rfile = tmp_path / "rprompts.txt"
+    rfile.write_text("p1\np2\n")
+    tests = tmp_path / "test.tsv"
+    tests.write_text("cars\thi [SEP] i like cars\tcars are fast\n"
+                     "dogs\twoof\tdogs bark\n")
+    out = tmp_path / "out.txt"
+    n = msdp.generate_samples_from_file(
+        lambda prompt: "GEN:" + prompt[-10:] + "\nextra line",
+        str(rfile), "response", str(tests), str(out))
+    assert n == 2
+    lines = out.read_text().splitlines()
+    assert len(lines) == 2
+    assert all(l.startswith("GEN:") for l in lines)
+
+
+def test_msdp_f1(tmp_path):
+    g = tmp_path / "guess.txt"
+    a = tmp_path / "answer.txt"
+    g.write_text("the cat sat on the mat\ntotally wrong\n")
+    a.write_text("a cat sat on a mat\nnothing shared here\n")
+    f1 = msdp.evaluate_f1(str(g), str(a))
+    # first pair: perfect after article removal → 1.0; second → 0.0
+    assert abs(f1 - 0.5) < 1e-6
+    assert msdp.f1_score("exact match", "exact match") == 1.0
+
+
+def test_realm_index_builder_shard_merge(tmp_path):
+    """IndexBuilder over a fake 2-process split; shards merge losslessly
+    (reference indexer.py:72-123 save_shard/merge semantics)."""
+    from megatron_llm_tpu.models.realm_indexer import (
+        BlockDataStore, IndexBuilder, mips_search)
+
+    rng = np.random.default_rng(0)
+
+    class FakeDataset:
+        mapping = np.asarray([[0, 2, 0, 0], [2, 4, 0, 1], [4, 6, 1, 2],
+                              [6, 8, 1, 3]], np.int32)
+
+        def get_block(self, start, end, doc):
+            toks = np.arange(start, end, dtype=np.int64)
+            return toks, np.ones_like(toks, np.float32)
+
+    class FakeEmbed:
+        """Stub the jitted embed with a deterministic function of tokens."""
+
+        def __call__(self, t, m, p):
+            return np.asarray(t, np.float32).sum(-1, keepdims=True) * \
+                np.ones((t.shape[0], 4), np.float32)
+
+    path = tmp_path / "embeds.npz"
+    stores = []
+    for rank in range(2):
+        b = IndexBuilder.__new__(IndexBuilder)
+        b.dataset = FakeDataset()
+        b.batch_size = 2
+        b.log_interval = 100
+        b.rank, b.world = rank, 2
+        b.store = BlockDataStore(str(path))
+        b._embed = FakeEmbed()
+        b._proj_c = None
+        b.build()
+        b.store.save_shard(rank)
+        stores.append(b.store)
+    merged = BlockDataStore(str(path))
+    merged.merge_shards_and_save()
+    assert sorted(merged.embed_data) == [0, 1, 2, 3]
+
+    reloaded = BlockDataStore.load(str(path))
+    ids, vecs = reloaded.as_arrays()
+    assert list(ids) == [0, 1, 2, 3]
+    # block 0 = tokens [0,1] → sum 1; block 3 = [6,7] → 13
+    np.testing.assert_allclose(vecs[0], np.full(4, 1.0))
+    np.testing.assert_allclose(vecs[3], np.full(4, 13.0))
+
+    idx, scores = mips_search(vecs, np.ones((1, 4), np.float32), top_k=2)
+    assert idx[0, 0] == 3  # largest inner product
+
+
+def test_ict_dataset_titles_and_block_data(tmp_path):
+    """ICT blocks with a titles dataset: targets shrink by title length,
+    contexts start [CLS] title [SEP], and block_data carries ids."""
+    from megatron_llm_tpu.data.ict_dataset import ICTDataset, ICTSpecialTokens
+    from megatron_llm_tpu.data.indexed_dataset import (
+        MMapIndexedDataset, MMapIndexedDatasetBuilder)
+
+    rng = np.random.default_rng(1)
+    spath = tmp_path / "sents"
+    b = MMapIndexedDatasetBuilder(str(spath), dtype=np.int32)
+    for _ in range(6):
+        for _ in range(3):
+            b.add_item(rng.integers(1, 80, 6))
+        b.end_document()
+    b.finalize()
+    tpath = tmp_path / "titles"
+    tb = MMapIndexedDatasetBuilder(str(tpath), dtype=np.int32)
+    for _ in range(6):
+        tb.add_item(rng.integers(1, 80, 3))
+        tb.end_document()
+    tb.finalize()
+
+    sp = ICTSpecialTokens(cls=90, sep=91, pad=0)
+    ds = ICTDataset(MMapIndexedDataset(str(spath)), 16, 48, sp, seed=1,
+                    titles=MMapIndexedDataset(str(tpath)))
+    assert len(ds) > 0
+    s = ds[0]
+    start, end, doc, block_id = (int(x) for x in s["block_data"])
+    assert end > start and 0 <= doc < 6
+    ctx = s["context_tokens"]
+    assert ctx[0] == sp.cls
+    assert ctx[4] == sp.sep  # 3 title tokens then [SEP]
+    toks, mask = ds.get_block(start, end, doc)
+    assert toks.shape == (48,)
+    assert toks[0] == sp.cls

@@ -1,0 +1,29 @@
+"""Two-process multihost dryrun (tools/multihost_dryrun.py) as a CI test.
+
+Covers the multi-process paths single-process tests cannot reach:
+jax.distributed rendezvous via initialize.initialize_distributed, a global
+mesh with dp spanning processes, per-process data feeding, the
+_cluster_any signal consensus, and coordinated orbax save/load
+(VERDICT round 1, next-step #5).
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def test_two_process_dryrun():
+    env = dict(os.environ)
+    # The launcher sets per-worker JAX env itself; make sure nothing from
+    # the test session's single-process config leaks through.
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "multihost_dryrun.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert '"multihost": "ok"' in proc.stdout
+    assert '"processes": 2' in proc.stdout

@@ -1,0 +1,174 @@
+"""Real-HF-checkpoint trust path (opt-in; reference
+verify_correctness.py:113-173 + tests/test_llama_weights.py:91-118).
+
+This environment has **zero egress** — the HF hub is unreachable — so no
+real Llama/TinyLlama checkpoint can be downloaded here (verified: hub
+requests hang).  The full harness is nevertheless wired and runs whenever a
+real checkpoint directory is provided:
+
+    MEGATRON_TPU_HF_MODEL=/path/to/hf_llama_dir \
+        python -m pytest tests_tpu/test_real_weights.py -q
+
+It then asserts the reference's published tolerances on the real weights:
+avg(max|Δlogit|) ≤ 0.001 in fp32, avg abs err < 0.1 in bf16
+(docs/guide/getting_started.md:154), plus native→HF→native round-trip
+exactness and a real-tokenizer encode/decode round-trip.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+MODEL_DIR = os.environ.get("MEGATRON_TPU_HF_MODEL")
+
+needs_real_weights = pytest.mark.skipif(
+    not MODEL_DIR,
+    reason="set MEGATRON_TPU_HF_MODEL to a local HF Llama checkpoint dir "
+           "(no egress in this environment: the hub is unreachable, so "
+           "these only run where real weights are already on disk)")
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    import transformers
+
+    return transformers.AutoModelForCausalLM.from_pretrained(
+        MODEL_DIR, torch_dtype="float32", attn_implementation="eager",
+    ).eval()
+
+
+@pytest.fixture(scope="module")
+def converted(hf_model):
+    from megatron_llm_tpu.tools import hf_interop
+
+    cfg = hf_interop.config_from_hf(hf_model.config, family="llama",
+                                    params_dtype="float32",
+                                    attention_impl="dot",
+                                    recompute="none")
+    params = hf_interop.llama_from_hf(hf_model.state_dict(), cfg)
+    return cfg, params
+
+
+@needs_real_weights
+def test_fp32_logit_match_reference_tolerance(hf_model, converted):
+    """avg(max|Δlogit|) ≤ 0.001 over random batches — the exact gate of the
+    reference's tests/test_llama_weights.py:117."""
+    from megatron_llm_tpu.tools.verify_correctness import (
+        _random_batches, verify)
+
+    cfg, params = converted
+    batches = _random_batches(cfg.vocab_size, iters=4, batch_size=1,
+                              seq_length=min(
+                                  512, cfg.max_position_embeddings))
+    report = verify(cfg, params, hf_model, batches, tolerance=1e-3)
+    print("real-weights fp32:", {k: v for k, v in report.items()
+                                 if k != "steps"})
+    assert report["passed"], report
+
+
+@needs_real_weights
+def test_bf16_tolerance(hf_model, converted):
+    """avg abs err < 0.1 in bf16 (docs/guide/getting_started.md:154)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.models import model as model_lib
+
+    cfg, params = converted
+    bcfg = dataclasses.replace(cfg, params_dtype="bfloat16")
+    bparams = jax.tree.map(lambda x: jnp.asarray(x, jnp.bfloat16), params)
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (1, 256))
+    import torch
+
+    with torch.no_grad():
+        want = hf_model(torch.tensor(tokens)).logits.float().numpy()
+    got = np.asarray(jax.jit(
+        lambda p, t: model_lib.forward(bcfg, p, t))(
+            bparams, jnp.asarray(tokens)), np.float32)
+    got = got[..., : cfg.vocab_size]
+    err = float(np.mean(np.abs(got - want)))
+    print("real-weights bf16 avg abs err:", err)
+    assert err < 0.1, err
+
+
+@needs_real_weights
+def test_roundtrip_native_hf_native(hf_model, converted):
+    from megatron_llm_tpu.tools import hf_interop
+
+    cfg, params = converted
+    sd = hf_interop.llama_to_hf(params, cfg)
+    params2 = hf_interop.llama_from_hf(sd, cfg)
+    import jax
+
+    for (pa, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(params),
+                               jax.tree_util.tree_leaves_with_path(params2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(pa))
+
+
+@needs_real_weights
+def test_real_tokenizer_roundtrip():
+    tok_file = os.path.join(MODEL_DIR, "tokenizer.model")
+    if not os.path.exists(tok_file):
+        pytest.skip("checkpoint has no sentencepiece tokenizer.model")
+    from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+
+    tok = build_tokenizer("sentencepiece", tok_file)
+    text = "The quick brown fox jumps over 13 lazy dogs — naïve café."
+    ids = tok.tokenize(text)
+    assert tok.detokenize(ids).strip() == text
+
+
+# ---------------------------------------------------------------------------
+# Offline fallback: full-WIDTH Llama-2-7B dims (reduced depth), random
+# weights.  Not a substitute for real weights, but it exercises the exact
+# production matmul shapes (h=4096, 32 heads, ffn=11008, vocab=32000)
+# through the converter + forward on hardware — the strongest trust
+# evidence obtainable with zero egress.
+# ---------------------------------------------------------------------------
+
+
+def test_full_width_llama_dims_parity():
+    import torch
+    import transformers
+
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.tools import hf_interop
+    from megatron_llm_tpu.models import model as model_lib
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=2, num_attention_heads=32,
+        num_key_value_heads=32, max_position_embeddings=4096,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    cfg = hf_interop.config_from_hf(hf_cfg, family="llama",
+                                    params_dtype="float32",
+                                    attention_impl="dot",
+                                    recompute="none")
+    params = hf_interop.llama_from_hf(hf_model.state_dict(), cfg)
+
+    tokens = np.random.default_rng(1).integers(0, 32000, (1, 128))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(tokens)).logits.float().numpy()
+    # TPU fp32 matmuls default to fast bf16-based passes (~1e-1 error at
+    # h=4096); the trust path needs true fp32 MXU passes
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(jax.jit(
+            lambda p, t: model_lib.forward(cfg, p, t))(
+                params, jnp.asarray(tokens)))[..., :32000]
+    diff = float(np.max(np.abs(got - want)))
+    print("full-width llama dims max|Δlogit|:", diff)
+    # reference gate for real fp32 weights is avg(max) ≤ 1e-3; random
+    # full-width weights accumulate slightly more fp32 noise
+    assert diff < 5e-3, diff
