@@ -1,0 +1,130 @@
+"""Pallas TPU decode-attention kernel: one new token against a KV cache.
+
+The XLA lowering of the decode GEMV (`ops/attention.py:decode_attention`)
+runs as a kLoop multiply-reduce fusion at a few percent of HBM bandwidth on
+v5e (profiled ~0.44 ms/layer at max_len=1024 vs a ~0.04 ms read floor).
+This kernel streams the head-major cache blocks through VMEM with the
+online-softmax recurrence (same math as kernels/flash_attention.py, q-len =
+the GQA group) and reads the dynamic fill level from SMEM, so work beyond
+``cache_len`` is masked, not branched.
+
+Layout contract (models/model.py:init_kv_cache): cache [b, kv, max_len, d],
+q [b, kv·group, d] for a single new token.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(scale: float, nk: int, block_k: int,
+                   len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0]                                   # [g_pad, d]
+    k = k_ref[0, 0]                                   # [block_k, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                          # [g_pad, block_k]
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_k
+    s = jnp.where(cols < len_ref[0], s, NEG_INF)
+
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_scr[:] = jnp.broadcast_to(
+        alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True),
+        l_scr.shape)
+    v = v_ref[0, 0]
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scr[:] = acc_scr[:] * alpha + pv
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)
+                       ).astype(o_ref.dtype)
+
+
+def flash_decode(
+    q: jax.Array,        # [b, n_heads, d] — ONE new token's queries
+    k_cache: jax.Array,  # [b, kv_heads, max_len, d]
+    v_cache: jax.Array,
+    cache_len: jax.Array,  # scalar int32: valid slots = cache_len (incl. new)
+    *,
+    softmax_scale: float | None = None,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """→ [b, n_heads, d] attention output for the single new token."""
+    b, n_heads, d = q.shape
+    _, kv_heads, max_len, _ = k_cache.shape
+    group = n_heads // kv_heads
+    if softmax_scale is None:
+        softmax_scale = 1.0 / float(np.sqrt(d))
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    block_k = min(block_k, max_len)
+    while max_len % block_k:
+        block_k //= 2
+    assert block_k >= 128, (max_len, block_k)
+    nk = max_len // block_k
+
+    # [b, kv, g, d] rows, padded up to the 8-sublane tile
+    g_pad = max(8, group)
+    qg = q.reshape(b, kv_heads, group, d)
+    if g_pad != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - group), (0, 0)))
+
+    lens = jnp.reshape(cache_len, (1,)).astype(jnp.int32)
+
+    grid = (b, kv_heads, nk)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, float(softmax_scale), nk, block_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, g_pad, d),
+                             lambda bi, hi, ki, lens: (bi, hi, 0, 0)),
+                pl.BlockSpec((1, 1, block_k, d),
+                             lambda bi, hi, ki, lens: (bi, hi, ki, 0)),
+                pl.BlockSpec((1, 1, block_k, d),
+                             lambda bi, hi, ki, lens: (bi, hi, ki, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, g_pad, d),
+                                   lambda bi, hi, ki, lens: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((g_pad, 128), jnp.float32),
+                pltpu.VMEM((g_pad, 128), jnp.float32),
+                pltpu.VMEM((g_pad, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv_heads, g_pad, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lens, qg, k_cache, v_cache)
+    return out[:, :, :group].reshape(b, n_heads, d)

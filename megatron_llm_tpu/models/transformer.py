@@ -202,9 +202,11 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
     GQA/MQA handled inside the attention einsum rather than by tiling K/V.
 
     ``kv_cache`` is an optional ``(k_cache, v_cache, length)`` triple
-    ([b, max_len, nkv, d] ×2 + scalar int32) for incremental decoding (the
-    reference's InferenceParams KV cache, transformer.py:423-496).  When
-    given, the return value is ``(out, (new_k_cache, new_v_cache))``.
+    (head-major [b, nkv, max_len, d] ×2 + scalar int32) for incremental
+    decoding (the reference's InferenceParams KV cache,
+    transformer.py:423-496).  When given, the return value is
+    ``(out, (new_k_rows, new_v_rows))`` — the new tokens' [b, nkv, s, d]
+    rows, NOT an updated cache; the caller owns the write-back.
     """
     b, s, h = x.shape
     d = cfg.head_dim
@@ -243,23 +245,18 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
         drop_rng = jax.random.fold_in(layer_rng, 1)
 
     if kv_cache is not None:
-        k_cache, v_cache, cache_len = kv_cache
+        from ..ops.attention import decode_attention
+
+        k_cache, v_cache, cache_len = kv_cache  # [b, nkv, max_len, d]
+        # head-major rows [b, nkv, s, d] — contiguous with the cache layout
+        new_k = jnp.transpose(k, (0, 2, 1, 3)).astype(k_cache.dtype)
+        new_v = jnp.transpose(v, (0, 2, 1, 3)).astype(v_cache.dtype)
         k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, cache_len, 0, 0))
+            k_cache, new_k, (0, 0, cache_len, 0))
         v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, cache_len, 0, 0))
-        # Causal-with-offset mask over the static-length cache: query i (at
-        # absolute position cache_len+i) may see cache slot j iff
-        # j <= cache_len + i.  Slots past the fill level hold garbage but are
-        # masked by the same inequality.
-        max_len = k_cache.shape[1]
-        i = jnp.arange(s)[:, None]
-        j = jnp.arange(max_len)[None, :]
-        bias = jnp.where(j <= (cache_len + i), 0.0, -jnp.inf
-                         )[None, None].astype(jnp.float32)
-        ctx = attention(
-            q, k_cache, v_cache,
-            impl="dot", causal=False, bias=bias,
+            v_cache, new_v, (0, 0, cache_len, 0))
+        ctx = decode_attention(
+            q, k_cache, v_cache, cache_len,
             softmax_scale=softmax_scale,
         )
     else:
@@ -280,7 +277,11 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
     if "bo" in p:
         out = out + p["bo"]
     if kv_cache is not None:
-        return out, (k_cache, v_cache)
+        # return only the NEW rows [b, nkv, s, d] — the caller writes them
+        # into its persistent cache with a row-sized dynamic_update_slice,
+        # so decode never copies the O(max_len) cache (measured 8-30x of
+        # the whole per-step cost before this change)
+        return out, (new_k, new_v)
     return out
 
 
@@ -441,25 +442,39 @@ def stack_forward(cfg: ModelConfig, stacked: Params, x: jax.Array,
 
 def stack_forward_cached(cfg: ModelConfig, stacked: Params, x: jax.Array,
                          side: AttnSideInputs,
-                         k_cache: jax.Array,  # [L, b, max_len, nkv, d]
+                         k_cache: jax.Array,  # [L, b, nkv, max_len, d]
                          v_cache: jax.Array,
                          cache_len: jax.Array):
     """Scan over layers threading a per-layer KV cache (decode path).
 
     The cache is stacked on the leading layer axis, mirroring the stacked
-    parameter layout, so one compiled layer body serves every depth.  Returns
-    ``(hidden, new_k_cache, new_v_cache)``; the caller advances ``cache_len``.
-    Parity: the reference's InferenceParams threading through
-    ParallelTransformer (megatron/model/transformer.py:423-496,1158-1246).
+    parameter layout, so one compiled layer body serves every depth.  The
+    caches enter the scan as read-only *xs* (per-layer slices); each layer
+    returns only its new token rows ([L, b, nkv, s, d] stacked ys) and one
+    batched dynamic_update_slice after the scan writes them back — earlier
+    designs that threaded updated caches through the scan ys re-stacked
+    (copied) the entire cache every decode step, which dominated decode
+    latency (3x measured at max_len=256, worse as the window grows).
+    Returns ``(hidden, new_k_cache, new_v_cache)``; the caller advances
+    ``cache_len``.  Parity: the reference's InferenceParams threading
+    through ParallelTransformer (transformer.py:423-496,1158-1246).
     """
-
     def body(h, inp):
-        layer_params, kc, vc = inp
-        h, _aux, (kc, vc) = layer_forward(cfg, layer_params, h, side, None,
-                                          kv_cache=(kc, vc, cache_len))
-        return h, (kc, vc)
+        layer_params, k_l, v_l = inp  # per-layer cache slices, read-only xs
+        h, _aux, (k_rows, v_rows) = layer_forward(
+            cfg, layer_params, h, side, None,
+            kv_cache=(k_l, v_l, cache_len))
+        return h, (k_rows, v_rows)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (stacked, k_cache, v_cache))
+    x, (rows_k, rows_v) = jax.lax.scan(
+        body, x, (stacked, k_cache, v_cache))
+    # one batched row write [L, b, nkv, s_new, d] — XLA aliases the DUS
+    # with the loop-carried cache buffer, so decode writes s_new rows
+    # instead of round-tripping the whole cache
+    new_k = jax.lax.dynamic_update_slice(
+        k_cache, rows_k, (0, 0, 0, cache_len, 0))
+    new_v = jax.lax.dynamic_update_slice(
+        v_cache, rows_v, (0, 0, 0, cache_len, 0))
     return x, new_k, new_v
 
 

@@ -49,6 +49,60 @@ def make_causal_mask(seq_q: int, seq_k: int, dtype=jnp.float32) -> jax.Array:
     return jnp.where(keep, 0.0, -np.inf).astype(dtype)[None, None]
 
 
+def decode_attention(
+    q: jax.Array,        # [b, s, n_heads, d] — the new tokens' queries
+    k_cache: jax.Array,  # [b, kv_heads, max_len, d] head-major, updated
+    v_cache: jax.Array,  # [b, kv_heads, max_len, d]
+    cache_len,           # scalar int32: absolute position of q's first token
+    *,
+    softmax_scale: float | None = None,
+) -> jax.Array:
+    """Incremental-decode attention over a head-major KV cache.
+
+    Purpose-built for the generation loop: both einsums contract directly
+    over the cache's contiguous [max_len, d] blocks, so XLA emits batched
+    GEMVs with **no transpose/copy of the cache** — the generic
+    `dot_product_attention` path materialized a transposed fp32 copy of
+    the whole cache every step (~20 ms/step at max_len=1024 on v5e vs the
+    ~1 ms bandwidth floor this path approaches).  Slots past the fill
+    level hold garbage but are masked by the causal-with-offset
+    inequality j <= cache_len + i.
+    """
+    b, s, n_heads, d = q.shape
+    _, kv_heads, max_len, _ = k_cache.shape
+    group = n_heads // kv_heads
+    if softmax_scale is None:
+        softmax_scale = 1.0 / float(np.sqrt(d))
+
+    if (s == 1 and d % 128 == 0 and max_len % 128 == 0
+            and jax.devices()[0].platform == "tpu"):
+        # single-token decode: the Pallas kernel streams the cache through
+        # VMEM at near-HBM bandwidth where the XLA lowering runs a kLoop
+        # multiply-reduce fusion at a few percent of it
+        from ..kernels.flash_decode import flash_decode
+
+        out = flash_decode(q[:, 0], k_cache, v_cache, cache_len + 1,
+                           softmax_scale=softmax_scale)
+        return out[:, None]
+
+    # [b, kv, group·s, d]: fold the GQA group and the (tiny) new-token dim
+    # into the GEMV row dim
+    qg = jnp.transpose(q.reshape(b, s, kv_heads, group, d),
+                       (0, 2, 3, 1, 4)).reshape(b, kv_heads, group * s, d)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qg, k_cache,
+                        preferred_element_type=jnp.float32) * softmax_scale
+    i = jnp.arange(s)                       # query row offsets
+    j = jnp.arange(max_len)
+    keep = j[None, :] <= (cache_len + i[:, None])     # [s, max_len]
+    keep = jnp.tile(keep, (group, 1))                 # rows are (g, s) pairs
+    scores = jnp.where(keep[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)  # [b, kv, g·s, d]
+    out = jnp.transpose(out.reshape(b, kv_heads, group, s, d),
+                        (0, 3, 1, 2, 4))
+    return out.reshape(b, s, n_heads, d)
+
+
 def dot_product_attention(
     q: jax.Array,  # [b, sq, n_heads, d]
     k: jax.Array,  # [b, sk, kv_heads, d]

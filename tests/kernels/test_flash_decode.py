@@ -1,0 +1,42 @@
+"""Pallas decode-attention kernel vs the einsum reference (interpret mode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.kernels.flash_decode import flash_decode
+from megatron_llm_tpu.ops.attention import decode_attention
+
+
+@pytest.mark.parametrize("heads,kv_heads,cache_len", [
+    (8, 8, 17), (8, 2, 100), (4, 1, 511), (8, 8, 0),
+])
+def test_matches_einsum_reference(heads, kv_heads, cache_len):
+    b, max_len, d = 2, 512, 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(b, 1, heads, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, kv_heads, max_len, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, kv_heads, max_len, d)), jnp.float32)
+
+    want = decode_attention(q, k, v, jnp.int32(cache_len))  # einsum path
+    got = flash_decode(q[:, 0], k, v, jnp.int32(cache_len) + 1,
+                       interpret=True)[:, None]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_matches_fp32_reference():
+    b, heads, kv_heads, max_len, d = 1, 8, 4, 1024, 128
+    rng = np.random.default_rng(1)
+    q = rng.normal(size=(b, 1, heads, d)).astype(np.float32)
+    k = rng.normal(size=(b, kv_heads, max_len, d)).astype(np.float32)
+    v = rng.normal(size=(b, kv_heads, max_len, d)).astype(np.float32)
+    want = decode_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                            jnp.int32(700))
+    got = flash_decode(jnp.asarray(q[:, 0], jnp.bfloat16),
+                       jnp.asarray(k, jnp.bfloat16),
+                       jnp.asarray(v, jnp.bfloat16),
+                       jnp.int32(701), interpret=True)[:, None]
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=0.05, atol=0.05)
