@@ -43,6 +43,18 @@ def _model_flops_per_token(cfg, seq_len: int) -> float:
     return 3.0 * fwd  # fwd + bwd
 
 
+def chip_peak_flops(device_kind: str) -> float:
+    """bf16 peak FLOP/s per chip for MFU normalization (also used by the
+    tests_tpu MFU regression guard)."""
+    peaks = {
+        "v5 lite": 197e12, "v5e": 197e12,
+        "v5p": 459e12, "v5": 459e12,
+        "v4": 275e12, "v6e": 918e12, "v6 lite": 918e12,
+    }
+    kind = device_kind.lower().replace("tpu ", "")
+    return next((v for k, v in peaks.items() if k in kind), 197e12)
+
+
 def _bench_model(seq: int, recompute: str):
     from megatron_llm_tpu.config import llama2_config
 
@@ -164,13 +176,7 @@ def main() -> None:
     import jax
 
     platform = jax.devices()[0].device_kind
-    peaks = {  # bf16 peak FLOP/s per chip
-        "v5 lite": 197e12, "v5e": 197e12,
-        "v5p": 459e12, "v5": 459e12,
-        "v4": 275e12, "v6e": 918e12, "v6 lite": 918e12,
-    }
-    kind = platform.lower().replace("tpu ", "")
-    peak = next((v for k, v in peaks.items() if k in kind), 197e12)
+    peak = chip_peak_flops(platform)
 
     # Headline: seq 1024 (the reference's finetune config), measured
     # single-chip sweet spot mb=12, selective recompute.

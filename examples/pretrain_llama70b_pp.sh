@@ -1,0 +1,20 @@
+#!/bin/bash
+# Llama-2-70B on a v5p-256 pod slice: tp=8 x pp=8 x dp=4 — BASELINE.md
+# config 5.  The pipelined schedule streams microbatches (embed at stage 0,
+# CE head at the last stage inside the tick loop); docs/pipeline_memory.md
+# gives the per-chip memory budget for this exact configuration (~14.5 GB
+# of 95 GB HBM with full remat + ZeRO-1).
+set -euo pipefail
+
+python finetune.py \
+    --model llama2 --model_size 70b \
+    --load "${CKPT:-ckpts/llama2-70b}" --save ckpts/run70b \
+    --data_path "$1" \
+    --tokenizer_type sentencepiece --tokenizer_model "$2" \
+    --tp 8 --pp 8 --dp 4 --virtual_pipeline_stages 2 \
+    --sequence_parallel --use_distributed_optimizer \
+    --params_dtype bfloat16 --attention_impl flash --recompute full \
+    --micro_batch_size 1 --global_batch_size 512 \
+    --seq_length 4096 --train_iters 1000 \
+    --lr 1.5e-5 --lr_decay_style cosine --lr_warmup_iters 100 \
+    --clip_grad 1.0 --log_interval 5

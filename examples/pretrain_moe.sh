@@ -1,0 +1,18 @@
+#!/bin/bash
+# Mixture-of-experts pretraining (capability beyond the reference fork):
+# 8 experts sharded over ep=4, top-2 token-choice routing with capacity.
+# Watch "moe dropped frac" / "moe load imbalance" in the training log to
+# tune --moe_capacity_factor (dispatch memory is E-independent; see
+# models/moe.py docstring).
+set -euo pipefail
+
+python finetune.py \
+    --model llama2 --model_size 7b \
+    --data_path "$1" \
+    --tokenizer_type sentencepiece --tokenizer_model "$2" \
+    --num_experts 8 --moe_top_k 2 --moe_capacity_factor 1.25 \
+    --ep 4 --dp 2 --use_distributed_optimizer \
+    --params_dtype bfloat16 --attention_impl flash --recompute selective \
+    --micro_batch_size 2 --global_batch_size 256 \
+    --seq_length 2048 --train_iters 1000 \
+    --lr 3e-5 --log_interval 10

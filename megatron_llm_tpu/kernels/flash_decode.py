@@ -91,8 +91,8 @@ def flash_decode(
     assert block_k >= 128, (max_len, block_k)
     nk = max_len // block_k
 
-    # [b, kv, g, d] rows, padded up to the 8-sublane tile
-    g_pad = max(8, group)
+    # [b, kv, g, d] rows, padded up to a multiple of the 8-sublane tile
+    g_pad = max(8, -(-group // 8) * 8)
     qg = q.reshape(b, kv_heads, group, d)
     if g_pad != group:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - group), (0, 0)))
