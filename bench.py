@@ -172,6 +172,22 @@ def _decode_point():
     return b * gen_len / dt
 
 
+def _retry(fn, *args):
+    """One retry: the axon-tunneled compile service occasionally throws a
+    transient remote-compile error; a failed point must not sink the whole
+    benchmark the driver records."""
+    try:
+        return fn(*args)
+    except Exception as e:  # noqa: BLE001 — deliberate broad retry
+        print(f"# bench point failed ({type(e).__name__}); retrying once",
+              flush=True)
+        import jax
+
+        jax.clear_caches()
+        time.sleep(5)
+        return fn(*args)
+
+
 def main() -> None:
     import jax
 
@@ -180,7 +196,8 @@ def main() -> None:
 
     # Headline: seq 1024 (the reference's finetune config), measured
     # single-chip sweet spot mb=12, selective recompute.
-    tps, mfu, loss, n_params = _train_point(1024, 12, "selective", 20, peak)
+    tps, mfu, loss, n_params = _retry(
+        _train_point, 1024, 12, "selective", 20, peak)
 
     # MFU-vs-seq curve (BASELINE config 4 regime at 32k): selective remat
     # while it fits, full remat beyond 8k.
@@ -190,11 +207,11 @@ def main() -> None:
                                (8192, 1, "selective", 10),
                                (16384, 1, "full", 5),
                                (32768, 1, "full", 5)):
-        c_tps, c_mfu, _, _ = _train_point(seq, mb, rc, iters, peak)
+        c_tps, c_mfu, _, _ = _retry(_train_point, seq, mb, rc, iters, peak)
         curve.append({"seq_length": seq, "mfu": round(c_mfu, 4),
                       "tokens_per_sec": round(c_tps, 1)})
 
-    decode_tps = _decode_point()
+    decode_tps = _retry(_decode_point)
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     print(json.dumps({

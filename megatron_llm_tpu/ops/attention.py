@@ -76,13 +76,14 @@ def decode_attention(
 
     if (s == 1 and d % 128 == 0 and max_len % 128 == 0
             and jax.devices()[0].platform == "tpu"
-            and len(jax.devices()) == 1):
+            and not _mesh_active()):
         # single-token decode: the Pallas kernel streams the cache through
         # VMEM at near-HBM bandwidth where the XLA lowering runs a kLoop
-        # multiply-reduce fusion at a few percent of it.  Single-device
-        # only: under tp-sharded serving GSPMD has no partitioning rule
-        # for the pallas_call over a kv-head-sharded cache, so multi-device
-        # processes stay on the (correctly partitioned) einsum path.
+        # multiply-reduce fusion at a few percent of it.  Unsharded only:
+        # under tp-sharded serving (which this stack always runs inside a
+        # mesh context) GSPMD has no partitioning rule for the pallas_call
+        # over a kv-head-sharded cache, so mesh-active traces stay on the
+        # (correctly partitioned) einsum path.
         from ..kernels.flash_decode import flash_decode
 
         out = flash_decode(q[:, 0], k_cache, v_cache, cache_len + 1,

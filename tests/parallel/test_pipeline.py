@@ -223,7 +223,12 @@ def test_pipeline_with_flash_kernel_matches_reference():
     params = model_lib.init_params(jax.random.key(2), cfg)
     batch = _batch(cfg, M, mb=2, seed=9)
 
-    ref_loss = _reference_loss(cfg, params, batch)
+    # reference runs DOT attention so the kernel's numerics are actually
+    # under test, not cancelled out
+    import dataclasses
+
+    ref_loss = _reference_loss(
+        dataclasses.replace(cfg, attention_impl="dot"), params, batch)
 
     p_params = pipe.to_pipeline_params(params, parallel)
     specs = shard_lib.param_specs(cfg, parallel)

@@ -160,10 +160,16 @@ def test_training_mfu_floor():
 
     sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
     import jax
+    import pytest
 
     from bench import _train_point, chip_peak_flops
 
-    peak = chip_peak_flops(jax.devices()[0].device_kind)
+    kind = jax.devices()[0].device_kind
+    if "v5 lite" not in kind.lower() and "v5e" not in kind.lower():
+        # the 0.45 floor (and the mb=12 shape) is calibrated on v5e; a
+        # faster chip would fail spuriously without retuning
+        pytest.skip(f"MFU floor calibrated for v5e, running on {kind}")
+    peak = chip_peak_flops(kind)
     tps, mfu, loss, _ = _train_point(1024, 12, "selective", 10, peak)
     assert mfu >= 0.45, (mfu, tps)
     assert loss < 12.0, loss
