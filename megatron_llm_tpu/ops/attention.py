@@ -28,6 +28,93 @@ import numpy as np
 _flash_fallback_warned = False
 
 
+def _backend() -> str:
+    """Trace-time platform name.  Indirection point so CPU tests can
+    monkeypatch it and drive the TPU-only decode branches (the Pallas
+    kernel itself runs in interpret mode off-TPU)."""
+    return jax.default_backend()
+
+
+def decode_kernel_eligible(s: int, d: int, max_len: int,
+                           platform: str) -> bool:
+    """Pure shape/platform predicate for the Pallas decode fast path.
+
+    Factored out of ``decode_attention`` so both branches are reachable
+    from CPU unit tests: round 2 shipped an inline guard whose TPU-only
+    arm was untestable off-hardware and hid an undefined symbol.
+    """
+    return (s == 1 and d % 128 == 0 and max_len % 128 == 0
+            and platform == "tpu")
+
+
+def _active_mesh():
+    """The mesh the current trace runs under, or None.
+
+    Checks jax's abstract-mesh context (``jax.sharding.use_mesh`` scope,
+    also set when tracing shard_map bodies) first, then this package's own
+    ``parallel.mesh.use_mesh`` stack (the training driver / generation
+    entry points use the latter)."""
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is not None and not ctx.empty:
+        return ctx
+    from ..parallel import mesh as mesh_lib
+
+    return mesh_lib.current_mesh()
+
+
+def _mesh_active() -> bool:
+    return _active_mesh() is not None
+
+
+def _kernel_decode(q, k_cache, v_cache, cache_len, softmax_scale):
+    """The single call site of the Pallas decode kernel: [b,1,h,d] in/out."""
+    from ..kernels.flash_decode import flash_decode
+
+    out = flash_decode(q[:, 0], k_cache, v_cache, cache_len + 1,
+                       softmax_scale=softmax_scale)
+    return out[:, None]
+
+
+def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
+                          mesh):
+    """Run the Pallas decode kernel under an active mesh, or return None.
+
+    GSPMD has no partitioning rule for the ``pallas_call`` over a
+    kv-head-sharded cache, so the kernel is wrapped in a ``shard_map``
+    manual over the tensor axis only (heads/kv-heads are tp-sharded per
+    models/sharding.py; batch/dp and the rest stay GSPMD-managed — the
+    partial-manual pattern of parallel/ring_attention.py).  Returns None
+    when the head counts don't divide tp (MQA keeps K/V replicated and
+    the einsum path is already correct there) — the caller falls back.
+    """
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import TENSOR_AXIS
+
+    if TENSOR_AXIS not in mesh.axis_names:
+        return None
+    if TENSOR_AXIS in getattr(mesh, "manual_axes", ()):
+        # already inside a manual-tp shard_map: shapes are per-shard and
+        # the pallas_call sees local arrays — call straight through.
+        return _kernel_decode(q, k_cache, v_cache, cache_len, softmax_scale)
+    tp = mesh.shape[TENSOR_AXIS]
+    n_heads, kv_heads = q.shape[2], k_cache.shape[1]
+    if tp > 1 and (n_heads % tp or kv_heads % tp):
+        return None
+
+    wrapped = jax.shard_map(
+        lambda q_, kc, vc, ln: _kernel_decode(q_, kc, vc, ln, softmax_scale),
+        mesh=mesh,
+        in_specs=(P(None, None, TENSOR_AXIS, None),
+                  P(None, TENSOR_AXIS, None, None),
+                  P(None, TENSOR_AXIS, None, None),
+                  P()),
+        out_specs=P(None, None, TENSOR_AXIS, None),
+        axis_names={TENSOR_AXIS},
+        check_vma=False,
+    )
+    return wrapped(q, k_cache, v_cache, jnp.asarray(cache_len, jnp.int32))
+
+
 def _warn_flash_fallback():
     global _flash_fallback_warned
     if not _flash_fallback_warned:
@@ -74,21 +161,21 @@ def decode_attention(
     if softmax_scale is None:
         softmax_scale = 1.0 / float(np.sqrt(d))
 
-    if (s == 1 and d % 128 == 0 and max_len % 128 == 0
-            and jax.devices()[0].platform == "tpu"
-            and not _mesh_active()):
+    if decode_kernel_eligible(s, d, max_len, _backend()):
         # single-token decode: the Pallas kernel streams the cache through
         # VMEM at near-HBM bandwidth where the XLA lowering runs a kLoop
-        # multiply-reduce fusion at a few percent of it.  Unsharded only:
-        # under tp-sharded serving (which this stack always runs inside a
-        # mesh context) GSPMD has no partitioning rule for the pallas_call
-        # over a kv-head-sharded cache, so mesh-active traces stay on the
-        # (correctly partitioned) einsum path.
-        from ..kernels.flash_decode import flash_decode
-
-        out = flash_decode(q[:, 0], k_cache, v_cache, cache_len + 1,
-                           softmax_scale=softmax_scale)
-        return out[:, None]
+        # multiply-reduce fusion at a few percent of it.  Under an active
+        # mesh the kernel runs inside a shard_map manual over the tp axis
+        # (kv-head-sharded cache); only un-divisible head counts fall back
+        # to the einsum path.
+        mesh = _active_mesh()
+        if mesh is None:
+            return _kernel_decode(q, k_cache, v_cache, cache_len,
+                                  softmax_scale)
+        out = _sharded_flash_decode(q, k_cache, v_cache, cache_len,
+                                    softmax_scale, mesh)
+        if out is not None:
+            return out
 
     # [b, kv, group·s, d]: fold the GQA group and the (tiny) new-token dim
     # into the GEMV row dim
