@@ -249,6 +249,14 @@ class ParallelConfig:
     context_parallel_layout: str = "contiguous"
     # number of microbatches for pipeline / grad accumulation
     num_microbatches: int = 1
+    # windowed rematerialization of the pipeline tick loop: 0 = off (every
+    # tick's boundary tensor is saved for backward — fine up to M≈16); W>0
+    # checkpoints the scan in windows of W ticks, bounding saved boundaries
+    # at ceil(T/W) + 2·W instead of 2·T.  This is the large-M (grad-accum
+    # M≥64) memory bound the reference gets from ≤pp in-flight 1F1B
+    # (megatron/schedules.py:606-722), at ~+25% FLOPs when on.  vpp=1 only
+    # (the interleaved circular buffer would be re-saved per window).
+    pipeline_remat_window: int = 0
     # ZeRO-1: shard optimizer state over dp
     # (reference: megatron/optimizer/distrib_optimizer.py)
     use_distributed_optimizer: bool = False
@@ -272,6 +280,12 @@ class ParallelConfig:
         assert self.context_parallel_layout in ("contiguous", "zigzag"), (
             f"unknown context_parallel_layout "
             f"{self.context_parallel_layout!r}")
+        if self.pipeline_remat_window:
+            assert self.pipeline_remat_window > 0
+            assert self.virtual_pipeline_stages == 1, (
+                "pipeline_remat_window requires vpp == 1: the interleaved "
+                "circular buffer is part of the scan carry and would be "
+                "re-saved at every window boundary, inflating memory")
         return self
 
 

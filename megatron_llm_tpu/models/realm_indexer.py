@@ -167,12 +167,12 @@ class IndexBuilder:
             return self.store
         self.store.save_shard(self.rank)
         if self.world > 1:
-            try:
-                from jax.experimental import multihost_utils
+            # Merging before every host has finished writing its shard
+            # would silently produce a partial index — a failed barrier in
+            # a world>1 build must abort, not be swallowed.
+            from jax.experimental import multihost_utils
 
-                multihost_utils.sync_global_devices("realm_index_shards")
-            except Exception:
-                pass
+            multihost_utils.sync_global_devices("realm_index_shards")
         if self.rank == 0:
             self.store.merge_shards_and_save()
         return self.store

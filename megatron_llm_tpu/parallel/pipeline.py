@@ -43,7 +43,11 @@ bounds in-flight microbatches at ≤pp (schedules.py:606-722); the streamed
 scan holds M·vpp boundary tensors instead, which at BASELINE config-5 shapes
 (70B, s=4096, mb=1, pp=8, M=16) is ~1.5 GB bf16 per device — small next to
 params+opt state, and the price of getting the backward schedule for free
-from ``jax.grad``.
+from ``jax.grad``.  At grad-accum counts M ≥ 64 the O(T) term stops being
+small; ``ParallelConfig.pipeline_remat_window`` = W checkpoints the tick
+loop in windows of W, restoring an O(T/W + W·lpc) bound (the large-M
+equivalent of the reference's ≤pp in-flight rule) for one extra forward
+replay per window.
 
 Layer→stage assignment matches the reference (megatron/model/
 transformer.py:1015-1060): chunk v on stage s holds global layers
@@ -187,6 +191,7 @@ def pipeline_activation_bytes(
     mb: int,
     seq_shard: int,
     recompute: str = "full",
+    window: int = 0,
 ) -> dict:
     """Estimated per-device activation memory of one pipelined train step.
 
@@ -199,10 +204,14 @@ def pipeline_activation_bytes(
 
     - ``boundary``: the scan saves each tick's input and output boundary
       tensor [mb, seq_shard, h] for the backward replay → 2·T·mb·s·h·B.
+      With ``window`` W > 0 (vpp=1) only the ceil(T/W) window-entry carries
+      plus one in-flight window's 2·W tick boundaries are live.
     - ``layer_residuals``: per-tick per-layer saved values, governed by the
       remat policy: 'full' saves only each layer's checkpoint input (c=1),
       'selective' keeps a few mlp/attn boundaries (c≈4), 'none' keeps all
-      internals (c≈4 + 3·ffn/h, GLU counted).
+      internals (c≈4 + 3·ffn/h, GLU counted).  Windowed: only one window's
+      W ticks hold residuals at a time (they exist during that window's
+      backward replay, not across the whole schedule).
     - ``circ``: the vpp>1 circular re-entry buffer, M·mb·s·h·B.
     - ``head``: transient fp32 logits blocks, ≈3·mb·s·V·4 (fwd value,
       softmax, dlogits — the head is checkpointed so these never stack
@@ -217,11 +226,16 @@ def pipeline_activation_bytes(
     v = cfg.padded_vocab_size()
 
     per_boundary = mb * seq_shard * h * B
-    boundary = 2 * T * per_boundary
     c = {"full": 1.0,
          "selective": 4.0,
          "none": 4.0 + 3.0 * cfg.ffn_size / h}[recompute]
-    layer_residuals = int(T * lpc * c * per_boundary)
+    if window and window > 0 and vpp == 1 and T > window:
+        n_win = -(-T // window)
+        boundary = (n_win + 2 * window) * per_boundary
+        layer_residuals = int(window * lpc * c * per_boundary)
+    else:
+        boundary = 2 * T * per_boundary
+        layer_residuals = int(T * lpc * c * per_boundary)
     circ = (M * per_boundary) if vpp > 1 else 0
     head = 3 * mb * seq_shard * v * 4
     io_grads = 2 * v * h * 4
@@ -431,8 +445,10 @@ def pipeline_loss(
 
             # Streamed head: the microbatch finishing at tick t (last
             # chunk, last stage) goes through norm→unembed→CE right here.
+            # The upper bound matters for the windowed schedule's padding
+            # ticks (t ≥ T), which must not re-count microbatch M-1.
             out_idx = t - (vpp - 1) * M - (pp - 1)
-            head_valid = (out_idx >= 0) & (stage == pp - 1)
+            head_valid = (out_idx >= 0) & (out_idx < M) & (stage == pp - 1)
             w_idx = jnp.clip(out_idx, 0, M - 1)
             lab_m = jax.lax.dynamic_index_in_dim(labels, w_idx, 0,
                                                  keepdims=False)
@@ -479,8 +495,29 @@ def pipeline_loss(
         init = (jnp.zeros(mb_shape, compute_dtype), circ,
                 aux0, jnp.zeros((), jnp.float32),
                 stats0)
-        (_, _, aux_sum, loss_sum, stats), _ = jax.lax.scan(
-            tick, init, jnp.arange(T))
+        W = parallel.pipeline_remat_window
+        if W and W > 0 and vpp == 1 and T > W:
+            # Windowed rematerialization: the plain scan saves every tick's
+            # boundary in/out for the backward replay (2·T tensors); at
+            # grad-accum counts M ≥ 64 that dwarfs the reference's ≤pp
+            # in-flight 1F1B bound (schedules.py:606-722).  Checkpointing
+            # windows of W ticks keeps only ceil(T/W) window carries plus
+            # one window's residuals live — memory ~O(T/W + W), at the cost
+            # of one extra forward replay per window in backward.  Padding
+            # ticks (t ≥ T) are no-ops: every update in `tick` is masked by
+            # tick_valid / head_valid / c_valid, all false there.
+            n_win = -(-T // W)
+            ticks = jnp.arange(n_win * W).reshape(n_win, W)
+
+            def window_body(carry, ts):
+                carry, _ = jax.lax.scan(tick, carry, ts)
+                return carry, None
+
+            (_, _, aux_sum, loss_sum, stats), _ = jax.lax.scan(
+                jax.checkpoint(window_body, prevent_cse=False), init, ticks)
+        else:
+            (_, _, aux_sum, loss_sum, stats), _ = jax.lax.scan(
+                tick, init, jnp.arange(T))
 
         # Only the last stage accumulated real losses; the psums make the
         # scalars (and the small [M, mb, s] eval stats) pp-invariant.  All

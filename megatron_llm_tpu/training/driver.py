@@ -454,7 +454,28 @@ def _build_train_iterator(cfg: RuntimeConfig, dataset, consumed_samples: int,
         seed=cfg.train.seed,
         eod_token=eod_token,
     )
-    return iter(it)
+
+    def checked():
+        """Validate the first batch's token range once: out-of-vocab ids
+        don't crash XLA gathers the way they assert on CUDA — they yield a
+        silent NaN loss with finite-looking grad norms, which costs users
+        hours to trace back to the corpus/tokenizer mismatch."""
+        vocab = cfg.model.vocab_size
+        first = True
+        for batch in it:
+            if first:
+                first = False
+                hi = int(batch["tokens"].max())
+                lo = int(batch["tokens"].min())
+                if hi >= vocab or lo < 0:
+                    raise ValueError(
+                        f"dataset token ids span [{lo}, {hi}] but "
+                        f"model vocab_size is {vocab}: the corpus was "
+                        f"tokenized with a different vocabulary than the "
+                        f"model config (this would train to a NaN loss)")
+            yield batch
+
+    return checked()
 
 
 def pretrain(

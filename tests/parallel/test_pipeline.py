@@ -246,3 +246,55 @@ def test_pipeline_with_flash_kernel_matches_reference():
     # flash runs fp32 inside; interpret-mode kernel vs einsum ≈ 1e-5
     np.testing.assert_allclose(np.asarray(pl_loss), np.asarray(ref_loss),
                                rtol=5e-5, atol=5e-5)
+
+
+@pytest.mark.parametrize("M,W", [(6, 3), (5, 2)])  # even and ragged windows
+def test_windowed_remat_matches_unwindowed(M, W):
+    """pipeline_remat_window must change memory, not math: loss and every
+    grad identical to the plain schedule (incl. ragged T % W padding
+    ticks, which must be true no-ops)."""
+    pp = 2
+    cfg = _cfg(num_layers=4)
+    base = ParallelConfig(pipeline_parallel=pp, num_microbatches=M)
+    windowed = ParallelConfig(pipeline_parallel=pp, num_microbatches=M,
+                              pipeline_remat_window=W).validate()
+    mesh = mesh_lib.build_mesh(base)
+
+    params = model_lib.init_params(jax.random.key(3), cfg)
+    batch = _batch(cfg, M, mb=2, seed=11)
+    p_params = pipe.to_pipeline_params(params, base)
+    specs = shard_lib.param_specs(cfg, base)
+    p_specs = pipe.pipeline_param_specs(specs, base)
+    p_params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        p_params, p_specs, is_leaf=lambda v: isinstance(v, P))
+
+    def runtime(par):
+        return RuntimeConfig(model=cfg, parallel=par,
+                             optimizer=OptimizerConfig(),
+                             train=TrainConfig(seq_length=cfg.seq_length))
+
+    with mesh_lib.use_mesh(mesh):
+        loss_plain, grads_plain = jax.jit(jax.value_and_grad(
+            lambda p: pipe.pipeline_loss(runtime(base), p, batch, mesh=mesh)
+        ))(p_params)
+        loss_win, grads_win = jax.jit(jax.value_and_grad(
+            lambda p: pipe.pipeline_loss(runtime(windowed), p, batch,
+                                         mesh=mesh)
+        ))(p_params)
+
+    np.testing.assert_allclose(np.asarray(loss_win), np.asarray(loss_plain),
+                               rtol=1e-6, atol=1e-6)
+    for (path, a), (_, b) in zip(
+        jax.tree.leaves_with_path(grads_plain),
+        jax.tree.leaves_with_path(grads_win),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-5, atol=1e-6,
+            err_msg=f"windowed grad mismatch at {jax.tree_util.keystr(path)}")
+
+
+def test_window_requires_vpp1():
+    with pytest.raises(AssertionError):
+        ParallelConfig(pipeline_parallel=2, virtual_pipeline_stages=2,
+                       pipeline_remat_window=4).validate()

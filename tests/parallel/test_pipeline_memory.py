@@ -125,3 +125,46 @@ def test_memory_scales_with_T_not_quadratically():
     # T(16)/T(8) = 19/11 ≈ 1.73; allow fixed costs + XLA slop but rule out
     # anything superlinear in M (old design: 3 buffers × M + residuals × T)
     assert m16 / m8 < 2.5, (m8, m16)
+
+
+def test_windowed_remat_bounds_memory_at_large_M():
+    """BASELINE config-5 grad-accum regime (M=64): the windowed schedule
+    must cut measured temp memory vs the plain scan and stay within its
+    own analytic bound — the ≤pp-in-flight property the reference gets
+    from 1F1B interleaving (megatron/schedules.py:606-722)."""
+    pp, mb, M, W = 8, 1, 64, 8
+    cfg = tiny_config(
+        num_layers=pp * 2,
+        hidden_size=128,
+        num_attention_heads=4,
+        ffn_hidden_size=256,
+        params_dtype="float32",
+        recompute="full",
+        seq_length=512,
+        max_position_embeddings=512,
+        vocab_size=1024,
+    )
+
+    def measure(window):
+        parallel = ParallelConfig(pipeline_parallel=pp, num_microbatches=M,
+                                  pipeline_remat_window=window).validate()
+        runtime = RuntimeConfig(model=cfg, parallel=parallel,
+                                optimizer=OptimizerConfig(),
+                                train=TrainConfig(seq_length=cfg.seq_length))
+        mesh = mesh_lib.build_mesh(parallel)
+        return _measure_temp_bytes(cfg, runtime, parallel, mesh, M, mb)
+
+    plain = measure(0)
+    windowed = measure(W)
+    assert windowed < 0.6 * plain, (plain, windowed)
+
+    model = pipe.pipeline_activation_bytes(
+        cfg, pp=pp, vpp=1, M=M, mb=mb, seq_shard=cfg.seq_length,
+        recompute="full", window=W)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    param_bytes = 2 * 4 * sum(p.size for p in jax.tree.leaves(params)) / pp
+    bound = model["upper_bound"] + param_bytes * 4
+    assert windowed <= bound, (
+        f"windowed temp {windowed/2**20:.1f} MiB exceeds bound "
+        f"{bound/2**20:.1f} MiB "
+        f"({ {k: round(v/2**20, 2) for k, v in model.items()} })")

@@ -1,0 +1,101 @@
+"""bench.py partial-failure behavior: any single point crashing (even with
+a deterministic error) must still yield one parsed JSON record.
+
+Round 2's bench measured the whole train curve, then lost it when the
+decode point crashed before the single end-of-run print; deterministic
+errors were also retried as if transient.  These tests pin the fixed
+orchestration, with the heavy measurement functions stubbed out.
+"""
+
+import json
+import io
+import contextlib
+
+import pytest
+
+import bench
+
+
+def _run_main(monkeypatch, train_fn, decode_fn):
+    monkeypatch.setattr(bench, "_train_point", train_fn)
+    monkeypatch.setattr(bench, "_decode_point", decode_fn)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    lines = [l for l in buf.getvalue().splitlines() if not l.startswith("#")]
+    assert len(lines) == 1, lines
+    return json.loads(lines[0])
+
+
+def _ok_train(seq, mb, rc, iters, peak):
+    return 1000.0 * 1024 / seq, 0.5, 2.0, 123456
+
+
+def test_all_points_ok(monkeypatch):
+    rec = _run_main(monkeypatch, _ok_train, lambda: 2000.0)
+    assert rec["metric"] == "mfu" and rec["value"] == 0.5
+    assert rec["decode_tokens_per_sec"] == 2000.0
+    assert len(rec["mfu_vs_seq"]) == 5
+
+
+def test_decode_crash_keeps_headline(monkeypatch):
+    def bad_decode():
+        raise NameError("boom")  # the round-2 failure class
+
+    rec = _run_main(monkeypatch, _ok_train, bad_decode)
+    assert rec["value"] == 0.5 and rec["vs_baseline"] is not None
+    assert rec["decode_tokens_per_sec"] is None
+    assert len(rec["mfu_vs_seq"]) == 5
+
+
+def test_one_curve_point_crash_keeps_rest(monkeypatch):
+    def train(seq, mb, rc, iters, peak):
+        if seq == 16384:
+            raise TypeError("deterministic bug at one seq")
+        return _ok_train(seq, mb, rc, iters, peak)
+
+    rec = _run_main(monkeypatch, train, lambda: 2000.0)
+    assert rec["value"] == 0.5
+    seqs = [p["seq_length"] for p in rec["mfu_vs_seq"]]
+    assert 16384 not in seqs and 32768 in seqs
+
+
+def test_headline_crash_uses_fallback_then_partial(monkeypatch):
+    calls = []
+
+    def train(seq, mb, rc, iters, peak):
+        calls.append((seq, mb))
+        raise ValueError("always fails")
+
+    rec = _run_main(monkeypatch, train, lambda: 2000.0)
+    # primary + fallback headline attempted, then every curve point
+    assert (1024, 12) in calls and (1024, 8) in calls
+    assert rec["value"] is None and rec["mfu_vs_seq"] == []
+    assert rec["decode_tokens_per_sec"] == 2000.0
+
+
+def test_deterministic_error_not_retried(monkeypatch):
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise NameError("not transient")
+
+    with pytest.raises(NameError):
+        bench._retry(bad)
+    assert len(calls) == 1
+
+
+def test_transient_error_retried(monkeypatch):
+    import jax
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise jax.errors.JaxRuntimeError("transient compile blip")
+        return "ok"
+
+    assert bench._retry(flaky) == "ok"
+    assert len(calls) == 2
