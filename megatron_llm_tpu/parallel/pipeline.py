@@ -339,10 +339,32 @@ def pipeline_loss(
     sp_axes = ((model_cfg.sequence_parallel_axis,)
                if model_cfg.sequence_parallel_axis else ())
 
+    # dp is manual too (microbatch dim sharded explicitly): a dp-sharded
+    # batch argument entering a pp-manual shard_map as an *auto*-axis
+    # operand trips an XLA SPMD-partitioner grouping CHECK
+    # (spmd_partitioner_util.cc) at dp×pp×tp — and explicit dp also makes
+    # the DP loss/grad reduction visible, mirroring the reference's DDP
+    # all-reduce (megatron/model/distributed.py:202).  Param cotangents
+    # psum over dp through the shard_map transpose (params enter
+    # dp-replicated), exactly as they already do for cp.
+    dp_axis = (mesh_lib.DATA_AXIS
+               if (mesh_lib.DATA_AXIS in mesh.axis_names
+                   and dict(mesh.shape).get(mesh_lib.DATA_AXIS, 1) > 1)
+               else None)
+
     def pipelined(chunks, io_p, tokens, labels, loss_mask, pos_mb, seg_mb):
         # chunks: [vpp, 1, lpc, ...] (pp axis manual) → squeeze stage dim
         chunks_local = jax.tree.map(lambda c: c[:, 0], chunks)
         stage = jax.lax.axis_index(PP)
+
+        embed_rng_l, stack_rng_l = embed_rng, stack_rng
+        if dp_axis is not None and stack_rng_l is not None:
+            # distinct dropout streams per dp shard (auto-dp got this from
+            # GSPMD sharding one global mask; manual-dp must fold the
+            # shard index)
+            dpi = jax.lax.axis_index(dp_axis)
+            embed_rng_l = jax.random.fold_in(embed_rng_l, dpi)
+            stack_rng_l = jax.random.fold_in(stack_rng_l, dpi)
 
         mb_shape = tokens.shape[1:] + (model_cfg.hidden_size,)
         circ = (jnp.zeros((M,) + mb_shape, compute_dtype)
@@ -353,7 +375,10 @@ def pipeline_loss(
                       jnp.zeros(tokens.shape, jnp.float32))   # argmax correct
 
         def cp_sum(x):
-            return jax.lax.psum(x, cp_axis) if cp_axis is not None else x
+            """Token-space sums must span every manual axis that shards
+            tokens: cp (seq) and dp (batch)."""
+            axes = tuple(a for a in (cp_axis, dp_axis) if a is not None)
+            return jax.lax.psum(x, axes) if axes else x
 
         def head_fn(h, lab, msk):
             """Final norm → unembed → CE on one finished microbatch.
@@ -399,8 +424,8 @@ def pipeline_loss(
             pos_in = (None if pos_mb is None else
                       jax.lax.dynamic_index_in_dim(pos_mb, t_in, 0,
                                                    keepdims=False))
-            er = (None if embed_rng is None
-                  else jax.random.fold_in(embed_rng, t_in))
+            er = (None if embed_rng_l is None
+                  else jax.random.fold_in(embed_rng_l, t_in))
             fresh = model_lib.embed(
                 model_cfg, {"embedding": cast(io_p["embedding"])},
                 tok, pos_in, None, er, deterministic,
@@ -414,10 +439,10 @@ def pipeline_loss(
             current = jnp.where(stage == 0, inp, state)
 
             tick_rng = None
-            if stack_rng is not None:
+            if stack_rng_l is not None:
                 # unique stream per (microbatch, ring position)
                 tick_rng = jax.random.fold_in(
-                    jax.random.fold_in(stack_rng, m_idx),
+                    jax.random.fold_in(stack_rng_l, m_idx),
                     chunk_idx * pp + stage)
 
             sel_side = AttnSideInputs(
@@ -525,25 +550,31 @@ def pipeline_loss(
         # bf16 all-reduces to a form that crashes XLA:CPU's
         # AllReducePromotion pass (jax 0.9.0), and the streamed design only
         # ever reduces fp32 scalars/stats anyway.
+        # mb losses are already cp/dp-global (cp_sum in head_fn), so only
+        # the pp-sum remains; it makes the scalar identical on all shards.
         loss_total = jax.lax.psum(loss_sum, PP)
         # Each (stage, chunk) processed every microbatch exactly once, so
         # the pp-sum of the local aux sums covers all L layers × M
-        # microbatches; cp shards see equal token slices → mean over cp.
+        # microbatches; cp/dp shards see equal token counts → mean over
+        # those axes.
         aux = jax.lax.psum(aux_sum, PP)
-        if cp_axis is not None:
-            aux = jax.lax.pmean(aux, cp_axis)
+        for ax in (cp_axis, dp_axis):
+            if ax is not None:
+                aux = jax.lax.pmean(aux, ax)
         if stats is not None:
             stats = tuple(jax.lax.psum(b, PP) for b in stats)
         return loss_total, aux, stats
 
     layer_in_specs = jax.tree.map(lambda _: P(None, PP), params["layers"])
+    manual_axes = {PP}
+    if dp_axis is not None:
+        manual_axes.add(dp_axis)
     if cp_axis is not None:
-        manual_axes = {PP, cp_axis}
-        side_spec = P(None, None, cp_axis)  # [M, mb, s]
+        manual_axes.add(cp_axis)
+        side_spec = P(None, dp_axis, cp_axis)  # [M, mb, s]
         assert position_ids is not None
     else:
-        manual_axes = {PP}
-        side_spec = P()
+        side_spec = P(None, dp_axis) if dp_axis is not None else P()
     stats_spec = (side_spec, side_spec) if return_stats else None
     fn = jax.shard_map(
         pipelined,

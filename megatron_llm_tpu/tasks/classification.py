@@ -175,6 +175,11 @@ def main(argv: Optional[list] = None) -> dict:
     from ..training.driver import pretrain_custom
 
     p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--task", default="generic",
+                   choices=["generic", "mnli", "qqp"],
+                   help="generic = header TSV/JSONL; mnli/qqp parse the "
+                        "GLUE distributions' shipped formats "
+                        "(tasks/glue.py)")
     p.add_argument("--train_data", required=True)
     p.add_argument("--valid_data", required=True)
     p.add_argument("--tokenizer_model", default="bert-base-uncased")
@@ -207,12 +212,21 @@ def main(argv: Optional[list] = None) -> dict:
         tie_embed_logits=True, tokentype_size=2,
         seq_length=args.seq_length,
     )
-    train_rows = load_rows(args.train_data)
+    if args.task == "generic":
+        train_rows, valid_rows = (load_rows(args.train_data),
+                                  load_rows(args.valid_data))
+        label_map = None
+    else:
+        from .glue import load_glue_rows
+
+        train_rows, label_map = load_glue_rows(args.task, args.train_data)
+        valid_rows, _ = load_glue_rows(args.task, args.valid_data)
     train_ds = ClassificationDataset(
         train_rows, tok, args.seq_length,
-        inner.cls_token_id, inner.sep_token_id, inner.pad_token_id or 0)
+        inner.cls_token_id, inner.sep_token_id, inner.pad_token_id or 0,
+        label_map=label_map)
     valid_ds = ClassificationDataset(
-        load_rows(args.valid_data), tok, args.seq_length,
+        valid_rows, tok, args.seq_length,
         inner.cls_token_id, inner.sep_token_id, inner.pad_token_id or 0,
         label_map=train_ds.label_map)
 

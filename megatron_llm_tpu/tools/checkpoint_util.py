@@ -8,6 +8,9 @@ jobs are format/dtype conversion:
 
   hf-to-native   HF weights → release checkpoint (+ config.json)
                  (reference weights_conversion/hf_to_megatron.py)
+  meta-to-native Meta release dir (consolidated.NN.pth shards +
+                 params.json) → release checkpoint (reference
+                 weights_conversion/utils/merge_llama.py + hf_to_megatron)
   native-to-hf   native checkpoint → HF model directory
                  (reference weights_conversion/megatron_to_hf.py)
   resave         load any checkpoint (any topology) and rewrite it as a
@@ -17,6 +20,8 @@ jobs are format/dtype conversion:
 Usage:
   python -m megatron_llm_tpu.tools.checkpoint_util hf-to-native \
       --hf_path meta-llama/Llama-2-7b-hf --output /ckpts/llama2-7b
+  python -m megatron_llm_tpu.tools.checkpoint_util meta-to-native \
+      --meta_dir /weights/Llama-2-70b --output /ckpts/llama2-70b
   python -m megatron_llm_tpu.tools.checkpoint_util native-to-hf \
       --load /ckpts/run1 --hf_base meta-llama/Llama-2-7b-hf --output /out/hf
   python -m megatron_llm_tpu.tools.checkpoint_util resave \
@@ -47,6 +52,74 @@ def hf_to_native(hf_path: str, output: str, family: Optional[str] = None,
     np_dtype = np.float32 if dtype == "float32" else getattr(
         __import__("ml_dtypes"), "bfloat16")
     params = converter(hf_model.state_dict(), cfg, dtype=np_dtype)
+    run_cfg = RuntimeConfig(model=cfg)
+    checkpointing.save_release_params(output, params, run_cfg)
+    print(f"wrote release checkpoint: {output} "
+          f"({sum(p.size for p in _leaves(params)):,} params)")
+
+
+def config_from_meta_params(params_json: dict, vocab_size: int,
+                            dtype: str = "float32") -> ModelConfig:
+    """Meta release ``params.json`` → native ModelConfig.
+
+    Meta stores ``dim/n_layers/n_heads[/n_kv_heads]`` plus the SwiGLU
+    sizing inputs (``multiple_of``, optional ``ffn_dim_multiplier``); the
+    actual ffn width is derived the way Meta's model code does:
+    ``2/3 · 4·dim``, scaled, rounded up to ``multiple_of``.
+    """
+    from ..config import llama2_config
+
+    dim = params_json["dim"]
+    hidden = int(2 * 4 * dim / 3)
+    mult = params_json.get("ffn_dim_multiplier")
+    if mult is not None:
+        hidden = int(mult * hidden)
+    multiple_of = params_json.get("multiple_of", 256)
+    ffn = multiple_of * (-(-hidden // multiple_of))
+    kwargs = dict(
+        hidden_size=dim,
+        num_layers=params_json["n_layers"],
+        num_attention_heads=params_json["n_heads"],
+        ffn_hidden_size=ffn,
+        vocab_size=vocab_size,
+        norm_eps=params_json.get("norm_eps", 1e-5),
+        params_dtype=dtype,
+    )
+    if "n_kv_heads" in params_json:
+        kwargs["num_kv_heads"] = params_json["n_kv_heads"]
+    if "rope_theta" in params_json:
+        kwargs["rope_theta"] = params_json["rope_theta"]
+    return llama2_config("7b", **kwargs)
+
+
+def meta_to_native(meta_dir: str, output: str,
+                   dtype: str = "float32") -> None:
+    """Meta release dir (consolidated.*.pth + params.json) → release ckpt.
+
+    The reference reaches this format through merge_meta_llama +
+    llama_to_megatron (weights_conversion/hf_to_megatron.py:59,116);
+    here the shards merge on host numpy and convert directly.
+    """
+    import json
+    import os
+
+    with open(os.path.join(meta_dir, "params.json")) as f:
+        params_json = json.load(f)
+    sd = hf_interop.load_meta_shards(meta_dir)
+    vocab = params_json.get("vocab_size", -1)
+    if vocab is None or vocab <= 0:
+        vocab = sd["tok_embeddings.weight"].shape[0]
+    cfg = config_from_meta_params(params_json, vocab, dtype)
+    # params.json under-determines the ffn width (multiple_of rounding
+    # variants exist across releases); the tensor itself is authoritative.
+    ffn_actual = sd["layers.0.feed_forward.w1.weight"].shape[0]
+    if ffn_actual != cfg.ffn_size:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, ffn_hidden_size=ffn_actual).validate()
+    np_dtype = np.float32 if dtype == "float32" else getattr(
+        __import__("ml_dtypes"), "bfloat16")
+    params = hf_interop.llama_from_meta(sd, cfg, dtype=np_dtype)
     run_cfg = RuntimeConfig(model=cfg)
     checkpointing.save_release_params(output, params, run_cfg)
     print(f"wrote release checkpoint: {output} "
@@ -173,6 +246,13 @@ def main(argv: Optional[list] = None) -> int:
     a.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"])
 
+    m = sub.add_parser("meta-to-native")
+    m.add_argument("--meta_dir", required=True,
+                   help="dir with consolidated.NN.pth shards + params.json")
+    m.add_argument("--output", required=True)
+    m.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+
     b = sub.add_parser("native-to-hf")
     b.add_argument("--load", required=True)
     b.add_argument("--output", required=True)
@@ -191,6 +271,8 @@ def main(argv: Optional[list] = None) -> int:
     if args.cmd == "hf-to-native":
         hf_to_native(args.hf_path, args.output, args.model_family,
                      args.dtype)
+    elif args.cmd == "meta-to-native":
+        meta_to_native(args.meta_dir, args.output, args.dtype)
     elif args.cmd == "native-to-hf":
         native_to_hf(args.load, args.output, args.hf_base,
                      args.model_family, args.iteration)

@@ -165,6 +165,136 @@ def llama_to_hf(params: dict, cfg: ModelConfig) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Meta release checkpoints (consolidated.NN.pth)
+# Reference behavior: weights_conversion/utils/merge_llama.py:1-80 (shard
+# merging, consumed by hf_to_megatron.py:59); this is an original
+# implementation of the same shard layout.
+# ---------------------------------------------------------------------------
+
+# How Meta's model-parallel training sharded each param class, i.e. which
+# axis the consolidated.*.pth shards concatenate along.  None = replicated
+# (every shard holds the full tensor).
+_META_SHARD_AXIS = {
+    "attention.wq.weight": 0,       # column-parallel: out-dim split
+    "attention.wk.weight": 0,
+    "attention.wv.weight": 0,
+    "feed_forward.w1.weight": 0,    # gate proj
+    "feed_forward.w3.weight": 0,    # up proj
+    "output.weight": 0,             # lm head [vocab, h]: vocab split
+    "attention.wo.weight": 1,       # row-parallel: in-dim split
+    "feed_forward.w2.weight": 1,    # down proj
+    "tok_embeddings.weight": 1,     # embedding split along hidden dim
+    "attention_norm.weight": None,
+    "ffn_norm.weight": None,
+    "norm.weight": None,
+    "rope.freqs": None,
+}
+
+
+def _meta_shard_axis(key: str):
+    for suffix, axis in _META_SHARD_AXIS.items():
+        if key.endswith(suffix):
+            return axis
+    raise KeyError(f"unrecognized Meta checkpoint key: {key!r}")
+
+
+def merge_meta_shards(shards: list) -> dict:
+    """Merge Meta ``consolidated.*.pth`` model-parallel shards (as loaded
+    state dicts, in rank order) into one full state dict.
+
+    Equivalent in behavior to the reference's ``merge_meta_llama``
+    (weights_conversion/utils/merge_llama.py) minus the file walking:
+    column-parallel params concatenate along dim 0, row-parallel along
+    dim 1, replicated params are taken from shard 0.
+    """
+    if len(shards) == 1:
+        return {k: _np(v) for k, v in shards[0].items()}
+    merged = {}
+    for key in shards[0]:
+        axis = _meta_shard_axis(key)
+        if axis is None:
+            merged[key] = _np(shards[0][key])
+        else:
+            merged[key] = np.concatenate(
+                [_np(s[key]) for s in shards], axis=axis)
+    return merged
+
+
+def load_meta_shards(root_dir: str) -> dict:
+    """Load + merge every ``consolidated.NN.pth`` under ``root_dir``."""
+    import re
+    from pathlib import Path
+
+    import torch
+
+    paths = sorted(p for p in Path(root_dir).iterdir()
+                   if re.match(r"^consolidated\.\d+\.pth$", p.name))
+    if not paths:
+        raise FileNotFoundError(
+            f"no consolidated.NN.pth shards under {root_dir}")
+    shards = [torch.load(p, map_location="cpu", weights_only=True)
+              for p in paths]
+    return merge_meta_shards(shards)
+
+
+def llama_from_meta(
+    state_dict: Mapping[str, "Array"],
+    cfg: ModelConfig,
+    tp: int = 1,
+    dtype=np.float32,
+) -> dict:
+    """Merged Meta-format state dict → native param pytree.
+
+    Differs from ``llama_from_hf`` in naming (``layers.N.attention.wq`` vs
+    ``model.layers.N.self_attn.q_proj``) and — crucially — in RoPE layout:
+    Meta weights are already interleaved even/odd (the layout this
+    framework and the reference use natively), so no rotate-half
+    permutation is applied (the reference applies permute_qkv only on the
+    HF path, hf_to_megatron.py:59-113).
+    """
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    v_padded = cfg.padded_vocab_size(tp)
+
+    def stack(fn: Callable[[int], Array]) -> Array:
+        return np.stack([fn(i) for i in range(cfg.num_layers)]).astype(dtype)
+
+    def pfx(i: int) -> str:
+        return f"layers.{i}."
+
+    return {
+        "embedding": {
+            "word": _pad_rows(sd["tok_embeddings.weight"], v_padded
+                              ).astype(dtype),
+        },
+        "layers": {
+            "input_norm": {
+                "scale": stack(
+                    lambda i: sd[pfx(i) + "attention_norm.weight"]),
+            },
+            "post_attn_norm": {
+                "scale": stack(lambda i: sd[pfx(i) + "ffn_norm.weight"]),
+            },
+            "attn": {
+                "wq": stack(lambda i: sd[pfx(i) + "attention.wq.weight"].T),
+                "wk": stack(lambda i: sd[pfx(i) + "attention.wk.weight"].T),
+                "wv": stack(lambda i: sd[pfx(i) + "attention.wv.weight"].T),
+                "wo": stack(lambda i: sd[pfx(i) + "attention.wo.weight"].T),
+            },
+            "mlp": {
+                "w_gate": stack(
+                    lambda i: sd[pfx(i) + "feed_forward.w1.weight"].T),
+                "w_up": stack(
+                    lambda i: sd[pfx(i) + "feed_forward.w3.weight"].T),
+                "w_down": stack(
+                    lambda i: sd[pfx(i) + "feed_forward.w2.weight"].T),
+            },
+        },
+        "final_norm": {"scale": sd["norm.weight"].astype(dtype)},
+        "lm_head": _pad_rows(sd["output.weight"], v_padded).T.astype(dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Falcon  (reference: hf_to_megatron.py falcon_to_megatron)
 # ---------------------------------------------------------------------------
 

@@ -26,6 +26,20 @@ jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# Persistent compilation cache: the suite's wall-clock is dominated by
+# XLA compiles of the same sharded programs on every run (round-2 verdict:
+# ~16 min, which is why final edits went untested).  Cache entries are
+# keyed on HLO + flags, so code changes invalidate exactly the affected
+# programs.  Override location with JAX_TEST_COMPILE_CACHE; set it to
+# "off" to disable.
+_cache_dir = os.environ.get(
+    "JAX_TEST_COMPILE_CACHE",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_test_cache"))
+if _cache_dir != "off":
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.abspath(_cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,251 @@
+"""GLUE (MNLI/QQP) and RACE processors on miniature files in the actual
+upstream distribution formats, plus the RACE 4-way multiple-choice model
+and the ORQA answer-matching functions.
+
+Reference formats: tasks/glue/mnli.py (columns 0/8/9/-1, 10-col test),
+tasks/glue/qqp.py (6-col train, 3-col test), tasks/race/data.py
+(JSON-lines .txt with article/questions/options/answers).
+"""
+
+import json
+
+import numpy as np
+import jax
+
+from megatron_llm_tpu.config import ModelConfig
+from megatron_llm_tpu.tasks import glue, race
+from megatron_llm_tpu.tasks.classification import (
+    ClassificationDataset,
+    classification_accuracy,
+)
+
+
+class ByteTok:
+    vocab_size = 256
+
+    def tokenize(self, text):
+        return list(text.encode())
+
+
+def tiny_cfg(seq_length=64):
+    return ModelConfig(
+        vocab_size=256, hidden_size=32, num_layers=2,
+        num_attention_heads=4, num_kv_heads=4, ffn_hidden_size=64,
+        max_position_embeddings=seq_length, norm_type="layernorm",
+        activation="gelu", position_embedding_type="absolute",
+        use_bias=True, tie_embed_logits=True, tokentype_size=2,
+        params_dtype="float32", attention_impl="dot", recompute="none",
+        make_vocab_size_divisible_by=8, seq_length=seq_length,
+    ).validate()
+
+
+# ---------------------------------------------------------------------------
+# MNLI — 12-column dev/train rows, 10-column test rows
+# ---------------------------------------------------------------------------
+
+_MNLI_HEADER = ("index\tpromptID\tpairID\tgenre\tsentence1_binary_parse\t"
+                "sentence2_binary_parse\tsentence1_parse\tsentence2_parse\t"
+                "sentence1\tsentence2\tlabel1\tgold_label")
+
+
+def _mnli_row(i, s1, s2, gold):
+    return (f"{i}\t{i}p\t{i}pair\tfiction\t(p)\t(p)\t(p)\t(p)\t"
+            f"{s1}\t{s2}\t{gold}\t{gold}")
+
+
+def test_mnli_parsing(tmp_path):
+    f = tmp_path / "dev_matched.tsv"
+    f.write_text("\n".join([
+        _MNLI_HEADER,
+        _mnli_row(0, "A man   is eating .", "The man  is dining .",
+                  "entailment"),
+        _mnli_row(1, "A dog runs.", "A cat sleeps.", "contradiction"),
+        _mnli_row(2, "Hello there.", "General remark.", "neutral"),
+    ]) + "\n")
+    rows = glue.load_mnli(str(f))
+    assert len(rows) == 3
+    # clean_text collapses runs of whitespace; a trailing " ." is kept
+    # as-is (the ' . ' → '. ' re-attachment needs a following space,
+    # matching reference tasks/data_utils.py:9-17)
+    assert rows[0][0] == "A man is eating ."
+    assert rows[0][1] == "The man is dining ."
+    assert [r[2] for r in rows] == ["entailment", "contradiction",
+                                    "neutral"]
+    # mid-sentence dots are re-attached; newlines fold to spaces
+    assert glue.clean_text("one . two\nthree") == "one. two three"
+
+
+def test_mnli_test_file_gets_placeholder_label(tmp_path):
+    header = "\t".join(f"c{i}" for i in range(10))
+    row = "\t".join(["7", "7p", "7pair", "travel", "(p)", "(p)", "(p)",
+                     "(p)", "First sentence.", "Second sentence."])
+    f = tmp_path / "test_matched.tsv"
+    f.write_text(header + "\n" + row + "\n")
+    rows = glue.load_mnli(str(f))
+    assert rows == [("First sentence.", "Second sentence.",
+                     "contradiction")]
+
+
+def test_mnli_rejects_bad_label(tmp_path):
+    f = tmp_path / "bad.tsv"
+    f.write_text(_MNLI_HEADER + "\n" + _mnli_row(0, "a.", "b.", "maybe")
+                 + "\n")
+    try:
+        glue.load_mnli(str(f))
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "maybe" in str(e)
+
+
+# ---------------------------------------------------------------------------
+# QQP — 6-column train rows, 3-column test rows, malformed rows skipped
+# ---------------------------------------------------------------------------
+
+
+def test_qqp_parsing(tmp_path):
+    f = tmp_path / "train.tsv"
+    f.write_text("\n".join([
+        "id\tqid1\tqid2\tquestion1\tquestion2\tis_duplicate",
+        "0\t1\t2\tHow do I cook rice?\tHow to cook rice?\t1",
+        "1\t3\t4\tWhat is JAX?\tWho wrote Hamlet?\t0",
+        "2\t5\t6\tbroken row with missing fields",
+    ]) + "\n")
+    rows = glue.load_qqp(str(f))
+    assert len(rows) == 2  # malformed row skipped, not fatal
+    assert rows[0] == ("How do I cook rice?", "How to cook rice?", "1")
+    assert rows[1][2] == "0"
+
+
+def test_qqp_test_format(tmp_path):
+    f = tmp_path / "test.tsv"
+    f.write_text("id\tquestion1\tquestion2\n"
+                 "0\tIs it real?\tIs this real?\n")
+    rows = glue.load_qqp(str(f))
+    assert rows == [("Is it real?", "Is this real?", "0")]
+
+
+def test_glue_rows_feed_eval_loop(tmp_path):
+    """End-to-end: shipped-format MNLI file → dataset → accuracy number."""
+    f = tmp_path / "dev.tsv"
+    f.write_text("\n".join(
+        [_MNLI_HEADER] + [
+            _mnli_row(i, f"sent one {i}.", f"sent two {i}.", lab)
+            for i, lab in enumerate(
+                ["entailment", "neutral", "contradiction", "entailment"])
+        ]) + "\n")
+    rows, label_map = glue.load_glue_rows("mnli", str(f))
+    ds = ClassificationDataset(rows, ByteTok(), 64, cls_id=250, sep_id=251,
+                               pad_id=0, label_map=label_map)
+    assert ds.num_classes == 3
+    cfg = tiny_cfg()
+    from megatron_llm_tpu.tasks.classification import \
+        init_classification_params
+
+    params = init_classification_params(jax.random.key(0), cfg, 3)
+    acc = classification_accuracy(cfg, params, ds, batch_size=2)
+    assert 0.0 <= acc <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# RACE
+# ---------------------------------------------------------------------------
+
+
+def _race_file(tmp_path):
+    d = tmp_path / "middle"
+    d.mkdir()
+    doc = {
+        "article": "The quick brown fox jumps over the lazy dog .\n"
+                   "It was a sunny day .",
+        "questions": ["What did the fox jump over?",
+                      "The day was _ ."],
+        "options": [["the dog", "the moon", "a fence", "a river"],
+                    ["rainy", "sunny", "cloudy", "dark"]],
+        "answers": ["A", "B"],
+    }
+    (d / "1.txt").write_text(json.dumps(doc) + "\n")
+    return str(d)
+
+
+def test_race_question_processing(tmp_path):
+    qs = race.read_race_questions(_race_file(tmp_path))
+    assert len(qs) == 2
+    # plain question: choice appended
+    assert qs[0]["qas"][0] == "What did the fox jump over? the dog"
+    assert qs[0]["label"] == 0
+    # cloze question: "_" substituted
+    assert qs[1]["qas"][1] == "The day was sunny ."
+    assert qs[1]["label"] == 1
+
+
+def test_race_dataset_contract(tmp_path):
+    ds = race.RaceDataset([_race_file(tmp_path)], ByteTok(), 96,
+                          cls_id=250, sep_id=251, pad_id=0,
+                          max_qa_length=24)
+    assert len(ds) == 2
+    s = ds[0]
+    assert s["tokens"].shape == (4, 96)          # NUM_CHOICES flattening
+    assert s["tokentype_ids"].shape == (4, 96)
+    assert s["pad_mask"].shape == (4, 96)
+    for c in range(4):
+        assert s["tokens"][c, 0] == 250
+        n = int(s["pad_mask"][c].sum())
+        assert s["tokens"][c, n - 1] == 251      # trailing [SEP]
+        assert set(np.unique(s["tokentype_ids"][c, :n])) == {0, 1}
+    assert s["label"] == 0
+
+
+def test_race_multichoice_model(tmp_path):
+    """4-way scores + loss + eval accuracy end to end on the tiny model."""
+    cfg = tiny_cfg(seq_length=96)
+    ds = race.RaceDataset([_race_file(tmp_path)], ByteTok(), 96,
+                          cls_id=250, sep_id=251, pad_id=0,
+                          max_qa_length=24)
+    params = race.init_multichoice_params(jax.random.key(0), cfg)
+    batch = {
+        "tokens": np.stack([ds[i]["tokens"] for i in range(2)]),
+        "tokentype_ids": np.stack([ds[i]["tokentype_ids"]
+                                   for i in range(2)]),
+        "pad_mask": np.stack([ds[i]["pad_mask"] for i in range(2)]),
+        "label": np.asarray([ds[i]["label"] for i in range(2)]),
+    }
+    logits = race.multichoice_forward(
+        cfg, params, batch["tokens"], batch["pad_mask"],
+        batch["tokentype_ids"])
+    assert logits.shape == (2, 4)
+    loss = race.multichoice_loss(cfg, params, batch)
+    assert np.isfinite(float(loss))
+    acc = race.multichoice_accuracy(cfg, params, ds, batch_size=2)
+    assert 0.0 <= acc <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# ORQA answer matching (exact match + regex)
+# ---------------------------------------------------------------------------
+
+
+def test_orqa_exact_match():
+    from megatron_llm_tpu.tasks.orqa import (exact_match_accuracy,
+                                             exact_match_score)
+
+    assert exact_match_score("The  Eiffel Tower!", "eiffel tower")
+    assert exact_match_score("a cat", "cat")          # article dropped
+    assert not exact_match_score("cat", "dog")
+    acc = exact_match_accuracy(
+        ["Paris", "42", "wrong"],
+        [["paris", "city of light"], ["42"], ["right"]])
+    assert abs(acc - 2 / 3) < 1e-9
+
+
+def test_orqa_regex_match():
+    from megatron_llm_tpu.tasks.orqa import has_answer, regex_match
+
+    assert regex_match("It opened in 1889 in Paris.", r"18\d\d")
+    assert not regex_match("no digits here", r"\d{4}")
+    assert not regex_match("anything", r"(unclosed")  # invalid → no match
+    assert has_answer("It opened in 1889.", [r"18\d\d"],
+                      match_type="regex")
+    assert has_answer("The capital is Paris.", ["paris"],
+                      match_type="string")
+    assert not has_answer("The capital is Paris.", ["london"],
+                          match_type="string")
