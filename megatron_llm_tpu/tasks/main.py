@@ -24,10 +24,20 @@ def main(argv: Optional[list] = None) -> int:
         from .zeroshot import main as zmain
 
         return zmain(["--task", task, *rest])
-    if task in ("classification", "glue", "race"):
+    if task in ("classification", "glue"):
         from .classification import main as cmain
 
         cmain(rest)
+        return 0
+    if task in ("mnli", "qqp"):
+        from .classification import main as cmain
+
+        cmain(["--task", task, *rest])
+        return 0
+    if task == "race":
+        from .race import main as rmain
+
+        rmain(rest)
         return 0
     if task == "orqa":
         from .orqa import main as omain
@@ -38,7 +48,8 @@ def main(argv: Optional[list] = None) -> int:
 
         return mmain(rest)
     raise SystemExit(f"unknown --task {task!r}; choose from wikitext, "
-                     "lambada, classification, orqa, msdp")
+                     "lambada, classification, glue, mnli, qqp, race, "
+                     "orqa, msdp")
 
 
 if __name__ == "__main__":

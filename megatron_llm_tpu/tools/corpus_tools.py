@@ -1,0 +1,444 @@
+"""Corpus preparation toolkit: URL filtering, cleanup, fuzzy dedup,
+task decontamination.
+
+Reference parity: tools/openwebtext/ (13 scripts — blacklist_urls.py,
+cleanup_dataset.py, find_duplicates.py, group_duplicate_url.py,
+remove_group_duplicates.py, filter_ngrams.py, add_id.py, merge_jsons.py).
+This is a clean-room reimplementation of the same pipeline stages as one
+module with subcommands; it is host-side code (no JAX), and avoids the
+reference's heavyweight deps (ftfy/langdetect/LSH package) with
+self-contained equivalents:
+
+  blacklist-urls   domain / extension / malformed-URL filtering
+  cleanup          unicode normalization, language heuristic, min-length
+  dedup            minhash-LSH over char-shingles → duplicate groups →
+                   keep-one-per-group removal list (find_duplicates +
+                   group_duplicate_url + remove_group_duplicates in one)
+  decontaminate    remove training docs that contain eval-task n-grams
+                   (filter_ngrams.py's purpose)
+  add-id / merge   bookkeeping helpers (add_id.py, merge_jsons.py)
+
+Documents are loose JSONL: one ``{"text": ..., "url": ...}`` per line
+(the openwebtext convention; ``id`` added by add-id).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import re
+import sys
+import unicodedata
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# URL filtering (reference: blacklist_urls.py)
+# ---------------------------------------------------------------------------
+
+# Non-content / non-text domains commonly excluded from web-text corpora.
+DEFAULT_DOMAIN_BLACKLIST = frozenset({
+    "youtube.com", "youtu.be", "vimeo.com", "twitch.tv",
+    "instagram.com", "flickr.com", "imgur.com", "giphy.com",
+    "facebook.com", "twitter.com", "x.com", "reddit.com",
+    "spotify.com", "soundcloud.com", "itunes.apple.com",
+    "amazon.com", "ebay.com", "etsy.com",
+    "pornhub.com", "xvideos.com", "xhamster.com", "redtube.com",
+    "t.co", "bit.ly", "goo.gl", "tinyurl.com", "ow.ly",
+})
+
+# Binary / media file extensions that cannot yield useful text.
+DEFAULT_EXTENSION_BLACKLIST = frozenset({
+    ".jpg", ".jpeg", ".png", ".gif", ".bmp", ".svg", ".webp", ".ico",
+    ".mp3", ".wav", ".flac", ".ogg", ".m4a",
+    ".mp4", ".avi", ".mov", ".mkv", ".webm", ".flv", ".wmv",
+    ".pdf", ".doc", ".docx", ".xls", ".xlsx", ".ppt", ".pptx",
+    ".zip", ".rar", ".tar", ".gz", ".7z", ".dmg", ".exe", ".apk",
+    ".css", ".js", ".xml", ".rss", ".atom",
+})
+
+_URL_RE = re.compile(r"^https?://[^\s]+$", re.IGNORECASE)
+
+
+def url_domain(url: str) -> str:
+    """Registrable host of a URL, lowercased, ``www.`` stripped.
+
+    Uses urlsplit so userinfo (``user:pass@host``) and ports can't spoof
+    the blacklist check."""
+    from urllib.parse import urlsplit
+
+    try:
+        host = urlsplit(url.strip()).hostname or ""
+    except ValueError:
+        return ""
+    host = host.lower()
+    return host[4:] if host.startswith("www.") else host
+
+
+def url_is_malformed(url: str) -> bool:
+    url = url.strip()
+    return (not url or len(url) > 2048 or " " in url
+            or not _URL_RE.match(url))
+
+
+def url_is_blacklisted(
+    url: str,
+    domains: frozenset = DEFAULT_DOMAIN_BLACKLIST,
+    extensions: frozenset = DEFAULT_EXTENSION_BLACKLIST,
+) -> bool:
+    """True if the URL should be dropped (malformed, blacklisted domain or
+    subdomain thereof, or binary/media extension)."""
+    if url_is_malformed(url):
+        return True
+    host = url_domain(url)
+    parts = host.split(".")
+    for i in range(len(parts) - 1):
+        if ".".join(parts[i:]) in domains:
+            return True
+    path = re.sub(r"[?#].*$", "", url.strip()).lower()
+    return any(path.endswith(ext) for ext in extensions)
+
+
+def filter_urls(urls: Iterable[str], **kw) -> list[str]:
+    return [u.strip() for u in urls
+            if u.strip() and not url_is_blacklisted(u, **kw)]
+
+
+# ---------------------------------------------------------------------------
+# Cleanup (reference: cleanup_dataset.py / cleanup_fix_dataset.py)
+# ---------------------------------------------------------------------------
+
+# The frequent mojibake sequences: UTF-8 bytes decoded as cp1252 (the
+# ubiquitous web form) and as latin-1, written with explicit escapes so
+# the source itself can't be re-mangled by tooling.  E.g. \u2019
+# (UTF-8 E2 80 99) reads as cp1252 \u00e2\u20ac\u2122 and as latin-1
+# \u00e2\u0080\u0099.
+_MOJIBAKE = [
+    ("\u00e2\u20ac\u2122", "'"),    # cp1252 right single quote
+    ("\u00e2\u0080\u0099", "'"),    # latin-1 right single quote
+    ("\u00e2\u20ac\u02dc", "'"),    # cp1252 left single quote
+    ("\u00e2\u0080\u0098", "'"),    # latin-1 left single quote
+    ("\u00e2\u20ac\u0153", '"'),    # cp1252 left double quote
+    ("\u00e2\u0080\u009c", '"'),    # latin-1 left double quote
+    ("\u00e2\u20ac\u009d", '"'),    # cp1252 right double quote
+    ("\u00e2\u0080\u009d", '"'),    # latin-1 right double quote
+    ("\u00e2\u20ac\u201c", "-"),    # cp1252 en dash
+    ("\u00e2\u0080\u0093", "-"),    # latin-1 en dash
+    ("\u00e2\u20ac\u201d", "-"),    # cp1252 em dash
+    ("\u00e2\u0080\u0094", "-"),    # latin-1 em dash
+    ("\u00e2\u20ac\u00a6", "..."),  # ellipsis (byte A6 = same in both)
+    ("\u00e2\u0080\u00a6", "..."),
+    ("\u00c3\u00a9", "\u00e9"),     # e-acute
+    ("\u00c2\u00a0", " "),           # nbsp
+]
+
+
+def fix_text(text: str) -> str:
+    """Unicode repair: undo the common mojibake sequences, NFC-normalize,
+    fold exotic spaces to plain spaces, CRLF/CR to LF, drop other control
+    chars (keep newline and tab)."""
+    for bad, good in _MOJIBAKE:
+        text = text.replace(bad, good)
+    text = unicodedata.normalize("NFC", text)
+    text = text.replace("\r\n", "\n").replace("\r", "\n")
+    out = []
+    for c in text:
+        if c in "\n\t":
+            out.append(c)
+            continue
+        cat = unicodedata.category(c)
+        if cat in ("Cc", "Cf"):
+            continue
+        out.append(" " if cat == "Zs" else c)
+    return "".join(out)
+
+
+def looks_english(text: str, threshold: float = 0.75) -> bool:
+    """Cheap language heuristic standing in for langdetect: fraction of
+    alphabetic chars that are ASCII letters.  Web-scale English filtering
+    needs no more than this for the coarse pass the reference does."""
+    alpha = [c for c in text if c.isalpha()]
+    if not alpha:
+        return False
+    ascii_alpha = sum(1 for c in alpha if c.isascii())
+    return ascii_alpha / len(alpha) >= threshold
+
+
+def clean_document(
+    doc: dict,
+    min_tokens: int = 128,
+    english_only: bool = True,
+) -> Optional[dict]:
+    """→ cleaned doc, or None if it should be dropped (too short /
+    non-English) — reference cleanup_dataset.filter_corpus semantics
+    (ftfy → langdetect → ≥128 tokens)."""
+    text = fix_text(doc.get("text", ""))
+    if len(text.split()) < min_tokens:
+        return None
+    if english_only and not looks_english(text):
+        return None
+    out = dict(doc)
+    out["text"] = text
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fuzzy dedup: minhash-LSH (reference: find_duplicates.py 5-char shingles +
+# jaccard 0.7, group_duplicate_url.py is_similar 0.9,
+# remove_group_duplicates.py keep-one)
+# ---------------------------------------------------------------------------
+
+
+def shingles(text: str, char_ngram: int = 5) -> set:
+    """Character n-gram shingle set (whitespace collapsed, lowercased)."""
+    t = re.sub(r"\s+", " ", text.lower()).strip()
+    return {t[i:i + char_ngram] for i in range(max(len(t) - char_ngram + 1,
+                                                  1))}
+
+
+def jaccard(a: set, b: set) -> float:
+    if not a and not b:
+        return 1.0
+    return len(a & b) / max(len(a | b), 1)
+
+
+def _minhash_signature(sh: set, seeds: np.ndarray) -> np.ndarray:
+    """[num_hashes] min-hash signature via salted blake2 of each shingle."""
+    if not sh:
+        return np.zeros(len(seeds), np.uint64)
+    hashes = np.empty((len(sh), len(seeds)), np.uint64)
+    for i, s in enumerate(sorted(sh)):
+        h = int.from_bytes(
+            hashlib.blake2b(s.encode(), digest_size=8).digest(), "little")
+        # one blake2 per shingle, then cheap per-seed mixing
+        hashes[i] = (np.uint64(h) ^ seeds) * np.uint64(0x9E3779B97F4A7C15)
+    return hashes.min(axis=0)
+
+
+def find_duplicate_groups(
+    docs: Sequence[dict],
+    key: str = "url",
+    char_ngram: int = 5,
+    num_hashes: int = 64,
+    num_bands: int = 16,
+    similarity: float = 0.7,
+) -> list[list[str]]:
+    """Minhash-LSH candidate generation + exact-jaccard confirmation →
+    groups (connected components) of near-duplicate document keys.
+
+    ``num_bands`` bands of ``num_hashes/num_bands`` rows each: documents
+    sharing any band bucket are candidates; candidates are confirmed by
+    shingle jaccard ≥ ``similarity``.
+    """
+    assert num_hashes % num_bands == 0
+    rows = num_hashes // num_bands
+    rng = np.random.default_rng(1234)
+    seeds = rng.integers(1, 2 ** 63, size=num_hashes, dtype=np.uint64)
+
+    keys, shingle_sets, sigs = [], [], []
+    for d in docs:
+        keys.append(d[key])
+        sh = shingles(d.get("text", ""), char_ngram)
+        shingle_sets.append(sh)
+        sigs.append(_minhash_signature(sh, seeds))
+
+    # LSH banding
+    candidates: set[tuple[int, int]] = set()
+    for b in range(num_bands):
+        buckets: dict[bytes, list[int]] = {}
+        for i, sig in enumerate(sigs):
+            bkey = sig[b * rows:(b + 1) * rows].tobytes()
+            buckets.setdefault(bkey, []).append(i)
+        for members in buckets.values():
+            for ai in range(len(members)):
+                for bi in range(ai + 1, len(members)):
+                    candidates.add((members[ai], members[bi]))
+
+    # exact confirmation + union-find grouping
+    parent = list(range(len(docs)))
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for i, j in candidates:
+        if jaccard(shingle_sets[i], shingle_sets[j]) >= similarity:
+            parent[find(i)] = find(j)
+
+    groups: dict[int, list[str]] = {}
+    for i in range(len(docs)):
+        groups.setdefault(find(i), []).append(keys[i])
+    return [g for g in groups.values() if len(g) > 1]
+
+
+def removal_list(groups: Sequence[Sequence[str]]) -> set:
+    """Keep the first key of each duplicate group, remove the rest
+    (reference remove_group_duplicates.py keeps one url per group)."""
+    out = set()
+    for g in groups:
+        out.update(g[1:])
+    return out
+
+
+def dedup_docs(docs: Sequence[dict], key: str = "url", **kw) -> list[dict]:
+    remove = removal_list(find_duplicate_groups(docs, key=key, **kw))
+    return [d for d in docs if d[key] not in remove]
+
+
+# ---------------------------------------------------------------------------
+# Task decontamination (reference: filter_ngrams.py)
+# ---------------------------------------------------------------------------
+
+
+def _word_ngrams(text: str, n: int) -> set:
+    words = re.findall(r"[a-z0-9']+", text.lower())
+    return {" ".join(words[i:i + n])
+            for i in range(max(len(words) - n + 1, 0))}
+
+
+def build_task_ngrams(task_texts: Iterable[str], n: int = 13) -> set:
+    """The eval-set n-gram inventory training docs must not contain
+    (13-gram overlap is the standard GPT-3-style decontamination
+    criterion the reference's filter_ngrams implements)."""
+    out: set = set()
+    for t in task_texts:
+        out |= _word_ngrams(t, n)
+    return out
+
+
+def is_contaminated(text: str, task_ngrams: set, n: int = 13) -> bool:
+    return bool(_word_ngrams(text, n) & task_ngrams)
+
+
+def decontaminate_docs(docs: Sequence[dict], task_ngrams: set,
+                       n: int = 13) -> list[dict]:
+    return [d for d in docs
+            if not is_contaminated(d.get("text", ""), task_ngrams, n)]
+
+
+# ---------------------------------------------------------------------------
+# JSONL io + bookkeeping (reference: add_id.py, merge_jsons.py)
+# ---------------------------------------------------------------------------
+
+
+def read_jsonl(path: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def write_jsonl(path: str, docs: Iterable[dict]) -> int:
+    n = 0
+    with open(path, "w") as f:
+        for d in docs:
+            f.write(json.dumps(d) + "\n")
+            n += 1
+    return n
+
+
+def add_ids(docs: Sequence[dict], start: int = 0) -> list[dict]:
+    return [{**d, "id": start + i} for i, d in enumerate(docs)]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    a = sub.add_parser("blacklist-urls")
+    a.add_argument("input", help="one URL per line")
+    a.add_argument("output")
+
+    c = sub.add_parser("cleanup")
+    c.add_argument("input", help="jsonl docs")
+    c.add_argument("output")
+    c.add_argument("--min_tokens", type=int, default=128)
+    c.add_argument("--keep_non_english", action="store_true")
+
+    d = sub.add_parser("dedup")
+    d.add_argument("input", help="jsonl docs")
+    d.add_argument("output")
+    d.add_argument("--key", default="url")
+    d.add_argument("--similarity", type=float, default=0.7)
+    d.add_argument("--groups_out", default=None,
+                   help="optionally write the duplicate groups as jsonl")
+
+    g = sub.add_parser("decontaminate")
+    g.add_argument("input", help="jsonl docs")
+    g.add_argument("output")
+    g.add_argument("--task_files", nargs="+", required=True,
+                   help="jsonl files whose 'text' fields form the eval set")
+    g.add_argument("--ngram", type=int, default=13)
+
+    i = sub.add_parser("add-id")
+    i.add_argument("input")
+    i.add_argument("output")
+    i.add_argument("--start", type=int, default=0)
+
+    m = sub.add_parser("merge")
+    m.add_argument("inputs", nargs="+")
+    m.add_argument("--output", required=True)
+
+    ns = p.parse_args(argv)
+    if ns.cmd == "blacklist-urls":
+        with open(ns.input) as f:
+            kept = filter_urls(f)
+        with open(ns.output, "w") as f:
+            f.write("\n".join(kept) + ("\n" if kept else ""))
+        print(f"kept {len(kept)} urls")
+    elif ns.cmd == "cleanup":
+        docs = read_jsonl(ns.input)
+        cleaned = [c for c in
+                   (clean_document(x, ns.min_tokens,
+                                   english_only=not ns.keep_non_english)
+                    for x in docs) if c is not None]
+        n = write_jsonl(ns.output, cleaned)
+        print(f"kept {n}/{len(docs)} docs")
+    elif ns.cmd == "dedup":
+        docs = read_jsonl(ns.input)
+        groups = find_duplicate_groups(docs, key=ns.key,
+                                       similarity=ns.similarity)
+        if ns.groups_out:
+            write_jsonl(ns.groups_out, [{"group": g} for g in groups])
+        remove = removal_list(groups)
+        kept = [x for x in docs if x[ns.key] not in remove]
+        write_jsonl(ns.output, kept)
+        print(f"kept {len(kept)}/{len(docs)} docs "
+              f"({len(groups)} duplicate groups)")
+    elif ns.cmd == "decontaminate":
+        docs = read_jsonl(ns.input)
+        task_texts = [d["text"] for tf in ns.task_files
+                      for d in read_jsonl(tf)]
+        ng = build_task_ngrams(task_texts, ns.ngram)
+        kept = decontaminate_docs(docs, ng, ns.ngram)
+        write_jsonl(ns.output, kept)
+        print(f"kept {len(kept)}/{len(docs)} docs "
+              f"({len(ng)} task {ns.ngram}-grams)")
+    elif ns.cmd == "add-id":
+        docs = add_ids(read_jsonl(ns.input), ns.start)
+        write_jsonl(ns.output, docs)
+        print(f"wrote {len(docs)} docs with ids from {ns.start}")
+    else:  # merge
+        total = 0
+        with open(ns.output, "w") as f:
+            for path in ns.inputs:
+                for doc in read_jsonl(path):
+                    f.write(json.dumps(doc) + "\n")
+                    total += 1
+        print(f"merged {total} docs from {len(ns.inputs)} files")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

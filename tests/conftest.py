@@ -26,16 +26,16 @@ jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_default_matmul_precision", "highest")
 
-# Persistent compilation cache: the suite's wall-clock is dominated by
-# XLA compiles of the same sharded programs on every run (round-2 verdict:
-# ~16 min, which is why final edits went untested).  Cache entries are
-# keyed on HLO + flags, so code changes invalidate exactly the affected
-# programs.  Override location with JAX_TEST_COMPILE_CACHE; set it to
-# "off" to disable.
-_cache_dir = os.environ.get(
-    "JAX_TEST_COMPILE_CACHE",
-    os.path.join(os.path.dirname(__file__), "..", ".jax_test_cache"))
-if _cache_dir != "off":
+# Persistent compilation cache — OPT-IN via JAX_TEST_COMPILE_CACHE=<dir>.
+# A warm cache cuts the suite from ~9-16 min to well under that, BUT on
+# this jax/XLA version (0.9.0, XLA:CPU) deserialized executables of
+# collective-heavy shard_map programs intermittently SIGABRT at their
+# first host fetch (observed 3/4 warm full-suite runs, moving between
+# tests/models/test_moe.py and tests/parallel/test_ring_attention.py;
+# cold runs never abort).  Until that upstream bug is fixed, correctness
+# of a default `pytest tests/` run beats speed.
+_cache_dir = os.environ.get("JAX_TEST_COMPILE_CACHE", "")
+if _cache_dir and _cache_dir != "off":
     jax.config.update("jax_compilation_cache_dir",
                       os.path.abspath(_cache_dir))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
@@ -85,3 +85,47 @@ def pytest_configure(config):
         "markers", "incremental: xfail-chain steps within a test class"
     )
     config.addinivalue_line("markers", "tpu: requires real TPU hardware")
+    config.addinivalue_line(
+        "markers",
+        "slow: >13s single-test compile cost on the 1-core CI host; "
+        "`-m 'not slow'` is the fast inner-loop tier, the full suite "
+        "(default) is required before any snapshot/commit of substance",
+    )
+
+
+# The heavyweight end-to-end tests (each dominated by XLA compiles of
+# large sharded programs; this host has ONE cpu core, so compile time is
+# irreducible wall-clock — and the persistent compile cache is disabled,
+# see above).  Centralized here instead of per-file markers so the list
+# mirrors `--durations` output directly.
+_SLOW_TESTS = {
+    "test_two_process_dryrun",
+    "test_train_step_with_context_parallelism",
+    "test_train_step_with_zigzag_layout",
+    "test_moe_train_step_ep",
+    "test_moe_through_pipeline",
+    "test_moe_model_forward_and_grad",
+    "test_pipeline_matches_reference",
+    "test_windowed_remat_matches_unwindowed",
+    "test_full_train_step_dp_sharded_batch_argument",
+    "test_retrieval_loss_trains",
+    "test_pretrain_ict_entrypoint",
+    "test_pretrain_bert_entrypoint",
+    "test_pretrain_t5_entrypoint",
+    "test_zero1_state_equivalence",
+    "test_save_load_resume_equivalence",
+    "test_memory_scales_with_T_not_quadratically",
+    "test_streamed_pipeline_memory_fits_model",
+    "test_windowed_remat_bounds_memory_at_large_M",
+    "test_pretrain_end_to_end",
+    "test_pretrain_resume",
+    "test_droppath_training_smoke_grads_finite",
+    "test_tp_loss_and_grads_match_unsharded",
+    "test_dense_index_retrieves_own_context",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.name.split("[")[0] in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)

@@ -87,8 +87,11 @@ def test_deterministic_error_not_retried(monkeypatch):
 
 
 def test_transient_error_retried(monkeypatch):
+    import time
+
     import jax
 
+    monkeypatch.setattr(time, "sleep", lambda s: None)  # retry backoff
     calls = []
 
     def flaky():

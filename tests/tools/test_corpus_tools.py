@@ -1,0 +1,144 @@
+"""Corpus preparation toolkit (reference: tools/openwebtext/ pipeline)."""
+
+import json
+
+import pytest
+
+from megatron_llm_tpu.tools import corpus_tools as ct
+
+
+# ---------------------------------------------------------------------------
+# URL filtering
+# ---------------------------------------------------------------------------
+
+
+def test_url_blacklist():
+    assert ct.url_is_blacklisted("https://www.youtube.com/watch?v=x")
+    assert ct.url_is_blacklisted("https://m.youtube.com/watch?v=x")  # subdomain
+    assert ct.url_is_blacklisted("https://example.com/photo.JPG")
+    assert ct.url_is_blacklisted("https://example.com/doc.pdf?dl=1")
+    assert ct.url_is_blacklisted("not a url")
+    assert ct.url_is_blacklisted("ftp://example.com/x")
+    assert not ct.url_is_blacklisted("https://example.com/article.html")
+    assert not ct.url_is_blacklisted("https://notyoutube.com/page")
+
+
+def test_filter_urls():
+    urls = ["https://example.com/a", "https://youtube.com/b", "",
+            "https://blog.org/post.html", "garbage"]
+    assert ct.filter_urls(urls) == ["https://example.com/a",
+                                    "https://blog.org/post.html"]
+
+
+# ---------------------------------------------------------------------------
+# Cleanup
+# ---------------------------------------------------------------------------
+
+
+def test_fix_text_mojibake_and_controls():
+    # mojibake built from explicit escapes (raw literals get
+    # re-mangled by editors, which is exactly what fix_text repairs)
+    s = ("caf\u00c3\u00a9 \u00e2\u0080\u009cquoted\u00e2\u0080\u009d"
+         "\r\nnext\x07line end")
+    fixed = ct.fix_text(s)
+    assert fixed == 'caf\u00e9 "quoted"\nnextline end'
+
+
+def test_clean_document_filters():
+    long_en = {"text": "word " * 200, "url": "u1"}
+    short = {"text": "too short", "url": "u2"}
+    non_en = {"text": "буква " * 200, "url": "u3"}
+    assert ct.clean_document(long_en) is not None
+    assert ct.clean_document(short) is None
+    assert ct.clean_document(non_en) is None
+    assert ct.clean_document(non_en, english_only=False) is not None
+
+
+# ---------------------------------------------------------------------------
+# Dedup
+# ---------------------------------------------------------------------------
+
+
+def _docs():
+    base = ("The quick brown fox jumps over the lazy dog and then "
+            "runs far away into the deep green forest tonight. " * 6)
+    near = base.replace("lazy dog", "sleepy dog")
+    other = ("Completely different content about astronomy, telescopes "
+             "and the rings of Saturn in the winter sky above. " * 6)
+    return [
+        {"url": "a", "text": base},
+        {"url": "b", "text": near},     # near-duplicate of a
+        {"url": "c", "text": other},
+        {"url": "d", "text": base},     # exact duplicate of a
+    ]
+
+
+def test_find_duplicate_groups():
+    groups = ct.find_duplicate_groups(_docs(), similarity=0.7)
+    assert len(groups) == 1
+    assert sorted(groups[0]) == ["a", "b", "d"]
+
+
+def test_dedup_keeps_one_per_group():
+    kept = ct.dedup_docs(_docs(), similarity=0.7)
+    urls = [d["url"] for d in kept]
+    assert "c" in urls
+    assert len([u for u in urls if u in ("a", "b", "d")]) == 1
+
+
+def test_jaccard_and_shingles():
+    a = ct.shingles("hello world")
+    assert ct.jaccard(a, a) == 1.0
+    assert ct.jaccard(a, ct.shingles("goodbye moon")) < 0.2
+
+
+# ---------------------------------------------------------------------------
+# Decontamination
+# ---------------------------------------------------------------------------
+
+
+def test_decontaminate():
+    eval_text = ("the secret benchmark sentence that must never appear "
+                 "in the training corpus at all")
+    ng = ct.build_task_ngrams([eval_text], n=8)
+    contaminated = {"url": "x", "text": "prefix words " + eval_text +
+                    " suffix words"}
+    clean = {"url": "y", "text": "ordinary training text " * 10}
+    kept = ct.decontaminate_docs([contaminated, clean], ng, n=8)
+    assert [d["url"] for d in kept] == ["y"]
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_cli_pipeline(tmp_path, capsys):
+    raw = tmp_path / "raw.jsonl"
+    docs = [{"url": f"https://site{i}.com/p", "text": "word " * 200}
+            for i in range(3)]
+    docs.append({"url": "https://site9.com/p", "text": "word " * 200})  # dup
+    ct.write_jsonl(str(raw), docs)
+
+    cleaned = tmp_path / "clean.jsonl"
+    ct.main(["cleanup", str(raw), str(cleaned)])
+    assert len(ct.read_jsonl(str(cleaned))) == 4
+
+    deduped = tmp_path / "dedup.jsonl"
+    ct.main(["dedup", str(cleaned), str(deduped), "--similarity", "0.9"])
+    assert len(ct.read_jsonl(str(deduped))) == 1  # all texts identical
+
+    with_ids = tmp_path / "ids.jsonl"
+    ct.main(["add-id", str(deduped), str(with_ids), "--start", "5"])
+    assert ct.read_jsonl(str(with_ids))[0]["id"] == 5
+
+    merged = tmp_path / "merged.jsonl"
+    ct.main(["merge", str(with_ids), str(with_ids),
+             "--output", str(merged)])
+    assert len(ct.read_jsonl(str(merged))) == 2
+
+    urls_in = tmp_path / "urls.txt"
+    urls_in.write_text("https://ok.com/a\nhttps://youtube.com/x\n")
+    urls_out = tmp_path / "urls_clean.txt"
+    ct.main(["blacklist-urls", str(urls_in), str(urls_out)])
+    assert urls_out.read_text().strip() == "https://ok.com/a"
