@@ -113,7 +113,11 @@ def _train_point(seq: int, mb: int, recompute: str, iters: int, peak: float):
     }
     key = jax.random.key(0)
 
-    # warmup / compile
+    # warmup / compile — two steps: the first compiles, the second flushes
+    # remaining lazy one-time work (allocator growth, executable warm-in)
+    # out of the timed window (~0.8% of a 20-iter headline otherwise)
+    state, metrics = step(state, batch, key)
+    float(metrics["loss"])
     state, metrics = step(state, batch, key)
     float(metrics["loss"])
 
@@ -230,7 +234,7 @@ def main() -> None:
     # (mb=8) only runs if the primary fails — a partial record with a real
     # headline beats a stack trace.
     headline = _point("train@1024", _train_point, 1024, 12, "selective",
-                      20, peak)
+                      30, peak)
     headline_config = "mb12"
     if headline is None:
         headline = _point("train@1024/fallback", _train_point, 1024, 8,

@@ -303,15 +303,33 @@ def _word_ngrams(text: str, n: int) -> set:
 def build_task_ngrams(task_texts: Iterable[str], n: int = 13) -> set:
     """The eval-set n-gram inventory training docs must not contain
     (13-gram overlap is the standard GPT-3-style decontamination
-    criterion the reference's filter_ngrams implements)."""
+    criterion the reference's filter_ngrams implements).
+
+    Eval texts shorter than ``n`` words contribute their whole word
+    sequence as a single entry — otherwise short targets (e.g. LAMBADA
+    continuations) would silently never match anything."""
     out: set = set()
     for t in task_texts:
-        out |= _word_ngrams(t, n)
+        grams = _word_ngrams(t, n)
+        if grams:
+            out |= grams
+        else:
+            words = re.findall(r"[a-z0-9']+", t.lower())
+            if words:
+                out.add(" ".join(words))
     return out
 
 
 def is_contaminated(text: str, task_ngrams: set, n: int = 13) -> bool:
-    return bool(_word_ngrams(text, n) & task_ngrams)
+    if _word_ngrams(text, n) & task_ngrams:
+        return True
+    # short-eval-text entries (< n words) match as subsequences
+    short = [g for g in task_ngrams if g.count(" ") + 1 < n]
+    if short:
+        words = re.findall(r"[a-z0-9']+", text.lower())
+        joined = " " + " ".join(words) + " "
+        return any(f" {g} " in joined for g in short)
+    return False
 
 
 def decontaminate_docs(docs: Sequence[dict], task_ngrams: set,

@@ -142,3 +142,15 @@ def test_cli_pipeline(tmp_path, capsys):
     urls_out = tmp_path / "urls_clean.txt"
     ct.main(["blacklist-urls", str(urls_in), str(urls_out)])
     assert urls_out.read_text().strip() == "https://ok.com/a"
+
+
+def test_decontaminate_short_eval_texts():
+    """Eval items shorter than the n-gram size must still match (whole-
+    sequence fallback) — otherwise LAMBADA-style short targets silently
+    never decontaminate anything."""
+    ng = ct.build_task_ngrams(["the hidden answer"], n=13)
+    assert ng  # not an empty inventory
+    doc_bad = {"url": "x", "text": "some prefix the hidden answer suffix"}
+    doc_ok = {"url": "y", "text": "totally unrelated text " * 5}
+    kept = ct.decontaminate_docs([doc_bad, doc_ok], ng, n=13)
+    assert [d["url"] for d in kept] == ["y"]
