@@ -1,0 +1,248 @@
+"""Native byte-level BPE (GPT-2) and WordPiece (BERT) tokenizers.
+
+Reference parity: megatron/tokenizer/gpt2_tokenization.py (vocab.json +
+merges.txt byte-level BPE) and bert_tokenization.py (vocab.txt greedy
+longest-match WordPiece) — the reference reads these vocabulary files
+natively rather than through ``transformers``.  These are clean-room
+implementations of the same published algorithms; parity against
+``transformers`` tokenizers loaded from the *same files* is tested in
+tests/data/test_native_tokenizers.py.
+"""
+
+from __future__ import annotations
+
+import json
+import unicodedata
+from typing import Optional, Sequence
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 byte-level BPE
+# ---------------------------------------------------------------------------
+
+
+def bytes_to_unicode() -> dict:
+    """The GPT-2 reversible byte→unicode table: printable latin bytes map
+    to themselves, the rest to 256+offset code points, so every byte
+    string has a lossless text form."""
+    keep = (list(range(ord("!"), ord("~") + 1))
+            + list(range(ord("¡"), ord("¬") + 1))
+            + list(range(ord("®"), ord("ÿ") + 1)))
+    mapping = {}
+    extra = 0
+    for b in range(256):
+        if b in keep:
+            mapping[b] = chr(b)
+        else:
+            mapping[b] = chr(256 + extra)
+            extra += 1
+    return mapping
+
+
+# GPT-2's pretokenizer: contractions, letter runs, number runs, other
+# non-space runs, and trailing/leading space handling.  \p{L}/\p{N} need
+# the ``regex`` module (stdlib \w/\d mishandle No/Nl chars like ² or ½ —
+# different splits than the published tokenizer).
+import regex as _regex
+
+_GPT2_SPLIT = _regex.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+"
+    r"|\s+(?!\S)|\s+")
+
+
+class GPT2BPETokenizer:
+    """vocab.json + merges.txt byte-level BPE encoder/decoder."""
+
+    def __init__(self, vocab_file: str, merges_file: str):
+        with open(vocab_file, encoding="utf-8") as f:
+            self.encoder: dict = json.load(f)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        ranks = {}
+        with open(merges_file, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()  # CRLF / stray spaces must not
+                if not line or line.startswith("#version"):  # corrupt ranks
+                    continue
+                a, b = line.split()
+                ranks[(a, b)] = len(ranks)
+        self.bpe_ranks = ranks
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self._cache: dict = {}
+
+    def _bpe(self, token: str) -> list[str]:
+        """Merge-loop: repeatedly join the lowest-rank adjacent pair."""
+        if token in self._cache:
+            return self._cache[token]
+        parts = list(token)
+        while len(parts) > 1:
+            pairs = {(parts[i], parts[i + 1]): i
+                     for i in range(len(parts) - 1) if
+                     (parts[i], parts[i + 1]) in self.bpe_ranks}
+            if not pairs:
+                break
+            best = min(pairs, key=lambda p: self.bpe_ranks[p])
+            merged = []
+            i = 0
+            while i < len(parts):
+                if (i < len(parts) - 1
+                        and (parts[i], parts[i + 1]) == best):
+                    merged.append(parts[i] + parts[i + 1])
+                    i += 2
+                else:
+                    merged.append(parts[i])
+                    i += 1
+            parts = merged
+        self._cache[token] = parts
+        return parts
+
+    def encode(self, text: str) -> list[int]:
+        ids = []
+        for tok in _GPT2_SPLIT.findall(text):
+            mapped = "".join(self.byte_encoder[b]
+                             for b in tok.encode("utf-8"))
+            ids.extend(self.encoder[p] for p in self._bpe(mapped))
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        text = "".join(self.decoder[int(i)] for i in ids)
+        data = bytes(self.byte_decoder[c] for c in text)
+        return data.decode("utf-8", errors="replace")
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.encoder)
+
+
+# ---------------------------------------------------------------------------
+# BERT WordPiece
+# ---------------------------------------------------------------------------
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class WordPieceTokenizer:
+    """vocab.txt greedy-longest-match WordPiece with BERT basic
+    tokenization (lowercase option, accent stripping, punctuation and
+    CJK splitting)."""
+
+    def __init__(self, vocab_file: str, lower_case: bool = True,
+                 unk_token: str = "[UNK]", max_word_chars: int = 100,
+                 never_split: Optional[Sequence[str]] = None):
+        self.vocab: dict = {}
+        with open(vocab_file, encoding="utf-8") as f:
+            for line in f:
+                tok = line.strip()  # CRLF-safe
+                if tok:
+                    self.vocab[tok] = len(self.vocab)
+        self.inv_vocab = {v: k for k, v in self.vocab.items()}
+        self.lower = lower_case
+        self.unk = unk_token
+        # max 100 matches the published WordPiece (longer words -> [UNK])
+        self.max_word_chars = max_word_chars
+        # special tokens survive basic tokenization intact
+        self.never_split = set(never_split if never_split is not None else
+                               ("[UNK]", "[SEP]", "[PAD]", "[CLS]",
+                                "[MASK]"))
+
+    # -- basic tokenizer ---------------------------------------------------
+
+    def _basic_split(self, text: str) -> list[str]:
+        text = unicodedata.normalize("NFC", text)
+        out = []
+        for ch in text:
+            cp = ord(ch)
+            # whitespace check must precede the control-category check:
+            # \t \n \r are category Cc but are separators, not deletions
+            if ch.isspace() or ch in "\t\n\r":
+                out.append(" ")
+            elif cp == 0 or cp == 0xFFFD or unicodedata.category(ch) in (
+                    "Cc", "Cf"):
+                continue
+            elif _is_cjk(cp):
+                out.append(f" {ch} ")
+            else:
+                out.append(ch)
+        words = "".join(out).split()
+        split = []
+        for w in words:
+            # special tokens pass through basic tokenization untouched
+            # (BasicTokenizer never_split behavior)
+            if w in self.never_split:
+                split.append(w)
+                continue
+            if self.lower:
+                w = w.lower()
+                w = "".join(c for c in unicodedata.normalize("NFD", w)
+                            if unicodedata.category(c) != "Mn")
+            # split punctuation into standalone tokens
+            cur = []
+            for ch in w:
+                if _is_punctuation(ch):
+                    if cur:
+                        split.append("".join(cur))
+                        cur = []
+                    split.append(ch)
+                else:
+                    cur.append(ch)
+            if cur:
+                split.append("".join(cur))
+        return split
+
+    # -- wordpiece ---------------------------------------------------------
+
+    def _wordpiece(self, word: str) -> list[str]:
+        if len(word) > self.max_word_chars:
+            return [self.unk]
+        pieces = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+    def encode(self, text: str) -> list[int]:
+        ids = []
+        for word in self._basic_split(text):
+            for piece in self._wordpiece(word):
+                ids.append(self.vocab[piece])
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        toks = [self.inv_vocab[int(i)] for i in ids]
+        out = []
+        for t in toks:
+            if t.startswith("##") and out:
+                out[-1] = out[-1] + t[2:]
+            else:
+                out.append(t)
+        return " ".join(out)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)

@@ -88,6 +88,90 @@ class HFTokenizer(Tokenizer):
         return self.eod
 
 
+class GPT2BPENativeTokenizer(Tokenizer):
+    """Native vocab.json + merges.txt byte-level BPE (reference
+    _GPT2BPETokenizer over gpt2_tokenization.py — no ``transformers``
+    dependency).  ``path`` is a directory containing both files, or
+    ``vocab.json,merges.txt``."""
+
+    def __init__(self, path: str):
+        import os
+
+        from .bpe import GPT2BPETokenizer
+
+        if "," in path:
+            vocab_file, merges_file = path.split(",", 1)
+        else:
+            vocab_file = os.path.join(path, "vocab.json")
+            merges_file = os.path.join(path, "merges.txt")
+        self._t = GPT2BPETokenizer(vocab_file, merges_file)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._t.vocab_size
+
+    def tokenize(self, text: str) -> list[int]:
+        return self._t.encode(text)
+
+    def detokenize(self, ids) -> str:
+        return self._t.decode(ids)
+
+    @property
+    def eod(self) -> int:
+        enc = self._t.encoder
+        if "<|endoftext|>" in enc:
+            return enc["<|endoftext|>"]
+        return self.vocab_size - 1
+
+    @property
+    def pad(self) -> int:
+        return self.eod
+
+
+class WordPieceNativeTokenizer(Tokenizer):
+    """Native vocab.txt WordPiece (reference _BertWordPieceTokenizer over
+    bert_tokenization.py).  Exposes cls/sep/mask for the BERT/ICT data
+    pipelines."""
+
+    def __init__(self, vocab_file: str, lower_case: bool = True):
+        from .bpe import WordPieceTokenizer
+
+        self._t = WordPieceTokenizer(vocab_file, lower_case=lower_case)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._t.vocab_size
+
+    def tokenize(self, text: str) -> list[int]:
+        return self._t.encode(text)
+
+    def detokenize(self, ids) -> str:
+        return self._t.decode(ids)
+
+    def _id(self, token: str) -> int:
+        return self._t.vocab[token]
+
+    @property
+    def cls(self) -> int:
+        return self._id("[CLS]")
+
+    @property
+    def sep(self) -> int:
+        return self._id("[SEP]")
+
+    @property
+    def mask(self) -> int:
+        return self._id("[MASK]")
+
+    @property
+    def pad(self) -> int:
+        return self._id("[PAD]")
+
+    @property
+    def eod(self) -> int:
+        return self.sep
+
+
 class SentencePieceTokenizer(Tokenizer):
     """Llama .model tokenizer (reference _SentencePieceTokenizer,
     tokenizer.py:326-497)."""
@@ -216,6 +300,16 @@ def build_tokenizer(tokenizer_type: str, tokenizer_model: Optional[str] = None,
         return HFTokenizer(tokenizer_model, vocab_extra_ids_list)
     if t in ("gpt2", "gpt2bpetokenizer"):
         return HFTokenizer(tokenizer_model or "gpt2")
+    if t in ("gpt2-bpe", "gpt2bpe"):
+        assert tokenizer_model, ("native GPT-2 BPE needs a dir with "
+                                 "vocab.json+merges.txt (or 'vocab,merges')")
+        return GPT2BPENativeTokenizer(tokenizer_model)
+    if t in ("bert-wordpiece", "wordpiece", "bertwordpiecelowercase"):
+        assert tokenizer_model, "WordPiece needs a vocab.txt path"
+        return WordPieceNativeTokenizer(tokenizer_model)
+    if t in ("bertwordpiececase",):
+        assert tokenizer_model, "WordPiece needs a vocab.txt path"
+        return WordPieceNativeTokenizer(tokenizer_model, lower_case=False)
     if t in ("null", "nulltokenizer"):
         return NullTokenizer(vocab_size)
     raise ValueError(f"unknown tokenizer type {tokenizer_type!r}")
