@@ -1,0 +1,14 @@
+#!/bin/bash
+# ORQA retriever eval on Natural Questions: top-k retrieval accuracy
+# against gold answers (reference: examples/evaluate_retriever_nq.sh).
+# The question embeddings come from the biencoder query tower; the
+# evidence embeddings from the REALM indexer (pretrain_ict.py →
+# models/realm_indexer.py).
+set -euo pipefail
+
+python -m megatron_llm_tpu.tasks.main --task orqa \
+    --qa_file "${NQ:-data/nq-dev.tsv}" \
+    --evidence_texts "${EVIDENCE:-data/wiki_blocks.jsonl}" \
+    --embedding_path "${EMBED:-data/block_embeds.npz}" \
+    --query_embeds "${QUERIES:-data/nq_query_embeds.npy}" \
+    --top_ks 1 5 20 100 --match_type string

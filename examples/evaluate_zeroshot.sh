@@ -1,0 +1,17 @@
+#!/bin/bash
+# Zero-shot LM eval: WikiText-103 perplexity and LAMBADA accuracy
+# (reference: examples/evaluate_zeroshot_gpt.sh + tasks/zeroshot_gpt/).
+set -euo pipefail
+
+CKPT=${CKPT:-ckpts/llama2-7b}
+TOKENIZER=${TOKENIZER:-tokenizer.model}
+
+python -m megatron_llm_tpu.tasks.main --task wikitext \
+    --load "$CKPT" --tokenizer_type sentencepiece \
+    --tokenizer_model "$TOKENIZER" \
+    --data_path "${WIKITEXT:-data/wikitext-103/wiki.test.tokens}"
+
+python -m megatron_llm_tpu.tasks.main --task lambada \
+    --load "$CKPT" --tokenizer_type sentencepiece \
+    --tokenizer_model "$TOKENIZER" \
+    --data_path "${LAMBADA:-data/lambada_test.jsonl}"

@@ -1,0 +1,16 @@
+#!/bin/bash
+# MNLI classification finetune from a pretrained BERT release checkpoint
+# (reference: examples/finetune_mnli_distributed.sh + tasks/glue/mnli.py).
+# Expects the GLUE MNLI distribution's TSV files as shipped.
+set -euo pipefail
+
+DATA=${DATA:-data/MNLI}
+BERT_CKPT=${BERT_CKPT:-ckpts/bert-base}
+
+python -m megatron_llm_tpu.tasks.main --task mnli \
+    --train_data "$DATA/train.tsv" \
+    --valid_data "$DATA/dev_matched.tsv" \
+    --pretrained_checkpoint "$BERT_CKPT" \
+    --tokenizer_model bert-base-uncased \
+    --seq_length 128 --epochs 3 \
+    --micro_batch_size 8 --global_batch_size 32 --lr 2e-5

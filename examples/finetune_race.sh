@@ -1,0 +1,16 @@
+#!/bin/bash
+# RACE multiple-choice finetune (reference:
+# examples/finetune_race_distributed.sh + tasks/race/data.py).  Data dirs
+# contain the RACE distribution's .txt JSON-lines files.
+set -euo pipefail
+
+DATA=${DATA:-data/RACE}
+BERT_CKPT=${BERT_CKPT:-ckpts/bert-base}
+
+python -m megatron_llm_tpu.tasks.main --task race \
+    --train_data "$DATA/train/middle" "$DATA/train/high" \
+    --valid_data "$DATA/dev/middle" "$DATA/dev/high" \
+    --pretrained_checkpoint "$BERT_CKPT" \
+    --tokenizer_model bert-base-uncased \
+    --seq_length 512 --max_qa_length 128 --epochs 3 \
+    --micro_batch_size 4 --global_batch_size 16 --lr 1e-5
