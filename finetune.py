@@ -146,6 +146,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     g.add_argument("--tensorboard_dir", default=None)
     g.add_argument("--wandb_project", default=None)
     g.add_argument("--wandb_name", default=None)
+    g.add_argument("--profile_dir", default=None,
+                   help="write a jax.profiler device trace of a few "
+                        "steady-state iterations here (TensorBoard "
+                        "profile plugin viewable)")
+    g.add_argument("--profile_step_start", type=int, default=11)
+    g.add_argument("--profile_step_end", type=int, default=13)
     g.add_argument("--exit_interval", type=int, default=None)
     g.add_argument("--exit_duration_mins", type=float, default=None)
 
@@ -250,6 +256,9 @@ def build_config(args):
         wandb_project=args.wandb_project,
         wandb_name=args.wandb_name,
         exit_interval=args.exit_interval,
+        profile_dir=args.profile_dir,
+        profile_step_start=args.profile_step_start,
+        profile_step_end=args.profile_step_end,
         exit_duration_mins=args.exit_duration_mins,
         data_path=args.data_path,
         split=args.split,

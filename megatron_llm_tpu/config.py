@@ -357,6 +357,14 @@ class TrainConfig:
     # iterations whose fwd/bwd is skipped (fault injection;
     # reference: --skip_iters, megatron/training.py:397-399)
     skip_iters: Sequence[int] = ()
+    # jax.profiler trace window: write a TensorBoard-viewable device
+    # profile of iterations [profile_step_start, profile_step_end] to
+    # profile_dir.  The TPU-idiomatic deep-dive the reference leaves to
+    # external nsys (SURVEY §5 notes no in-tree integration); the
+    # steady-state default [11, 13] skips compile/warmup iterations.
+    profile_dir: Optional[str] = None
+    profile_step_start: int = 11
+    profile_step_end: int = 13
 
 
 @dataclass(frozen=True)

@@ -562,10 +562,21 @@ def pretrain(
     log = _LogState()
     skip_set = set(cfg.train.skip_iters)
     exit_reason = None
+    profiling = False
+
+    def _close_profiler():
+        nonlocal profiling
+        if profiling:
+            # closes on every exit path — incl. exceptions mid-window,
+            # where the partial capture is exactly what's needed
+            jax.profiler.stop_trace()
+            profiling = False
+            print_rank_0(" profiler: trace written (closed at loop exit)")
 
     print_rank_0(f" training starts at iteration {iteration} / "
                  f"{cfg.train.train_iters}")
     with DistSignalHandler() as sig, art.mesh:
+      try:
         while iteration < cfg.train.train_iters:
             # fault injection: --skip_iters (training.py:397-399,422-426)
             if (iteration + 1) in skip_set:
@@ -601,10 +612,29 @@ def pretrain(
             dev_batch = _put_batch(batch, art.batch_sharding)
             timers("batch-generator").stop()
 
+            # profiler window (config: profile_dir + step range); started
+            # before and stopped after the step so each traced iteration
+            # is complete in the capture.  The upper bound keeps resumed
+            # runs (starting past the window) from writing stray traces.
+            if (cfg.train.profile_dir and not profiling
+                    and cfg.train.profile_step_start <= iteration + 1
+                    <= cfg.train.profile_step_end):
+                jax.profiler.start_trace(cfg.train.profile_dir)
+                profiling = True
+                print_rank_0(
+                    f" profiler: tracing iterations "
+                    f"{iteration + 1}..{cfg.train.profile_step_end} "
+                    f"-> {cfg.train.profile_dir}")
+
             timers("train-step", log_level=0).start()
             state, step_metrics = art.step_fn(state, dev_batch, base_rng)
             step_metrics = jax.device_get(step_metrics)
             timers("train-step").stop(wait_for=step_metrics)
+
+            if profiling and iteration + 1 >= cfg.train.profile_step_end:
+                jax.profiler.stop_trace()
+                profiling = False
+                print_rank_0(" profiler: trace written")
 
             iteration += 1
             consumed_samples += current_gbs
@@ -654,6 +684,8 @@ def pretrain(
                     exit_reason = "exit_duration"
             if exit_reason:
                 break
+      finally:
+        _close_profiler()
 
     if exit_reason:
         print_rank_0(f" exiting at iteration {iteration}: {exit_reason}")

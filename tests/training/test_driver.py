@@ -139,3 +139,38 @@ def test_timers():
     w = W()
     t.write(w, iteration=5)
     assert any(r[0] == "timers/b" for r in w.rows)
+
+
+def test_profile_window_writes_trace(tmp_path):
+    """--profile_dir: a jax.profiler capture of the configured step window
+    lands on disk (and an end-past-train_iters window still closes)."""
+    import os
+
+    prof = tmp_path / "prof"
+    cfg = _cfg(tmp_path, train_iters=3, save=None, eval_interval=1000,
+               profile_dir=str(prof), profile_step_start=2,
+               profile_step_end=10)  # end past train_iters: loop-exit close
+    ds = MockDataset(cfg.model.vocab_size, cfg.train.seq_length)
+    state = pretrain(cfg, ds)
+    assert int(state.iteration) == 3
+    traces = []
+    for root, _, files in os.walk(prof):
+        traces += [f for f in files if "xplane" in f or "trace" in f]
+    assert traces, "no profiler capture written"
+
+
+def test_profile_window_not_retriggered_on_resume(tmp_path):
+    """Resuming past the profile window must not write a stray trace."""
+    import os
+
+    cfg = _cfg(tmp_path, train_iters=2, save_interval=2)
+    ds = MockDataset(cfg.model.vocab_size, cfg.train.seq_length)
+    pretrain(cfg, ds)
+    prof = tmp_path / "prof_resume"
+    cfg2 = _cfg(tmp_path, train_iters=4, save_interval=100,
+                load=str(tmp_path / "ckpt"), profile_dir=str(prof),
+                profile_step_start=1, profile_step_end=2)  # before resume pt
+    state = pretrain(cfg2, ds)
+    assert int(state.iteration) == 4
+    assert not prof.exists() or not any(
+        f for _, _, fs in os.walk(prof) for f in fs)
