@@ -564,20 +564,42 @@ def pretrain(
     exit_reason = None
     profiling = False
 
-    def _close_profiler():
+    def _close_profiler(reason: str = "closed at loop exit"):
         nonlocal profiling
         if profiling:
             # closes on every exit path — incl. exceptions mid-window,
             # where the partial capture is exactly what's needed
             jax.profiler.stop_trace()
             profiling = False
-            print_rank_0(" profiler: trace written (closed at loop exit)")
+            print_rank_0(f" profiler: trace written ({reason})")
+
+    def _maybe_start_profiler(next_it: int):
+        """Open the trace when entering the configured window.  Called on
+        BOTH the normal and the skip-iteration paths (a window overlapping
+        --skip_iters must still open/close at the right steps).  The upper
+        bound keeps resumed runs (starting past the window) from writing
+        stray traces."""
+        nonlocal profiling
+        if (cfg.train.profile_dir and not profiling
+                and cfg.train.profile_step_start <= next_it
+                <= cfg.train.profile_step_end):
+            jax.profiler.start_trace(cfg.train.profile_dir)
+            profiling = True
+            print_rank_0(
+                f" profiler: tracing iterations "
+                f"{next_it}..{cfg.train.profile_step_end} "
+                f"-> {cfg.train.profile_dir}")
+
+    def _maybe_stop_profiler(done_it: int):
+        if profiling and done_it >= cfg.train.profile_step_end:
+            _close_profiler("window complete")
 
     print_rank_0(f" training starts at iteration {iteration} / "
                  f"{cfg.train.train_iters}")
     with DistSignalHandler() as sig, art.mesh:
       try:
         while iteration < cfg.train.train_iters:
+            _maybe_start_profiler(iteration + 1)
             # fault injection: --skip_iters (training.py:397-399,422-426)
             if (iteration + 1) in skip_set:
                 try:
@@ -592,6 +614,7 @@ def pretrain(
                     iteration=state.iteration + jnp.int32(1))
                 print_rank_0(f" skipping iteration {iteration} (fault "
                              "injection)")
+                _maybe_stop_profiler(iteration)
                 continue
 
             # batch-size ramp: rebuild the iterator (and step shapes) on rung
@@ -612,29 +635,16 @@ def pretrain(
             dev_batch = _put_batch(batch, art.batch_sharding)
             timers("batch-generator").stop()
 
-            # profiler window (config: profile_dir + step range); started
-            # before and stopped after the step so each traced iteration
-            # is complete in the capture.  The upper bound keeps resumed
-            # runs (starting past the window) from writing stray traces.
-            if (cfg.train.profile_dir and not profiling
-                    and cfg.train.profile_step_start <= iteration + 1
-                    <= cfg.train.profile_step_end):
-                jax.profiler.start_trace(cfg.train.profile_dir)
-                profiling = True
-                print_rank_0(
-                    f" profiler: tracing iterations "
-                    f"{iteration + 1}..{cfg.train.profile_step_end} "
-                    f"-> {cfg.train.profile_dir}")
-
             timers("train-step", log_level=0).start()
             state, step_metrics = art.step_fn(state, dev_batch, base_rng)
             step_metrics = jax.device_get(step_metrics)
             timers("train-step").stop(wait_for=step_metrics)
 
-            if profiling and iteration + 1 >= cfg.train.profile_step_end:
-                jax.profiler.stop_trace()
-                profiling = False
-                print_rank_0(" profiler: trace written")
+            # stop right after the window's last step, BEFORE the eval /
+            # save hooks below, so the capture is steady-state train steps
+            # (note: a hook firing on a non-final in-window iteration is
+            # still captured — pick a window clear of eval/save intervals)
+            _maybe_stop_profiler(iteration + 1)
 
             iteration += 1
             consumed_samples += current_gbs

@@ -161,3 +161,41 @@ def test_crlf_vocab_files_parse_identically(tmp_path):
     wa = WordPieceTokenizer(bvf)
     wb = WordPieceTokenizer(str(crlf_v))
     assert wa.vocab == wb.vocab
+
+
+def test_gpt2_bpe_randomized_parity(tmp_path):
+    """200 randomized strings (mixed scripts, numbers, punctuation,
+    whitespace runs) must encode identically to transformers."""
+    import random
+
+    vf, mf = _make_gpt2_files(tmp_path)
+    transformers = pytest.importorskip("transformers")
+    hf = transformers.GPT2Tokenizer(vocab_file=vf, merges_file=mf)
+    ours = GPT2BPETokenizer(vf, mf)
+    rng = random.Random(1234)
+    pieces = ["hello", "world", "the", "don't", "123", "²", "½", "¡",
+              "é", "ß", "中", ",", ".", "!", "  ", " ", "\n", "\t", "--"]
+    for _ in range(200):
+        s = "".join(rng.choice(pieces)
+                    for _ in range(rng.randrange(0, 12)))
+        got, want = ours.encode(s), hf.encode(s, add_special_tokens=False)
+        assert got == want, (repr(s), got, want)
+        assert ours.decode(got) == hf.decode(want), repr(s)
+
+
+def test_wordpiece_randomized_parity(tmp_path):
+    vf = _make_bert_vocab(tmp_path)
+    transformers = pytest.importorskip("transformers")
+    hf = transformers.BertTokenizer(vocab_file=vf, do_lower_case=True)
+    ours = WordPieceTokenizer(vf, lower_case=True)
+    import random
+
+    rng = random.Random(99)
+    pieces = ["the", "quick", "Fox", "jumps", "unbelievable", "café",
+              "12345", "[MASK]", "zzz", ",", "!", "?", " ", "\t", "\n",
+              "'", "over-the", "dog."]
+    for _ in range(200):
+        s = " ".join(rng.choice(pieces)
+                     for _ in range(rng.randrange(0, 10)))
+        got, want = ours.encode(s), hf.encode(s, add_special_tokens=False)
+        assert got == want, (repr(s), got, want)

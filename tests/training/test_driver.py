@@ -174,3 +174,21 @@ def test_profile_window_not_retriggered_on_resume(tmp_path):
     assert int(state.iteration) == 4
     assert not prof.exists() or not any(
         f for _, _, fs in os.walk(prof) for f in fs)
+
+
+def test_profile_window_with_skip_iters(tmp_path):
+    """A profile window overlapping --skip_iters must still open and
+    close correctly (skipped steps bypass the train step but not the
+    profiler bookkeeping)."""
+    import os
+
+    prof = tmp_path / "prof_skip"
+    cfg = _cfg(tmp_path, train_iters=4, save=None, eval_interval=1000,
+               skip_iters=(2, 3), profile_dir=str(prof),
+               profile_step_start=2, profile_step_end=3)
+    ds = MockDataset(cfg.model.vocab_size, cfg.train.seq_length)
+    state = pretrain(cfg, ds)
+    assert int(state.iteration) == 4
+    traces = [f for _, _, fs in os.walk(prof) for f in fs
+              if "xplane" in f or "trace" in f]
+    assert traces, "window over skipped iterations never closed/wrote"
