@@ -46,8 +46,8 @@ def parse_args(argv=None) -> argparse.Namespace:
 
     g = p.add_argument_group("model")
     g.add_argument("--model", default="llama2",
-                   choices=["llama", "llama2", "codellama", "falcon", "gpt",
-                            "tiny"])
+                   choices=["llama", "llama2", "llama3", "codellama",
+                            "falcon", "gpt", "tiny"])
     g.add_argument("--model_size", default="7b")
     g.add_argument("--seq_length", type=int, default=None)
     g.add_argument("--rope_scaling_factor", type=float, default=1.0)
@@ -171,6 +171,7 @@ def build_config(args):
         gpt_config,
         llama1_config,
         llama2_config,
+        llama3_config,
         tiny_config,
     )
 
@@ -202,6 +203,7 @@ def build_config(args):
     builders = {
         "llama": lambda: llama1_config(args.model_size, **overrides),
         "llama2": lambda: llama2_config(args.model_size, **overrides),
+        "llama3": lambda: llama3_config(args.model_size, **overrides),
         "codellama": lambda: codellama_config(args.model_size, **overrides),
         "falcon": lambda: falcon_config(args.model_size, **overrides),
         "gpt": lambda: gpt_config(args.model_size, **overrides),

@@ -550,6 +550,35 @@ def codellama_config(size: str = "34b", **overrides) -> ModelConfig:
     return llama2_config("7b", **base)
 
 
+def llama3_config(size: str = "8b", **overrides) -> ModelConfig:
+    """Llama-3 (beyond the reference's family list, but free here: GQA,
+    configurable rope_theta and the 128k-token tokenizer vocab are all
+    existing capabilities).  Llama-3.1's piecewise ("llama3"-type) RoPE
+    scaling is NOT implemented — only linear position-interpolation
+    scaling exists (rope_scaling_factor), so 3.1 long-context checkpoints
+    would produce divergent logits; use the base 8k-context models."""
+    base = dict(
+        vocab_size=128256,
+        rope_theta=500000.0,
+        max_position_embeddings=8192,
+        seq_length=8192,
+        make_vocab_size_divisible_by=128,
+    )
+    sizes = {
+        "8b": dict(hidden_size=4096, num_layers=32, num_attention_heads=32,
+                   num_kv_heads=8, ffn_hidden_size=14336),
+        "70b": dict(hidden_size=8192, num_layers=80,
+                    num_attention_heads=64, num_kv_heads=8,
+                    ffn_hidden_size=28672),
+    }
+    if size not in sizes:
+        raise KeyError(f"unknown llama-3 size {size!r} "
+                       f"(have {sorted(sizes)}; pass --model_size 8b)")
+    base.update(sizes[size])
+    base.update(overrides)
+    return llama2_config("7b", **base)
+
+
 def falcon_config(size: str = "7b", **overrides) -> ModelConfig:
     """Falcon: MQA/GQA, parallel attention, LayerNorm, gelu, rotary
     (reference: megatron/model/falcon_model.py:18-29)."""
@@ -628,6 +657,8 @@ PRESETS = {
     "llama2-13b": lambda: llama2_config("13b"),
     "llama2-70b": lambda: llama2_config("70b"),
     "llama1-7b": lambda: llama1_config("7b"),
+    "llama3-8b": lambda: llama3_config("8b"),
+    "llama3-70b": lambda: llama3_config("70b"),
     "codellama-7b": lambda: codellama_config("7b"),
     "codellama-34b": lambda: codellama_config("34b"),
     "falcon-7b": lambda: falcon_config("7b"),

@@ -63,19 +63,34 @@ def _encode_instruction(line: str):
     from ..data.instruction_dataset import Role
 
     data = json.loads(line)
-    turns = data.get("conversation") or data.get("messages")
+    # explicit key precedence (an `or`-chain would misroute records whose
+    # first-listed key holds an empty list)
+    turns = next((data[k] for k in ("conversation", "messages",
+                                    "conversations") if k in data), None)
+    if turns is None:
+        raise ValueError(
+            "instruction record needs a 'conversation' / 'messages' / "
+            f"'conversations' turn list; record keys: {sorted(data)}")
     text_ids: list[int] = []
     role_ids: list[int] = []
     if _worker_tok.bos is not None:
         text_ids.append(_worker_tok.bos)
         role_ids.append(int(Role.system))
     for turn in turns:
-        role_name = turn.get("role", "prompter")
+        # role: OpenAI/OASST "role" or ShareGPT "from" naming
+        role_name = turn.get("role") or turn.get("from") or "prompter"
         role = {"system": Role.system, "user": Role.prompter,
-                "prompter": Role.prompter,
-                "assistant": Role.assistant}.get(role_name, Role.prompter)
-        ids = _worker_tok.tokenize(turn["text"] if "text" in turn
-                                   else turn["content"])
+                "human": Role.prompter, "prompter": Role.prompter,
+                "assistant": Role.assistant,
+                "gpt": Role.assistant}.get(role_name, Role.prompter)
+        # text: "text" (OASST) / "content" (OpenAI) / "value" (ShareGPT)
+        text = next((turn[k] for k in ("text", "content", "value")
+                     if k in turn), None)
+        if text is None:
+            raise ValueError(
+                f"instruction turn needs 'text'/'content'/'value'; "
+                f"turn keys: {sorted(turn)}")
+        ids = _worker_tok.tokenize(text)
         if role == Role.assistant and _worker_args.append_eod:
             ids = list(ids) + [_worker_tok.eod]
         text_ids.extend(ids)

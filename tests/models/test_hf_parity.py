@@ -205,3 +205,45 @@ def test_gpt2_parity():
     tokens = np.random.default_rng(3).integers(0, 128, (2, 32))
     diff = _max_abs_diff(cfg, params, hf_model, tokens)
     assert diff < 2e-4, f"gpt2 logit diff {diff}"
+
+
+def test_llama3_shape_parity():
+    """Llama-3-style config (GQA 4:1, rope_theta 500k, big-vocab padding)
+    through config_from_hf + the converter: logit parity vs transformers.
+    The llama3 preset itself is just these capabilities at size."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=112,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=500000.0,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    cfg = hf_interop.config_from_hf(
+        hf_cfg, "llama", params_dtype="float32", attention_impl="dot",
+        recompute="none", seq_length=64)
+    assert cfg.rope_theta == 500000.0 and cfg.kv_heads == 2
+    params = hf_interop.llama_from_hf(hf_model.state_dict(), cfg)
+    tokens = np.random.default_rng(5).integers(0, 256, (2, 48))
+    diff = _max_abs_diff(cfg, params, hf_model, tokens)
+    assert diff < 2e-4, f"llama3-shape logit diff {diff}"
+
+
+def test_llama3_preset():
+    from megatron_llm_tpu.config import llama3_config
+
+    cfg = llama3_config("8b")
+    assert cfg.hidden_size == 4096 and cfg.kv_heads == 8
+    assert cfg.rope_theta == 500000.0 and cfg.vocab_size == 128256
+    assert cfg.ffn_size == 14336
+    cfg70 = llama3_config("70b", seq_length=4096,
+                          max_position_embeddings=4096)
+    assert cfg70.num_layers == 80 and cfg70.kv_heads == 8
