@@ -80,9 +80,16 @@ class ModelConfig:
     # positions
     position_embedding_type: str = PositionEmbeddingType.ROTARY
     rope_theta: float = 10000.0
-    # Linear position-interpolation RoPE scaling (Code-Llama long context;
-    # reference: megatron/model/positional_embeddings.py:7-13).
+    # RoPE scaling: "linear" position interpolation (Code-Llama long
+    # context; reference: megatron/model/positional_embeddings.py:7-13)
+    # or "llama3" piecewise frequency scaling (Llama-3.1 — extension
+    # beyond the reference).  The llama3 fields mirror HF's rope_scaling
+    # dict and are ignored under "linear".
     rope_scaling_factor: float = 1.0
+    rope_scaling_type: str = "linear"
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_positions: Optional[int] = None
     # structure flags
     use_bias: bool = False  # bias on linear layers (GPT yes, Llama no)
     qkv_bias: bool = False  # Falcon-7B style attention bias
@@ -551,12 +558,12 @@ def codellama_config(size: str = "34b", **overrides) -> ModelConfig:
 
 
 def llama3_config(size: str = "8b", **overrides) -> ModelConfig:
-    """Llama-3 (beyond the reference's family list, but free here: GQA,
-    configurable rope_theta and the 128k-token tokenizer vocab are all
-    existing capabilities).  Llama-3.1's piecewise ("llama3"-type) RoPE
-    scaling is NOT implemented — only linear position-interpolation
-    scaling exists (rope_scaling_factor), so 3.1 long-context checkpoints
-    would produce divergent logits; use the base 8k-context models."""
+    """Llama-3 (beyond the reference's family list, but mostly free
+    here: GQA, configurable rope_theta and the 128k-token tokenizer
+    vocab are existing capabilities).  Llama-3.1 long-context
+    checkpoints are supported via ``rope_scaling_type="llama3"``
+    (piecewise frequency scaling, ops/rope.py:llama3_scaled_inv_freq) —
+    config_from_hf maps the HF rope_scaling dict automatically."""
     base = dict(
         vocab_size=128256,
         rope_theta=500000.0,

@@ -486,5 +486,9 @@ def rope_tables(cfg: ModelConfig, dtype=jnp.float32):
         cfg.max_position_embeddings,
         theta=cfg.rope_theta,
         scaling_factor=cfg.rope_scaling_factor,
+        scaling_type=cfg.rope_scaling_type,
+        low_freq_factor=cfg.rope_low_freq_factor,
+        high_freq_factor=cfg.rope_high_freq_factor,
+        original_max_positions=cfg.rope_original_max_positions,
         dtype=dtype,
     )

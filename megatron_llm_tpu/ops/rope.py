@@ -17,23 +17,68 @@ import jax
 import jax.numpy as jnp
 
 
+def llama3_scaled_inv_freq(
+    inv_freq: jax.Array,
+    factor: float,
+    low_freq_factor: float,
+    high_freq_factor: float,
+    original_max_positions: int,
+) -> jax.Array:
+    """Llama-3.1's piecewise frequency scaling (beyond the reference,
+    which only has linear PI): frequencies whose wavelength exceeds the
+    original context are slowed by ``factor``, high frequencies are kept,
+    and the band between interpolates smoothly by how many times the
+    wavelength fits in the original context."""
+    import numpy as np
+
+    wavelen = 2.0 * np.pi / inv_freq
+    low_wavelen = original_max_positions / low_freq_factor
+    high_wavelen = original_max_positions / high_freq_factor
+    smooth = (original_max_positions / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    interp = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    out = jnp.where(wavelen > low_wavelen, inv_freq / factor, interp)
+    return jnp.where(wavelen < high_wavelen, inv_freq, out)
+
+
 def precompute_rope_freqs(
     head_dim: int,
     max_positions: int,
     theta: float = 10000.0,
     scaling_factor: float = 1.0,
+    scaling_type: str = "linear",
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_positions: int | None = None,
     dtype=jnp.float32,
 ) -> tuple[jax.Array, jax.Array]:
     """Return (cos, sin), each [max_positions, head_dim//2].
 
-    Parity: megatron/model/positional_embeddings.py:7-13 — including the
-    linear position interpolation ``t / scaling_factor`` used for 16k/32k
-    Code-Llama contexts.
+    ``scaling_type='linear'``: position interpolation ``t / factor``
+    (parity: megatron/model/positional_embeddings.py:7-13, the 16k/32k
+    Code-Llama mode).  ``scaling_type='llama3'``: Llama-3.1's piecewise
+    frequency transform (positions unscaled).
     """
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
-    t = jnp.arange(max_positions, dtype=jnp.float32) / scaling_factor
+    if scaling_type == "llama3":
+        if scaling_factor != 1.0:
+            if not original_max_positions:
+                # ValueError (not assert): must fail early and survive -O
+                raise ValueError(
+                    "llama3 rope scaling needs original_max_positions "
+                    "(the pre-extension context length)")
+            inv_freq = llama3_scaled_inv_freq(
+                inv_freq, scaling_factor, low_freq_factor,
+                high_freq_factor, original_max_positions)
+        t = jnp.arange(max_positions, dtype=jnp.float32)
+    elif scaling_type == "linear":
+        t = jnp.arange(max_positions, dtype=jnp.float32) / scaling_factor
+    else:
+        raise ValueError(f"unknown rope scaling_type {scaling_type!r} "
+                         "(want 'linear' | 'llama3')")
     freqs = jnp.outer(t, inv_freq)  # [pos, dim/2]
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 

@@ -575,6 +575,30 @@ def config_from_hf(hf_config, family: str | None = None,
     mt = family or getattr(hf_config, "model_type", None)
     if mt in ("llama", "code_llama"):
         scaling = getattr(hf_config, "rope_scaling", None) or {}
+        stype = scaling.get("rope_type") or scaling.get("type") or "linear"
+        rope_fields = {}
+        if stype in ("linear", "default") or not scaling:
+            # "default" is transformers' normalized spelling of
+            # "no scaling" (a factor would be ignored by HF too)
+            rope_fields["rope_scaling_factor"] = float(
+                scaling.get("factor", 1.0)) if stype == "linear" else 1.0
+        elif stype == "llama3":
+            rope_fields.update(
+                rope_scaling_type="llama3",
+                rope_scaling_factor=float(scaling["factor"]),
+                rope_low_freq_factor=float(
+                    scaling.get("low_freq_factor", 1.0)),
+                rope_high_freq_factor=float(
+                    scaling.get("high_freq_factor", 4.0)),
+                rope_original_max_positions=int(
+                    scaling["original_max_position_embeddings"]),
+            )
+        else:
+            # silently mapping e.g. yarn/dynamic onto linear PI would
+            # import a checkpoint that produces divergent logits
+            raise ValueError(
+                f"unsupported rope_scaling type {stype!r} "
+                "(supported: linear, llama3)")
         fields = dict(
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
@@ -587,9 +611,9 @@ def config_from_hf(hf_config, family: str | None = None,
             norm_eps=hf_config.rms_norm_eps,
             activation="swiglu",
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
-            rope_scaling_factor=float(scaling.get("factor", 1.0)),
             tie_embed_logits=bool(getattr(hf_config, "tie_word_embeddings",
                                           False)),
+            **rope_fields,
         )
     elif mt == "falcon":
         # Only the RoPE, bias-free Falcon variants (7b/40b lineage) are

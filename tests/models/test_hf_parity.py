@@ -247,3 +247,56 @@ def test_llama3_preset():
     cfg70 = llama3_config("70b", seq_length=4096,
                           max_position_embeddings=4096)
     assert cfg70.num_layers == 80 and cfg70.kv_heads == 8
+
+
+def test_llama31_rope_scaling_parity():
+    """Llama-3.1 piecewise ("llama3"-type) RoPE scaling: logit parity vs
+    transformers on a scaled-context config (original ctx 32 -> 64,
+    factor 8) — an extension beyond the reference's linear-PI-only
+    scaling."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=112,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=500000.0,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 32,
+        },
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(11)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    cfg = hf_interop.config_from_hf(
+        hf_cfg, "llama", params_dtype="float32", attention_impl="dot",
+        recompute="none", seq_length=64)
+    assert cfg.rope_scaling_type == "llama3"
+    assert cfg.rope_original_max_positions == 32
+    params = hf_interop.llama_from_hf(hf_model.state_dict(), cfg)
+    tokens = np.random.default_rng(7).integers(0, 128, (2, 60))
+    diff = _max_abs_diff(cfg, params, hf_model, tokens)
+    assert diff < 2e-4, f"llama3.1 rope-scaling logit diff {diff}"
+
+
+def test_unsupported_rope_scaling_rejected():
+    """yarn/dynamic rope types must fail loudly, not silently import as
+    linear PI with divergent logits."""
+    import pytest as _pytest
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4,
+        rope_scaling={"rope_type": "dynamic", "factor": 2.0},
+    )
+    with _pytest.raises(ValueError, match="rope_scaling"):
+        hf_interop.config_from_hf(hf_cfg, "llama")
