@@ -12,7 +12,6 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 from pathlib import Path
 from typing import Optional
 
@@ -28,34 +27,22 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
 
 
-def _compile_library() -> bool:
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-             "-o", str(_LIB), str(_SRC)],
-            check=True, capture_output=True, timeout=120,
-        )
-        return True
-    except Exception:
-        return False
-
-
 def get_lib() -> Optional[ctypes.CDLL]:
-    """Load (compiling on demand) the native helper library, or None."""
+    """Load (compiling on demand) the native helper library, or None.
+
+    The fallback MUST be logged (utils/native.py does): it is the common
+    no-toolchain trigger, and the numpy path draws different RNG streams
+    → different sample composition (advisor finding)."""
     global _lib, _lib_tried
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
-        if not _compile_library():
-            # MUST log on this path too: it is the common fallback trigger
-            # (no toolchain), and the numpy path draws different RNG
-            # streams → different sample composition (advisor finding).
-            logger.info("index_helpers: using numpy fallback "
-                        "implementation (native compile unavailable)")
-            return None
+    from ..utils.native import compile_and_load
+
+    lib = compile_and_load(_SRC, _LIB)
+    if lib is None:
+        return None
     try:
-        lib = ctypes.CDLL(str(_LIB))
         lib.sample_idx_rows.restype = ctypes.c_int64
         lib.sample_idx_rows.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int64]

@@ -51,9 +51,15 @@ _GPT2_SPLIT = _regex.compile(
 
 
 class GPT2BPETokenizer:
-    """vocab.json + merges.txt byte-level BPE encoder/decoder."""
+    """vocab.json + merges.txt byte-level BPE encoder/decoder.
 
-    def __init__(self, vocab_file: str, merges_file: str):
+    The merge loop runs in the native C++ engine when available
+    (tokenizer/native_bpe.py, the corpus-preprocessing hot path) and
+    falls back to the pure-Python loop below otherwise — results are
+    identical (tests/data/test_native_tokenizers.py parity)."""
+
+    def __init__(self, vocab_file: str, merges_file: str,
+                 use_native: bool = True):
         with open(vocab_file, encoding="utf-8") as f:
             self.encoder: dict = json.load(f)
         self.decoder = {v: k for k, v in self.encoder.items()}
@@ -69,6 +75,15 @@ class GPT2BPETokenizer:
         self.byte_encoder = bytes_to_unicode()
         self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
         self._cache: dict = {}
+        self._id_cache: dict = {}  # pretoken -> ids (native path)
+        self._native = None
+        if use_native:
+            try:
+                from .native_bpe import NativeBPE
+
+                self._native = NativeBPE(self.encoder, ranks)
+            except Exception:
+                self._native = None
 
     def _bpe(self, token: str) -> list[str]:
         """Merge-loop: repeatedly join the lowest-rank adjacent pair."""
@@ -97,10 +112,32 @@ class GPT2BPETokenizer:
         return parts
 
     def encode(self, text: str) -> list[int]:
+        pretokens = [
+            "".join(self.byte_encoder[b] for b in tok.encode("utf-8"))
+            for tok in _GPT2_SPLIT.findall(text)
+        ]
+        if self._native is not None:
+            # id-cache in front of the engine: corpora are Zipfian, so
+            # most pretokens are repeats; the C++ merge loop only runs on
+            # cache misses (cold/rare tokens, where it is ~10x the Python
+            # loop), batched in one call.
+            cache = self._id_cache
+            misses = [t for t in pretokens if t not in cache]
+            if misses:
+                uniq = list(dict.fromkeys(misses))
+                try:
+                    flat, per = self._native.encode_pretokens(uniq)
+                    for i, t in enumerate(uniq):
+                        cache[t] = flat[per[i]:per[i + 1]]
+                except RuntimeError:  # unknown symbol: Python fallback
+                    for t in uniq:
+                        cache[t] = [self.encoder[p] for p in self._bpe(t)]
+            ids: list[int] = []
+            for t in pretokens:
+                ids.extend(cache[t])
+            return ids
         ids = []
-        for tok in _GPT2_SPLIT.findall(text):
-            mapped = "".join(self.byte_encoder[b]
-                             for b in tok.encode("utf-8"))
+        for mapped in pretokens:
             ids.extend(self.encoder[p] for p in self._bpe(mapped))
         return ids
 

@@ -199,3 +199,22 @@ def test_wordpiece_randomized_parity(tmp_path):
                      for _ in range(rng.randrange(0, 10)))
         got, want = ours.encode(s), hf.encode(s, add_special_tokens=False)
         assert got == want, (repr(s), got, want)
+
+
+def test_cpp_engine_matches_python_merge_loop(tmp_path):
+    """The C++ merge engine and the pure-Python loop must produce
+    identical ids (and both match transformers, covered above)."""
+    vf, mf = _make_gpt2_files(tmp_path)
+    native = GPT2BPETokenizer(vf, mf, use_native=True)
+    if native._native is None:
+        pytest.skip("native bpe engine unavailable (no toolchain)")
+    python = GPT2BPETokenizer(vf, mf, use_native=False)
+    import random
+
+    rng = random.Random(7)
+    pieces = ["hello", "world", "the", "123", " ", "é", "中", "!",
+              "<|endoftext|>", "x"]
+    for _ in range(300):
+        s = "".join(rng.choice(pieces)
+                    for _ in range(rng.randrange(0, 14)))
+        assert native.encode(s) == python.encode(s), repr(s)
