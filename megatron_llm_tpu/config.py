@@ -90,6 +90,11 @@ class ModelConfig:
     rope_low_freq_factor: float = 1.0
     rope_high_freq_factor: float = 4.0
     rope_original_max_positions: Optional[int] = None
+    # yarn-only knobs (extrapolation/interpolation rotation bounds and an
+    # explicit attention temperature override)
+    rope_beta_fast: float = 32.0
+    rope_beta_slow: float = 1.0
+    rope_attention_factor: Optional[float] = None
     # structure flags
     use_bias: bool = False  # bias on linear layers (GPT yes, Llama no)
     qkv_bias: bool = False  # Falcon-7B style attention bias

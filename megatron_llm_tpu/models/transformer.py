@@ -490,5 +490,8 @@ def rope_tables(cfg: ModelConfig, dtype=jnp.float32):
         low_freq_factor=cfg.rope_low_freq_factor,
         high_freq_factor=cfg.rope_high_freq_factor,
         original_max_positions=cfg.rope_original_max_positions,
+        beta_fast=cfg.rope_beta_fast,
+        beta_slow=cfg.rope_beta_slow,
+        attention_factor=cfg.rope_attention_factor,
         dtype=dtype,
     )

@@ -42,6 +42,49 @@ def llama3_scaled_inv_freq(
     return jnp.where(wavelen < high_wavelen, inv_freq, out)
 
 
+def yarn_scaled_inv_freq(
+    inv_freq: jax.Array,
+    factor: float,
+    beta_fast: float,
+    beta_slow: float,
+    original_max_positions: int,
+    head_dim: int,
+    theta: float,
+    attention_factor: float | None = None,
+) -> tuple[jax.Array, float]:
+    """YaRN (NTK-by-parts) frequency scaling → (inv_freq, cos/sin scale).
+
+    Dimensions rotating faster than ``beta_fast`` turns over the original
+    context keep their frequency (extrapolation); slower than
+    ``beta_slow`` are divided by ``factor`` (interpolation); a linear
+    ramp blends between.  The attention temperature ``0.1·ln(factor)+1``
+    folds into the cos/sin tables, matching HF's attention_scaling.
+    (arXiv 2309.00071; extension beyond the reference.)
+    """
+    import math
+
+    dim = head_dim
+
+    def correction_dim(n_rot):
+        return (dim * math.log(original_max_positions
+                               / (n_rot * 2 * math.pi))
+                ) / (2 * math.log(theta))
+
+    low = max(math.floor(correction_dim(beta_fast)), 0)
+    high = min(math.ceil(correction_dim(beta_slow)), dim - 1)
+    if low == high:
+        high += 0.001
+    ramp = jnp.clip(
+        (jnp.arange(dim // 2, dtype=jnp.float32) - low) / (high - low),
+        0.0, 1.0)
+    extrap_w = 1.0 - ramp
+    scaled = inv_freq / factor * (1.0 - extrap_w) + inv_freq * extrap_w
+    if attention_factor is None:
+        attention_factor = (0.1 * math.log(factor) + 1.0
+                            if factor > 1 else 1.0)
+    return scaled, float(attention_factor)
+
+
 def precompute_rope_freqs(
     head_dim: int,
     max_positions: int,
@@ -51,6 +94,9 @@ def precompute_rope_freqs(
     low_freq_factor: float = 1.0,
     high_freq_factor: float = 4.0,
     original_max_positions: int | None = None,
+    beta_fast: float = 32.0,
+    beta_slow: float = 1.0,
+    attention_factor: float | None = None,
     dtype=jnp.float32,
 ) -> tuple[jax.Array, jax.Array]:
     """Return (cos, sin), each [max_positions, head_dim//2].
@@ -58,29 +104,41 @@ def precompute_rope_freqs(
     ``scaling_type='linear'``: position interpolation ``t / factor``
     (parity: megatron/model/positional_embeddings.py:7-13, the 16k/32k
     Code-Llama mode).  ``scaling_type='llama3'``: Llama-3.1's piecewise
-    frequency transform (positions unscaled).
+    frequency transform.  ``scaling_type='yarn'``: NTK-by-parts with the
+    attention temperature folded into the tables.  Both frequency-space
+    modes leave positions unscaled.
     """
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    table_scale = 1.0
+    if scaling_type in ("llama3", "yarn") and scaling_factor != 1.0 \
+            and not original_max_positions:
+        # ValueError (not assert): must fail early and survive -O
+        raise ValueError(
+            f"{scaling_type} rope scaling needs original_max_positions "
+            "(the pre-extension context length)")
     if scaling_type == "llama3":
         if scaling_factor != 1.0:
-            if not original_max_positions:
-                # ValueError (not assert): must fail early and survive -O
-                raise ValueError(
-                    "llama3 rope scaling needs original_max_positions "
-                    "(the pre-extension context length)")
             inv_freq = llama3_scaled_inv_freq(
                 inv_freq, scaling_factor, low_freq_factor,
                 high_freq_factor, original_max_positions)
+        t = jnp.arange(max_positions, dtype=jnp.float32)
+    elif scaling_type == "yarn":
+        if scaling_factor != 1.0:
+            inv_freq, table_scale = yarn_scaled_inv_freq(
+                inv_freq, scaling_factor, beta_fast, beta_slow,
+                original_max_positions, head_dim, theta,
+                attention_factor)
         t = jnp.arange(max_positions, dtype=jnp.float32)
     elif scaling_type == "linear":
         t = jnp.arange(max_positions, dtype=jnp.float32) / scaling_factor
     else:
         raise ValueError(f"unknown rope scaling_type {scaling_type!r} "
-                         "(want 'linear' | 'llama3')")
+                         "(want 'linear' | 'llama3' | 'yarn')")
     freqs = jnp.outer(t, inv_freq)  # [pos, dim/2]
-    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+    return (table_scale * jnp.cos(freqs)).astype(dtype), \
+        (table_scale * jnp.sin(freqs)).astype(dtype)
 
 
 def apply_rope(

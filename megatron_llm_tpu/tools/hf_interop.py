@@ -593,12 +593,34 @@ def config_from_hf(hf_config, family: str | None = None,
                 rope_original_max_positions=int(
                     scaling["original_max_position_embeddings"]),
             )
+        elif stype == "yarn":
+            # mscale/mscale_all_dim change the attention temperature and
+            # truncate=False changes the correction bounds; importing
+            # while ignoring them would silently diverge from HF
+            unsupported = [k for k in ("mscale", "mscale_all_dim",
+                                       "truncate")
+                           if scaling.get(k) not in (None, True)]
+            if unsupported:
+                raise ValueError(
+                    f"unsupported yarn rope_scaling keys {unsupported} "
+                    "(mscale/mscale_all_dim/truncate=False are not "
+                    "implemented)")
+            rope_fields.update(
+                rope_scaling_type="yarn",
+                rope_scaling_factor=float(scaling["factor"]),
+                rope_beta_fast=float(scaling.get("beta_fast") or 32.0),
+                rope_beta_slow=float(scaling.get("beta_slow") or 1.0),
+                rope_attention_factor=scaling.get("attention_factor"),
+                rope_original_max_positions=int(
+                    scaling.get("original_max_position_embeddings")
+                    or hf_config.max_position_embeddings),
+            )
         else:
-            # silently mapping e.g. yarn/dynamic onto linear PI would
+            # silently mapping e.g. dynamic-NTK onto linear PI would
             # import a checkpoint that produces divergent logits
             raise ValueError(
                 f"unsupported rope_scaling type {stype!r} "
-                "(supported: linear, llama3)")
+                "(supported: linear, llama3, yarn)")
         fields = dict(
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,

@@ -300,3 +300,37 @@ def test_unsupported_rope_scaling_rejected():
     )
     with _pytest.raises(ValueError, match="rope_scaling"):
         hf_interop.config_from_hf(hf_cfg, "llama")
+
+
+def test_yarn_rope_scaling_parity():
+    """YaRN (NTK-by-parts) rope scaling incl. the attention temperature
+    folded into cos/sin: logit parity vs transformers."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=112,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "yarn",
+            "factor": 4.0,
+            "original_max_position_embeddings": 32,
+        },
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(21)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    cfg = hf_interop.config_from_hf(
+        hf_cfg, "llama", params_dtype="float32", attention_impl="dot",
+        recompute="none", seq_length=128)
+    assert cfg.rope_scaling_type == "yarn"
+    params = hf_interop.llama_from_hf(hf_model.state_dict(), cfg)
+    tokens = np.random.default_rng(13).integers(0, 128, (2, 100))
+    diff = _max_abs_diff(cfg, params, hf_model, tokens)
+    assert diff < 2e-4, f"yarn rope-scaling logit diff {diff}"
