@@ -13,7 +13,6 @@ samples are padded/truncated to seq_length+1 and the loss mask is:
 from __future__ import annotations
 
 from enum import IntEnum
-from typing import Optional, Sequence
 
 import numpy as np
 

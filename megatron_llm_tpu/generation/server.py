@@ -16,7 +16,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional, Sequence
+from typing import Optional
 
 from ..config import ModelConfig
 from ..tokenizer.tokenizer import Tokenizer

@@ -25,8 +25,7 @@ recompute.  Key TPU-first departures from the reference:
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -15,12 +15,10 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import itertools
 import json
 import multiprocessing as mp
 import sys
 import time
-from typing import Optional
 
 import numpy as np
 
