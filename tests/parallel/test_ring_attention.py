@@ -138,15 +138,15 @@ def test_train_step_with_context_parallelism():
         "loss_mask": jnp.ones((1, 4, 32), jnp.float32),
     }
 
-    def run(cp, pp=1, dp=2):
+    def run(cp, pp=1, dp=2, gbs=4, b=None):
         cfg = RuntimeConfig(
             model=tiny_config(),
             parallel=ParallelConfig(
                 data_parallel=dp, context_parallel=cp, pipeline_parallel=pp,
-                num_microbatches=2 if pp > 1 else 1),
+                num_microbatches=(gbs // (2 * dp)) if pp > 1 else 1),
             optimizer=OptimizerConfig(lr=1e-3, clip_grad=1.0),
             train=TrainConfig(
-                train_iters=2, micro_batch_size=2, global_batch_size=4,
+                train_iters=2, micro_batch_size=2, global_batch_size=gbs,
                 seq_length=32, save=None,
             ),
         ).validate()
@@ -154,11 +154,12 @@ def test_train_step_with_context_parallelism():
             assert cfg.model.context_parallel_axis == "cp"
         params = model_lib.init_params(jax.random.key(3), cfg.model)
         art = setup_train_state(cfg, params=params)
-        b = batch
-        if pp > 1:
-            # pipeline consumes [M, mb, ...] microbatches
-            b = jax.tree.map(
-                lambda x: x.reshape(2, 2, *x.shape[2:]), batch)
+        if b is None:
+            b = batch
+            if pp > 1:
+                # pipeline consumes [M, mb, ...] microbatches
+                b = jax.tree.map(
+                    lambda x: x.reshape(2, 2, *x.shape[2:]), batch)
         _, metrics = art.step_fn(art.state, b, None)
         return float(metrics["loss"])
 
@@ -169,6 +170,16 @@ def test_train_step_with_context_parallelism():
     # pipeline (pp=2) combined with ring attention (cp=2)
     loss_pp_cp = run(2, pp=2, dp=1)
     np.testing.assert_allclose(loss_pp_cp, loss_ref, rtol=1e-3, atol=1e-3)
+    # the full manual-axis triple: dp AND cp AND pp all manual inside the
+    # pipeline shard_map (dp became manual in round 3 — the XLA
+    # partitioner-crash fix).  Self-consistent config: gbs 8 = mb 2 ×
+    # dp 2 × M 2; the 8-sample batch duplicates the reference data so
+    # the mean loss is unchanged.
+    big = jax.tree.map(
+        lambda x: jnp.concatenate([x, x], axis=1
+                                  ).reshape(2, 4, *x.shape[2:]), batch)
+    loss_triple = run(2, pp=2, dp=2, gbs=8, b=big)
+    np.testing.assert_allclose(loss_triple, loss_ref, rtol=1e-3, atol=1e-3)
 
 
 def test_train_step_with_zigzag_layout():
