@@ -223,10 +223,52 @@ def _point(label: str, fn, *args):
     return out
 
 
-def main() -> None:
-    import jax
+def _detect_device(timeout_s: int = 240):
+    """First device's kind, probed in a SUBPROCESS with a hard timeout.
 
-    platform = jax.devices()[0].device_kind
+    A degraded axon tunnel makes ``jax.devices()`` hang indefinitely
+    *inside a C call* (observed live: >25 min wedged, and SIGALRM never
+    fires because the Python handler can't run mid-C-call) — a benchmark
+    that hangs is worse for the driver than one that emits a structured
+    failure record quickly.  A killed subprocess bounds the wait no
+    matter where the backend blocks; on success the parent initializes
+    its own backend (now known reachable)."""
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        raise TimeoutError(
+            f"device probe exceeded {timeout_s}s "
+            "(accelerator tunnel unreachable?)")
+    if out.returncode != 0:
+        tail = (out.stderr or "").strip().splitlines()[-1:] or ["?"]
+        raise RuntimeError(f"device probe failed: {tail[0]}")
+    # the child already printed the device kind; re-calling jax.devices()
+    # here would reintroduce the unbounded hang (a wedge can start between
+    # the probe and the call) and pay backend init twice
+    kind = (out.stdout or "").strip().splitlines()[-1:]
+    if not kind:
+        raise RuntimeError("device probe printed nothing")
+    return kind[0]
+
+
+def main() -> None:
+    try:
+        platform = _detect_device()
+    except (TimeoutError, RuntimeError, OSError) as e:
+        # no reachable device: emit a parseable record naming the cause
+        # instead of hanging or stack-tracing
+        print(json.dumps({
+            "metric": "mfu", "value": None, "unit": "fraction_of_peak",
+            "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        raise SystemExit(1)
     peak = chip_peak_flops(platform)
 
     # Headline: seq 1024 (the reference's finetune config), measured

@@ -19,6 +19,9 @@ import bench
 def _run_main(monkeypatch, train_fn, decode_fn):
     monkeypatch.setattr(bench, "_train_point", train_fn)
     monkeypatch.setattr(bench, "_decode_point", decode_fn)
+    # the real probe subprocesses to the accelerator (and waits out its
+    # timeout when the tunnel is down) — not what these tests measure
+    monkeypatch.setattr(bench, "_detect_device", lambda: "TPU v5 lite")
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
         bench.main()
@@ -102,3 +105,18 @@ def test_transient_error_retried(monkeypatch):
 
     assert bench._retry(flaky) == "ok"
     assert len(calls) == 2
+
+
+def test_unreachable_device_yields_structured_record(monkeypatch, capsys):
+    """A wedged accelerator tunnel must produce a parseable failure
+    record quickly, not an indefinite hang (observed live in round 3)."""
+    def hang_forever():
+        raise TimeoutError("jax.devices() exceeded 300s")
+
+    monkeypatch.setattr(bench, "_detect_device", hang_forever)
+    with pytest.raises(SystemExit):
+        bench.main()
+    out = [l for l in capsys.readouterr().out.splitlines()
+           if not l.startswith("#")]
+    rec = json.loads(out[-1])
+    assert rec["value"] is None and "TimeoutError" in rec["error"]
