@@ -46,11 +46,16 @@ def parse_args(argv=None) -> argparse.Namespace:
 
     g = p.add_argument_group("model")
     g.add_argument("--model", default="llama2",
-                   choices=["llama", "llama2", "llama3", "codellama",
-                            "falcon", "gpt", "tiny"])
+                   choices=["llama", "llama2", "llama3", "llama3.1",
+                            "codellama", "falcon", "gpt", "tiny"])
     g.add_argument("--model_size", default="7b")
     g.add_argument("--seq_length", type=int, default=None)
     g.add_argument("--rope_scaling_factor", type=float, default=1.0)
+    g.add_argument("--rope_scaling_type", default=None,
+                   choices=["linear", "llama3", "yarn"],
+                   help="RoPE scaling style (with --rope_scaling_factor); "
+                        "llama3/yarn also need --rope_original_max_positions")
+    g.add_argument("--rope_original_max_positions", type=int, default=None)
     g.add_argument("--num_experts", type=int, default=0,
                    help="MoE experts per layer (0 = dense)")
     g.add_argument("--moe_top_k", type=int, default=2)
@@ -172,6 +177,7 @@ def build_config(args):
         llama1_config,
         llama2_config,
         llama3_config,
+        llama31_config,
         tiny_config,
     )
 
@@ -184,6 +190,11 @@ def build_config(args):
         overrides["seq_length"] = args.seq_length
     if args.rope_scaling_factor != 1.0:
         overrides["rope_scaling_factor"] = args.rope_scaling_factor
+    if args.rope_scaling_type:
+        overrides["rope_scaling_type"] = args.rope_scaling_type
+    if args.rope_original_max_positions:
+        overrides["rope_original_max_positions"] = \
+            args.rope_original_max_positions
     if args.hidden_dropout is not None:
         overrides["hidden_dropout"] = args.hidden_dropout
     if args.lima_dropout:
@@ -204,12 +215,18 @@ def build_config(args):
         "llama": lambda: llama1_config(args.model_size, **overrides),
         "llama2": lambda: llama2_config(args.model_size, **overrides),
         "llama3": lambda: llama3_config(args.model_size, **overrides),
+        "llama3.1": lambda: llama31_config(args.model_size, **overrides),
         "codellama": lambda: codellama_config(args.model_size, **overrides),
         "falcon": lambda: falcon_config(args.model_size, **overrides),
         "gpt": lambda: gpt_config(args.model_size, **overrides),
         "tiny": lambda: tiny_config(**overrides),
     }
     model = builders[args.model]()
+    # check the effective factor (preset may supply it, e.g. llama3.1's 8.0)
+    if args.rope_scaling_type and model.rope_scaling_factor == 1.0:
+        raise SystemExit(
+            "--rope_scaling_type has no effect with rope_scaling_factor=1.0 "
+            "— pass --rope_scaling_factor (or a preset that sets one)")
 
     dp = args.dp
     if dp <= 0:

@@ -591,6 +591,23 @@ def llama3_config(size: str = "8b", **overrides) -> ModelConfig:
     return llama2_config("7b", **base)
 
 
+def llama31_config(size: str = "8b", **overrides) -> ModelConfig:
+    """Llama-3.1: llama3 dims + 128k context via the HF "llama3"
+    piecewise RoPE frequency scaling (factor 8, low 1, high 4, original
+    8192 — the rope_scaling dict every Llama-3.1 HF config ships)."""
+    base = dict(
+        max_position_embeddings=131072,
+        seq_length=8192,  # trainable window; positions beyond are scaled
+        rope_scaling_type="llama3",
+        rope_scaling_factor=8.0,
+        rope_low_freq_factor=1.0,
+        rope_high_freq_factor=4.0,
+        rope_original_max_positions=8192,
+    )
+    base.update(overrides)
+    return llama3_config(size, **base)
+
+
 def falcon_config(size: str = "7b", **overrides) -> ModelConfig:
     """Falcon: MQA/GQA, parallel attention, LayerNorm, gelu, rotary
     (reference: megatron/model/falcon_model.py:18-29)."""
@@ -671,6 +688,8 @@ PRESETS = {
     "llama1-7b": lambda: llama1_config("7b"),
     "llama3-8b": lambda: llama3_config("8b"),
     "llama3-70b": lambda: llama3_config("70b"),
+    "llama3.1-8b": lambda: llama31_config("8b"),
+    "llama3.1-70b": lambda: llama31_config("70b"),
     "codellama-7b": lambda: codellama_config("7b"),
     "codellama-34b": lambda: codellama_config("34b"),
     "falcon-7b": lambda: falcon_config("7b"),

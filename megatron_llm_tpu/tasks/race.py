@@ -80,7 +80,9 @@ class RaceDataset:
         return len(self.samples)
 
     def _encode_one(self, qa: str, context_ids: list) -> tuple:
-        qa_ids = list(self.tok.tokenize(qa))[: self.max_qa]
+        # cap qa at seq-3 as well as max_qa so rows are always exactly
+        # seq_length even when max_qa_length + 3 > seq_length
+        qa_ids = list(self.tok.tokenize(qa))[: min(self.max_qa, self.seq - 3)]
         ctx = list(context_ids)
         # trim the context tail only (reference data_utils
         # build_tokens_types_paddings_from_ids truncates text_b)

@@ -217,16 +217,15 @@ def _minhash_signature(sh: set, seeds: np.ndarray) -> np.ndarray:
     return hashes.min(axis=0)
 
 
-def find_duplicate_groups(
+def find_duplicate_index_groups(
     docs: Sequence[dict],
-    key: str = "url",
     char_ngram: int = 5,
     num_hashes: int = 64,
     num_bands: int = 16,
     similarity: float = 0.7,
-) -> list[list[str]]:
+) -> list[list[int]]:
     """Minhash-LSH candidate generation + exact-jaccard confirmation →
-    groups (connected components) of near-duplicate document keys.
+    groups (connected components) of near-duplicate document *indices*.
 
     ``num_bands`` bands of ``num_hashes/num_bands`` rows each: documents
     sharing any band bucket are candidates; candidates are confirmed by
@@ -237,9 +236,8 @@ def find_duplicate_groups(
     rng = np.random.default_rng(1234)
     seeds = rng.integers(1, 2 ** 63, size=num_hashes, dtype=np.uint64)
 
-    keys, shingle_sets, sigs = [], [], []
+    shingle_sets, sigs = [], []
     for d in docs:
-        keys.append(d[key])
         sh = shingles(d.get("text", ""), char_ngram)
         shingle_sets.append(sh)
         sigs.append(_minhash_signature(sh, seeds))
@@ -269,14 +267,22 @@ def find_duplicate_groups(
         if jaccard(shingle_sets[i], shingle_sets[j]) >= similarity:
             parent[find(i)] = find(j)
 
-    groups: dict[int, list[str]] = {}
+    groups: dict[int, list[int]] = {}
     for i in range(len(docs)):
-        groups.setdefault(find(i), []).append(keys[i])
+        groups.setdefault(find(i), []).append(i)
     return [g for g in groups.values() if len(g) > 1]
 
 
-def removal_list(groups: Sequence[Sequence[str]]) -> set:
-    """Keep the first key of each duplicate group, remove the rest
+def find_duplicate_groups(docs: Sequence[dict], key: str = "url",
+                          **kw) -> list[list[str]]:
+    """Like :func:`find_duplicate_index_groups` but reporting each doc's
+    ``key`` value (may repeat when exact recrawls share a url)."""
+    return [[docs[i][key] for i in g]
+            for g in find_duplicate_index_groups(docs, **kw)]
+
+
+def removal_list(groups: Sequence[Sequence[int]]) -> set:
+    """Keep the first member of each duplicate group, remove the rest
     (reference remove_group_duplicates.py keeps one url per group)."""
     out = set()
     for g in groups:
@@ -285,8 +291,11 @@ def removal_list(groups: Sequence[Sequence[str]]) -> set:
 
 
 def dedup_docs(docs: Sequence[dict], key: str = "url", **kw) -> list[dict]:
-    remove = removal_list(find_duplicate_groups(docs, key=key, **kw))
-    return [d for d in docs if d[key] not in remove]
+    # Removal is index-based so duplicate groups whose members share the
+    # same key value (exact recrawls) still keep exactly one survivor.
+    del key  # kept for API compat; grouping is content-based
+    remove = removal_list(find_duplicate_index_groups(docs, **kw))
+    return [d for i, d in enumerate(docs) if i not in remove]
 
 
 # ---------------------------------------------------------------------------
@@ -425,15 +434,16 @@ def main(argv: Optional[list] = None) -> int:
         print(f"kept {n}/{len(docs)} docs")
     elif ns.cmd == "dedup":
         docs = read_jsonl(ns.input)
-        groups = find_duplicate_groups(docs, key=ns.key,
-                                       similarity=ns.similarity)
+        igroups = find_duplicate_index_groups(docs, similarity=ns.similarity)
         if ns.groups_out:
-            write_jsonl(ns.groups_out, [{"group": g} for g in groups])
-        remove = removal_list(groups)
-        kept = [x for x in docs if x[ns.key] not in remove]
+            write_jsonl(ns.groups_out,
+                        [{"group": [docs[i][ns.key] for i in g]}
+                         for g in igroups])
+        remove = removal_list(igroups)
+        kept = [x for i, x in enumerate(docs) if i not in remove]
         write_jsonl(ns.output, kept)
         print(f"kept {len(kept)}/{len(docs)} docs "
-              f"({len(groups)} duplicate groups)")
+              f"({len(igroups)} duplicate groups)")
     elif ns.cmd == "decontaminate":
         docs = read_jsonl(ns.input)
         task_texts = [d["text"] for tf in ns.task_files

@@ -227,8 +227,12 @@ def load_meta_shards(root_dir: str) -> dict:
 
     import torch
 
-    paths = sorted(p for p in Path(root_dir).iterdir()
-                   if re.match(r"^consolidated\.\d+\.pth$", p.name))
+    # Numeric sort: lexicographic order scrambles non-zero-padded shard
+    # indices >= 10 (consolidated.2.pth would sort after consolidated.10.pth).
+    paths = sorted(
+        (p for p in Path(root_dir).iterdir()
+         if re.match(r"^consolidated\.\d+\.pth$", p.name)),
+        key=lambda p: int(p.name.split(".")[1]))
     if not paths:
         raise FileNotFoundError(
             f"no consolidated.NN.pth shards under {root_dir}")

@@ -126,6 +126,27 @@ _SLOW_TESTS = {
 
 
 def pytest_collection_modifyitems(config, items):
+    matched = set()
     for item in items:
-        if item.name.split("[")[0] in _SLOW_TESTS:
+        base = item.name.split("[")[0]
+        if base in _SLOW_TESTS:
+            matched.add(base)
             item.add_marker(pytest.mark.slow)
+    # A renamed/removed test must not silently linger here, eroding the
+    # fast-tier guarantee.  Only enforce on full-suite collections (a
+    # path-restricted run legitimately collects a subset).
+    stale = _SLOW_TESTS - matched
+    # "Full suite" = every positional arg is this tests/ dir or an
+    # ancestor of it (subdirectory/file runs legitimately collect subsets).
+    tests_root = os.path.dirname(os.path.abspath(__file__))
+    def _covers_suite(arg):
+        p = os.path.abspath(arg.split("::")[0])
+        return os.path.isdir(p) and (
+            p == tests_root or tests_root.startswith(p + os.sep))
+    full_suite = (all(_covers_suite(a) for a in config.args)
+                  and not config.getoption("ignore", None)
+                  and not config.getoption("deselect", None))
+    if stale and full_suite:
+        raise pytest.UsageError(
+            f"_SLOW_TESTS entries matched no collected test: {sorted(stale)}"
+            " — remove or rename them in tests/conftest.py")

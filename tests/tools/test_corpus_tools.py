@@ -86,6 +86,17 @@ def test_dedup_keeps_one_per_group():
     assert len([u for u in urls if u in ("a", "b", "d")]) == 1
 
 
+def test_dedup_same_url_recrawl_keeps_one():
+    # Exact recrawl: two near-duplicate docs sharing one url must leave
+    # exactly one survivor, not zero (removal is index-based).
+    docs = _docs()
+    docs[3] = {"url": "a", "text": docs[3]["text"]}  # d becomes a recrawl of a
+    kept = ct.dedup_docs(docs, similarity=0.7)
+    urls = [d["url"] for d in kept]
+    assert urls.count("c") == 1
+    assert len([u for u in urls if u in ("a", "b")]) == 1
+
+
 def test_jaccard_and_shingles():
     a = ct.shingles("hello world")
     assert ct.jaccard(a, a) == 1.0
