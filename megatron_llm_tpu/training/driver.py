@@ -102,8 +102,14 @@ def _cluster_any(local_flag: bool) -> bool:
 
         flags = multihost_utils.process_allgather(np.asarray([local_flag]))
         return bool(np.any(flags))
-    except Exception:
-        return bool(local_flag)
+    except Exception as e:
+        # A degraded collective must NOT silently fall back to the local
+        # flag: per-host decisions are exactly the half-entered-collective
+        # hang this consensus exists to prevent.  Fail loudly instead.
+        raise RuntimeError(
+            "multi-host consensus allgather failed; refusing to fall back "
+            "to a per-host decision (hosts would diverge and deadlock the "
+            "next collective)") from e
 
 
 # ---------------------------------------------------------------------------
@@ -478,6 +484,42 @@ def _build_train_iterator(cfg: RuntimeConfig, dataset, consumed_samples: int,
     return checked()
 
 
+class _PersistentEvalIterator:
+    """Validation batches that advance across eval hooks instead of
+    restarting at sample 0 each time (every eval would otherwise score the
+    same leading batches; the reference advances one persistent valid
+    iterator for the whole run, training.py:877-961).  Wraps to the top of
+    the valid set on exhaustion; rebuilds position-preserving when batch
+    rampup changes the global batch size."""
+
+    def __init__(self, cfg, dataset, eod_token):
+        self.cfg, self.dataset, self.eod = cfg, dataset, eod_token
+        self.consumed = 0
+        self._gbs = None
+        self._it = None
+
+    def iterator(self, gbs: int) -> "_PersistentEvalIterator":
+        if self._it is None or gbs != self._gbs:
+            self._gbs = gbs
+            self._it = _build_train_iterator(
+                self.cfg, self.dataset, self.consumed, gbs, False, self.eod)
+        return self
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            self.consumed = 0
+            self._it = _build_train_iterator(
+                self.cfg, self.dataset, 0, self._gbs, False, self.eod)
+            batch = next(self._it)  # empty valid set → StopIteration out
+        self.consumed += self._gbs
+        return batch
+
+
 def pretrain(
     cfg: RuntimeConfig,
     train_dataset=None,
@@ -544,6 +586,8 @@ def pretrain(
     eval_step = None
     eval_flatten = True
     eval_batch_sharding = None
+    persistent_valid = (None if valid_dataset is None else
+                        _PersistentEvalIterator(cfg, valid_dataset, eod_token))
     if valid_dataset is not None or test_dataset is not None:
         if cfg.parallel.pipeline_parallel > 1:
             # pipelined eval: streamed per-token stats from the last stage
@@ -658,8 +702,7 @@ def pretrain(
                     and cfg.train.eval_interval
                     and iteration % cfg.train.eval_interval == 0):
                 timers("eval", log_level=0).start()
-                valid_iter = _build_train_iterator(
-                    cfg, valid_dataset, 0, current_gbs, False, eod_token)
+                valid_iter = persistent_valid.iterator(current_gbs)
                 params_for_eval = state.params
                 evaluate_and_print_results(
                     f"iteration {iteration}", cfg, params_for_eval,
@@ -709,8 +752,7 @@ def pretrain(
 
     # final validation + test (reference pretrain tail, training.py:144-169)
     if valid_dataset is not None and eval_step is not None:
-        valid_iter = _build_train_iterator(
-            cfg, valid_dataset, 0, current_gbs, False, eod_token)
+        valid_iter = persistent_valid.iterator(current_gbs)
         evaluate_and_print_results(
             "the end of training for val data", cfg, state.params,
             valid_iter, eval_step, writer, iteration, eval_batch_sharding,

@@ -192,3 +192,68 @@ def test_profile_window_with_skip_iters(tmp_path):
     traces = [f for _, _, fs in os.walk(prof) for f in fs
               if "xplane" in f or "trace" in f]
     assert traces, "window over skipped iterations never closed/wrote"
+
+
+def test_persistent_eval_iterator_advances_and_wraps(tmp_path):
+    """Each eval hook must see the NEXT validation batches, not restart at
+    sample 0 (reference advances one persistent valid iterator for the
+    whole run, training.py:877-961); exhaustion wraps to the top."""
+    from megatron_llm_tpu.training.driver import _PersistentEvalIterator
+
+    cfg = _cfg(tmp_path, save=None)
+    gbs = 8
+    valid = MockDataset(cfg.model.vocab_size, cfg.train.seq_length, n=24,
+                        seed=7)
+    pit = _PersistentEvalIterator(cfg, valid, eod_token=None)
+
+    b1 = next(pit.iterator(gbs))          # hook 1, batch 1
+    b2 = next(pit.iterator(gbs))          # hook 2 continues, batch 2
+    assert not np.array_equal(b1["tokens"], b2["tokens"])
+    assert pit.consumed == 2 * gbs
+
+    b3 = next(pit.iterator(gbs))          # batch 3 exhausts n=24
+    b4 = next(pit.iterator(gbs))          # wrap: back to batch 1
+    assert not np.array_equal(b3["tokens"], b1["tokens"])
+    assert np.array_equal(b4["tokens"], b1["tokens"])
+    assert pit.consumed == gbs  # reset on wrap, then one batch consumed
+
+
+def test_persistent_eval_iterator_rebuilds_on_gbs_change(tmp_path):
+    from megatron_llm_tpu.training.driver import _PersistentEvalIterator
+
+    cfg = _cfg(tmp_path, save=None)
+    valid = MockDataset(cfg.model.vocab_size, cfg.train.seq_length, n=64,
+                        seed=7)
+    pit = _PersistentEvalIterator(cfg, valid, eod_token=None)
+    b = next(pit.iterator(8))
+    assert b["tokens"].reshape(-1, b["tokens"].shape[-1]).shape[0] == 8
+    b = next(pit.iterator(16))  # rampup: larger accum, position preserved
+    assert b["tokens"].reshape(-1, b["tokens"].shape[-1]).shape[0] == 16
+    assert pit.consumed == 8 + 16
+
+
+def test_cluster_any_raises_on_degraded_collective(monkeypatch):
+    """In a multi-host run a failed consensus allgather must raise, not
+    silently fall back to a per-host decision (which would deadlock the
+    next collective when hosts diverge)."""
+    import jax
+
+    from megatron_llm_tpu.training import driver as drv
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    from jax.experimental import multihost_utils
+
+    def boom(x):
+        raise ValueError("collective transport down")
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", boom)
+    with pytest.raises(RuntimeError, match="consensus allgather failed"):
+        drv._cluster_any(True)
+
+
+def test_cluster_any_single_process_is_local():
+    from megatron_llm_tpu.training import driver as drv
+
+    assert drv._cluster_any(True) is True
+    assert drv._cluster_any(False) is False
