@@ -266,8 +266,9 @@ class ParallelConfig:
     # checkpoints the scan in windows of W ticks, bounding saved boundaries
     # at ceil(T/W) + 2·W instead of 2·T.  This is the large-M (grad-accum
     # M≥64) memory bound the reference gets from ≤pp in-flight 1F1B
-    # (megatron/schedules.py:606-722), at ~+25% FLOPs when on.  vpp=1 only
-    # (the interleaved circular buffer would be re-saved per window).
+    # (megatron/schedules.py:606-722), at ~+25% FLOPs when on.  With
+    # vpp > 1 it requires num_microbatches % pp == 0 (the tight
+    # interleaved schedule, whose carry has no circular buffer).
     pipeline_remat_window: int = 0
     # ZeRO-1: shard optimizer state over dp
     # (reference: megatron/optimizer/distrib_optimizer.py)
@@ -294,10 +295,14 @@ class ParallelConfig:
             f"{self.context_parallel_layout!r}")
         if self.pipeline_remat_window:
             assert self.pipeline_remat_window > 0
-            assert self.virtual_pipeline_stages == 1, (
-                "pipeline_remat_window requires vpp == 1: the interleaved "
-                "circular buffer is part of the scan carry and would be "
-                "re-saved at every window boundary, inflating memory")
+            if self.virtual_pipeline_stages > 1:
+                assert self.num_microbatches % self.pipeline_parallel == 0, (
+                    "pipeline_remat_window with vpp > 1 needs "
+                    "num_microbatches divisible by pipeline_parallel (the "
+                    "tight interleaved schedule; same divisibility the "
+                    "reference's interleaved 1F1B asserts) — otherwise the "
+                    "legacy circular buffer would be re-saved at every "
+                    "window boundary, inflating memory")
         return self
 
 

@@ -24,9 +24,14 @@ the same wall-clock as an idle bubble.
 
 Schedule shape (T = ticks):
 - vpp = 1:  T = M + pp - 1           (M = num microbatches)
-- vpp > 1:  T = M·vpp + pp - 1, requiring M ≥ pp; finished microbatches
-  wrap from the last stage back to stage 0 through a circular storage
-  buffer and re-enter for their next chunk after a full round of M ticks.
+- vpp > 1:  T = M·vpp + pp - 1, requiring M ≥ pp.  When M % pp == 0 (the
+  divisibility the reference's interleaved schedule also asserts) the
+  *tight* group-interleaved order runs: microbatches advance in groups of
+  pp, each group cycling through all vpp chunks, and the ring shift itself
+  delivers chunk→chunk re-entry (the wrap the last stage emits at tick t-1
+  is exactly what stage 0 consumes at tick t) — no re-entry buffer exists.
+  Otherwise the legacy order parks finished microbatches in an [M, ...]
+  circular buffer and re-enters them after a full round of M ticks.
 Bubble fraction = (pp-1)/(M·vpp + pp - 1): interleaving divides the bubble
 by vpp exactly as in the reference's interleaved 1F1B.
 
@@ -37,8 +42,9 @@ demand inside the tick and the last stage runs the CE head on each finished
 microbatch inside the tick, so no ``[M, mb, s, h]`` hidden-state buffer
 (input, output, or fp32 boundary copy) ever exists.  Per-device activation
 memory is T boundary tensors ``[mb, s_local, h]`` (scan residuals, compute
-dtype) + the model's own remat-policy residuals per tick + (vpp>1 only) the
-``[M, mb, s_local, h]`` circular re-entry buffer.  The reference's 1F1B
+dtype) + the model's own remat-policy residuals per tick + (legacy
+non-divisible-M interleaving only) the ``[M, mb, s_local, h]`` circular
+re-entry buffer.  The reference's 1F1B
 bounds in-flight microbatches at ≤pp (schedules.py:606-722); the streamed
 scan holds M·vpp boundary tensors instead, which at BASELINE config-5 shapes
 (70B, s=4096, mb=1, pp=8, M=16) is ~1.5 GB bf16 per device — small next to
@@ -229,14 +235,18 @@ def pipeline_activation_bytes(
     c = {"full": 1.0,
          "selective": 4.0,
          "none": 4.0 + 3.0 * cfg.ffn_size / h}[recompute]
-    if window and window > 0 and vpp == 1 and T > window:
+    tight = vpp == 1 or M % pp == 0
+    if window and window > 0 and tight and T > window:
         n_win = -(-T // window)
         boundary = (n_win + 2 * window) * per_boundary
         layer_residuals = int(window * lpc * c * per_boundary)
     else:
         boundary = 2 * T * per_boundary
         layer_residuals = int(T * lpc * c * per_boundary)
-    circ = (M * per_boundary) if vpp > 1 else 0
+    # The M-sized circular re-entry buffer exists only on the legacy
+    # (non-divisible-M) interleaved path; the tight schedule re-enters
+    # through the ring shift itself.
+    circ = (M * per_boundary) if (vpp > 1 and not tight) else 0
     head = 3 * mb * seq_shard * v * 4
     io_grads = 2 * v * h * 4
     terms = {
@@ -300,6 +310,12 @@ def pipeline_loss(
         assert M >= pp, (
             f"interleaved pipeline needs num_microbatches ≥ pp ({M} < {pp})"
         )
+    # "Tight" schedule: group-interleaved microbatch order whose re-entry
+    # rides the ring shift itself (no circular buffer).  Requires
+    # M % pp == 0 when vpp > 1 — the same divisibility the reference's
+    # interleaved schedule asserts (schedules.py:253).  At vpp = 1 the
+    # group order degenerates to plain 1F1B for any M.
+    tight = vpp == 1 or M % pp == 0
     T = M * vpp + pp - 1
     ring = [(s, (s + 1) % pp) for s in range(pp)]
     compute_dtype = model_cfg.dtype
@@ -368,7 +384,7 @@ def pipeline_loss(
 
         mb_shape = tokens.shape[1:] + (model_cfg.hidden_size,)
         circ = (jnp.zeros((M,) + mb_shape, compute_dtype)
-                if vpp > 1 else None)
+                if vpp > 1 and not tight else None)
         stats0 = None
         if return_stats:
             stats0 = (jnp.zeros(tokens.shape, jnp.float32),   # per-token CE
@@ -410,15 +426,31 @@ def pipeline_loss(
             state, circ, aux_sum, loss_sum, stats = carry
             # Which microbatch / chunk this stage works on at tick t.
             rel = t - stage  # ticks since this stage first saw work
-            m_idx = jnp.clip(rel, 0, None) % M
-            chunk_idx = jnp.clip(rel // M, 0, vpp - 1)
+            relc = jnp.clip(rel, 0, None)
+            if tight:
+                # Group-interleaved order (the reference's interleaved
+                # 1F1B, schedules.py:253, which likewise requires
+                # M % pp == 0): microbatches advance in groups of pp and
+                # each group runs all vpp chunks before the next group
+                # starts.  Re-entry is then *tight*: the wrap the last
+                # stage ppermutes at tick t-1 is exactly the
+                # (m, chunk-1) boundary stage 0 needs at tick t, so no
+                # M-sized circular buffer exists and windowed remat
+                # composes the same as at vpp = 1.
+                g = relc // pp
+                chunk_idx = g % vpp
+                m_idx = jnp.clip((g // vpp) * pp + relc % pp, 0, M - 1)
+            else:
+                m_idx = relc % M
+                chunk_idx = jnp.clip(rel // M, 0, vpp - 1)
 
-            # Stage-0 input: embed a fresh microbatch on demand while t < M,
-            # then wrapped microbatches from circular storage.  The embed is
-            # computed everywhere and selected on stage 0 — its cotangent is
-            # zero elsewhere (the jnp.where transpose), so embedding grads
-            # are exact.
-            t_in = jnp.minimum(t, M - 1)
+            # Stage-0 input: embed a fresh microbatch on demand when a
+            # microbatch enters chunk 0, wrapped re-entries otherwise
+            # (ring state if tight, circular storage if not).  The embed
+            # is computed everywhere and selected on stage 0 — its
+            # cotangent is zero elsewhere (the jnp.where transpose), so
+            # embedding grads are exact.
+            t_in = m_idx if tight else jnp.minimum(t, M - 1)
             tok = jax.lax.dynamic_index_in_dim(tokens, t_in, 0,
                                                keepdims=False)
             pos_in = (None if pos_mb is None else
@@ -430,13 +462,14 @@ def pipeline_loss(
                 model_cfg, {"embedding": cast(io_p["embedding"])},
                 tok, pos_in, None, er, deterministic,
             ).astype(compute_dtype)
-            if circ is not None:
+            if tight:
+                current = jnp.where((stage == 0) & (chunk_idx == 0),
+                                    fresh, state)
+            else:
                 wrapped = jax.lax.dynamic_index_in_dim(
                     circ, t % M, 0, keepdims=False)
                 inp = jnp.where(t < M, fresh, wrapped)
-            else:
-                inp = fresh
-            current = jnp.where(stage == 0, inp, state)
+                current = jnp.where(stage == 0, inp, state)
 
             tick_rng = None
             if stack_rng_l is not None:
@@ -470,10 +503,19 @@ def pipeline_loss(
 
             # Streamed head: the microbatch finishing at tick t (last
             # chunk, last stage) goes through norm→unembed→CE right here.
-            # The upper bound matters for the windowed schedule's padding
-            # ticks (t ≥ T), which must not re-count microbatch M-1.
-            out_idx = t - (vpp - 1) * M - (pp - 1)
-            head_valid = (out_idx >= 0) & (out_idx < M) & (stage == pp - 1)
+            # The bounds matter for the windowed schedule's padding ticks
+            # (t ≥ T), which must not re-count any microbatch.
+            if tight:
+                rel_l = t - (pp - 1)  # last stage's rel at this tick
+                relc_l = jnp.clip(rel_l, 0, None)
+                g_l = relc_l // pp
+                out_idx = (g_l // vpp) * pp + relc_l % pp
+                head_valid = ((rel_l >= 0) & (rel_l < M * vpp)
+                              & (g_l % vpp == vpp - 1) & (stage == pp - 1))
+            else:
+                out_idx = t - (vpp - 1) * M - (pp - 1)
+                head_valid = ((out_idx >= 0) & (out_idx < M)
+                              & (stage == pp - 1))
             w_idx = jnp.clip(out_idx, 0, M - 1)
             lab_m = jax.lax.dynamic_index_in_dim(labels, w_idx, 0,
                                                  keepdims=False)
@@ -521,16 +563,19 @@ def pipeline_loss(
                 aux0, jnp.zeros((), jnp.float32),
                 stats0)
         W = parallel.pipeline_remat_window
-        if W and W > 0 and vpp == 1 and T > W:
+        if W and W > 0 and tight and T > W:
             # Windowed rematerialization: the plain scan saves every tick's
             # boundary in/out for the backward replay (2·T tensors); at
             # grad-accum counts M ≥ 64 that dwarfs the reference's ≤pp
             # in-flight 1F1B bound (schedules.py:606-722).  Checkpointing
             # windows of W ticks keeps only ceil(T/W) window carries plus
             # one window's residuals live — memory ~O(T/W + W), at the cost
-            # of one extra forward replay per window in backward.  Padding
-            # ticks (t ≥ T) are no-ops: every update in `tick` is masked by
-            # tick_valid / head_valid / c_valid, all false there.
+            # of one extra forward replay per window in backward.  Under
+            # the tight interleaved schedule the carry is still a single
+            # boundary tensor (no circular buffer), so this composes with
+            # vpp > 1 unchanged.  Padding ticks (t ≥ T) are no-ops: every
+            # update in `tick` is masked by tick_valid / head_valid /
+            # c_valid, all false there.
             n_win = -(-T // W)
             ticks = jnp.arange(n_win * W).reshape(n_win, W)
 

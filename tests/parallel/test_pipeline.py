@@ -72,8 +72,9 @@ def _reference_loss(cfg, params, batch):
         (1, 2, 1, 1, 3),
         (1, 4, 1, 1, 4),
         (2, 2, 2, 1, 4),
-        (1, 2, 1, 2, 4),   # interleaved: 2 virtual chunks per stage
-        (1, 4, 1, 2, 4),   # interleaved at pp=4 (16 layers)
+        (1, 2, 1, 2, 4),   # interleaved (tight): 2 virtual chunks per stage
+        (1, 4, 1, 2, 4),   # interleaved (tight) at pp=4 (16 layers)
+        (1, 2, 1, 2, 5),   # interleaved legacy order (M % pp != 0)
     ],
 )
 def test_pipeline_matches_reference(dp, pp, tp, vpp, M):
@@ -248,15 +249,23 @@ def test_pipeline_with_flash_kernel_matches_reference():
                                rtol=5e-5, atol=5e-5)
 
 
-@pytest.mark.parametrize("M,W", [(6, 3), (5, 2)])  # even and ragged windows
-def test_windowed_remat_matches_unwindowed(M, W):
+@pytest.mark.parametrize(
+    "M,W,vpp",
+    [(6, 3, 1), (5, 2, 1),       # even and ragged windows, plain 1F1B
+     (4, 3, 2),                  # windowed INTERLEAVED (tight schedule)
+     (4, 2, 2)],                 # interleaved + ragged (T=9, 1 padding tick)
+)
+def test_windowed_remat_matches_unwindowed(M, W, vpp):
     """pipeline_remat_window must change memory, not math: loss and every
     grad identical to the plain schedule (incl. ragged T % W padding
-    ticks, which must be true no-ops)."""
+    ticks, which must be true no-ops) — for both plain 1F1B and the tight
+    interleaved schedule (vpp > 1, M % pp == 0)."""
     pp = 2
-    cfg = _cfg(num_layers=4)
-    base = ParallelConfig(pipeline_parallel=pp, num_microbatches=M)
+    cfg = _cfg(num_layers=4 * vpp)
+    base = ParallelConfig(pipeline_parallel=pp, num_microbatches=M,
+                          virtual_pipeline_stages=vpp)
     windowed = ParallelConfig(pipeline_parallel=pp, num_microbatches=M,
+                              virtual_pipeline_stages=vpp,
                               pipeline_remat_window=W).validate()
     mesh = mesh_lib.build_mesh(base)
 
@@ -294,10 +303,14 @@ def test_windowed_remat_matches_unwindowed(M, W):
             err_msg=f"windowed grad mismatch at {jax.tree_util.keystr(path)}")
 
 
-def test_window_requires_vpp1():
+def test_window_with_vpp_requires_divisible_microbatches():
+    # tight schedule (M % pp == 0): allowed
+    ParallelConfig(pipeline_parallel=2, virtual_pipeline_stages=2,
+                   num_microbatches=4, pipeline_remat_window=4).validate()
+    # legacy order would re-save the circular buffer per window: rejected
     with pytest.raises(AssertionError):
         ParallelConfig(pipeline_parallel=2, virtual_pipeline_stages=2,
-                       pipeline_remat_window=4).validate()
+                       num_microbatches=5, pipeline_remat_window=4).validate()
 
 
 def test_full_train_step_dp_sharded_batch_argument():

@@ -168,3 +168,48 @@ def test_windowed_remat_bounds_memory_at_large_M():
         f"windowed temp {windowed/2**20:.1f} MiB exceeds bound "
         f"{bound/2**20:.1f} MiB "
         f"({ {k: round(v/2**20, 2) for k, v in model.items()} })")
+
+
+def test_windowed_remat_bounds_memory_vpp2_large_M():
+    """Config-5 grad-accum regime WITH interleaving (vpp=2, M=64): the
+    tight schedule has no circular buffer, so windowing must bound memory
+    exactly as at vpp=1 (VERDICT r3 weak #3)."""
+    pp, mb, M, W, vpp = 8, 1, 64, 8, 2
+    cfg = tiny_config(
+        num_layers=pp * vpp,
+        hidden_size=128,
+        num_attention_heads=4,
+        ffn_hidden_size=256,
+        params_dtype="float32",
+        recompute="full",
+        seq_length=512,
+        max_position_embeddings=512,
+        vocab_size=1024,
+    )
+
+    def measure(window):
+        parallel = ParallelConfig(pipeline_parallel=pp, num_microbatches=M,
+                                  virtual_pipeline_stages=vpp,
+                                  pipeline_remat_window=window).validate()
+        runtime = RuntimeConfig(model=cfg, parallel=parallel,
+                                optimizer=OptimizerConfig(),
+                                train=TrainConfig(seq_length=cfg.seq_length))
+        mesh = mesh_lib.build_mesh(parallel)
+        return _measure_temp_bytes(cfg, runtime, parallel, mesh, M, mb)
+
+    plain = measure(0)
+    windowed = measure(W)
+    # T = M*vpp + pp - 1 = 135 saved boundaries plain vs ~O(T/W + 2W)
+    assert windowed < 0.6 * plain, (plain, windowed)
+
+    model = pipe.pipeline_activation_bytes(
+        cfg, pp=pp, vpp=vpp, M=M, mb=mb, seq_shard=cfg.seq_length,
+        recompute="full", window=W)
+    assert model["circ"] == 0  # tight schedule: no re-entry buffer
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    param_bytes = 2 * 4 * sum(p.size for p in jax.tree.leaves(params)) / pp
+    bound = model["upper_bound"] + param_bytes * 4
+    assert windowed <= bound, (
+        f"windowed temp {windowed/2**20:.1f} MiB exceeds bound "
+        f"{bound/2**20:.1f} MiB "
+        f"({ {k: round(v/2**20, 2) for k, v in model.items()} })")
