@@ -39,9 +39,14 @@ EP = "ep"
 
 
 def _layer_specs(cfg: ModelConfig, layer_axis: Optional[str],
-                 tp_size: int) -> Params:
-    """Specs for one (stacked) layer pytree; leading dim = layer axis."""
+                 tp_size: int, tp_axes=TP) -> Params:
+    """Specs for one (stacked) layer pytree; leading dim = layer axis.
+
+    ``tp_axes`` is the mesh axis (or axis tuple) carrying the tensor
+    sharding — 'tp' for training, ('pp', 'tp') for the serving re-layout
+    (serving_param_specs)."""
     L = layer_axis  # None (scan only) or 'pp'
+    TP = tp_axes  # noqa: N806 — shadows the module constant on purpose
     # K/V projections: shard over tp only if the kv heads divide evenly —
     # MQA (Falcon-7B kv=1) keeps K/V replicated on every tp shard, which is
     # what the reference does implicitly by tiling (transformer.py:449-456).
@@ -112,6 +117,61 @@ def param_specs(cfg: ModelConfig, parallel: ParallelConfig) -> Params:
     if not cfg.tie_embed_logits:
         specs["lm_head"] = P(None, TP)
     return specs
+
+
+def serving_param_specs(cfg: ModelConfig,
+                        parallel: ParallelConfig) -> Params:
+    """Inference re-layout: the pp axis JOINS tp instead of sharding layers.
+
+    Sharding the flat layer stack over 'pp' (the training layout) is wrong
+    for the jitted decode loop: every token step would move *weights*
+    between stages (each scan step reads a layer resident on one stage) —
+    a bandwidth disaster at bs=1.  For serving, pp devices are just more
+    tensor parallelism: every weight is sharded 1/(pp·tp) over the
+    combined ('pp', 'tp') axes, stays resident, and activations do the
+    usual tp collectives.  Memory per device matches the training layout;
+    the reference instead runs its pipelined ForwardStep per token
+    (megatron/text_generation/forward_step.py:44-213), paying a p2p
+    round-trip per token per stage boundary.
+
+    Requires head/vocab divisibility by pp·tp, same as tp alone.
+    """
+    pp = parallel.pipeline_parallel
+    if pp == 1:
+        return param_specs(cfg, parallel)
+    axes = (PP, TP)
+    tp_eff = pp * parallel.tensor_parallel
+    specs: Params = {
+        "embedding": {"word": P(axes, None)},
+        "layers": _layer_specs(cfg, None, tp_eff, tp_axes=axes),
+        "final_norm": {"scale": P(None)},
+    }
+    if cfg.norm_type == "layernorm":
+        specs["final_norm"]["bias"] = P(None)
+    if cfg.position_embedding_type == "absolute":
+        specs["embedding"]["position"] = P(None, None)
+    if cfg.tokentype_size:
+        specs["embedding"]["tokentype"] = P(None, None)
+    if not cfg.tie_embed_logits:
+        specs["lm_head"] = P(None, axes)
+    return specs
+
+
+def shard_for_serving(params: Params, cfg: ModelConfig,
+                      parallel: ParallelConfig) -> tuple[Params, Mesh]:
+    """One-call serving setup: build the mesh, re-layout ``params`` with
+    :func:`serving_param_specs`, return (sharded_params, mesh).  Shared by
+    the generation server CLI and the serving benchmark so the layout
+    logic lives in one place."""
+    from ..parallel import mesh as mesh_lib
+
+    tp_eff = parallel.pipeline_parallel * parallel.tensor_parallel
+    assert cfg.num_attention_heads % tp_eff == 0, (
+        f"serving re-layout shards heads over pp·tp = {tp_eff}, which must "
+        f"divide num_attention_heads = {cfg.num_attention_heads}")
+    mesh = mesh_lib.build_mesh(parallel)
+    specs = serving_param_specs(cfg, parallel)
+    return shard_params(params, specs, mesh), mesh
 
 
 def shard_params(params: Params, specs: Params, mesh: Mesh) -> Params:

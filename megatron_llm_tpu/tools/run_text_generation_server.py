@@ -25,6 +25,13 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--max_batch_size", type=int, default=8)
     ap.add_argument("--max_tokens_to_generate", type=int, default=1024)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel shards for serving")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="extra serving shards: for inference the pp axis "
+                         "JOINS tp (models/sharding.py:serving_param_specs) "
+                         "so a tp×pp training topology serves at tp·pp-way "
+                         "tensor parallelism with weights resident")
     args = ap.parse_args(argv)
 
     from ..checkpointing import load_params_for_inference
@@ -40,6 +47,19 @@ def main(argv=None) -> int:
     tokenizer = build_tokenizer(args.tokenizer_type, args.tokenizer_model)
     params = load_params_for_inference(args.load, lm.cfg)
 
+    mesh_ctx = None
+    if args.tp > 1 or args.pp > 1:
+        from ..config import ParallelConfig
+        from ..models.sharding import shard_for_serving
+        from ..parallel import mesh as mesh_lib
+
+        parallel = ParallelConfig(pipeline_parallel=args.pp,
+                                  tensor_parallel=args.tp)
+        params, mesh = shard_for_serving(params, lm.cfg, parallel)
+        mesh_ctx = mesh_lib.use_mesh(mesh)
+        print(f"serving layout: {dict(mesh.shape)} "
+              f"({args.tp * args.pp}-way tensor sharding)")
+
     from ..generation.server import MegatronServer
 
     server = MegatronServer(
@@ -47,7 +67,11 @@ def main(argv=None) -> int:
         max_batch_size=args.max_batch_size,
         max_tokens_to_generate=args.max_tokens_to_generate)
     print(f"serving on {args.host}:{args.port}")
-    server.run(args.host, args.port)
+    if mesh_ctx is not None:
+        with mesh_ctx:
+            server.run(args.host, args.port)
+    else:
+        server.run(args.host, args.port)
     return 0
 
 

@@ -1,0 +1,103 @@
+"""Decode-throughput benchmark on an arbitrary serving mesh.
+
+Measures KV-cached greedy decode tokens/sec for a model preset under the
+serving re-layout (models/sharding.py:serving_param_specs — the pp axis
+joins tp so weights stay resident; see that docstring for why sharding
+layers over pp is wrong for decode).  The reference publishes no decode
+benchmark; its serving path is the pipelined per-token ForwardStep
+(megatron/text_generation/forward_step.py:44-213).
+
+Usage::
+
+    python -m megatron_llm_tpu.tools.serving_bench \
+        --model tiny --tp 2 --pp 2 --batch 8 --prompt 128 --gen 128
+
+Prints one JSON line: {"decode_tokens_per_sec": ..., "mesh": {...}, ...}.
+On a multi-chip TPU slice this is the real serving number; on the virtual
+CPU mesh (tests) it validates the sharded program end-to-end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def run(model: str, size: str, tp: int, pp: int, batch: int,
+        prompt_len: int, gen_len: int, params_dtype: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..config import ParallelConfig, get_preset
+    from ..generation.generation import generate_tokens
+    from ..models import model as model_lib
+    from ..models import sharding as shard_lib
+    from ..parallel import mesh as mesh_lib
+
+    import dataclasses
+
+    name = model if model == "tiny" else f"{model}-{size}"
+    cfg = get_preset(name)
+    cfg = dataclasses.replace(
+        cfg,
+        seq_length=prompt_len + gen_len,
+        max_position_embeddings=max(cfg.max_position_embeddings,
+                                    prompt_len + gen_len),
+        params_dtype=params_dtype,
+    ).validate()
+
+    parallel = ParallelConfig(pipeline_parallel=pp, tensor_parallel=tp)
+    params = model_lib.init_params(jax.random.key(0), cfg,
+                                   tp=max(tp * pp, 1))
+    params, mesh = shard_lib.shard_for_serving(params, cfg, parallel)
+
+    rng = np.random.default_rng(0)
+    tokens = np.zeros((batch, prompt_len + gen_len), np.int32)
+    tokens[:, :prompt_len] = rng.integers(
+        1, min(cfg.vocab_size, 32000), (batch, prompt_len))
+    tokens = jnp.asarray(tokens)
+    lengths = jnp.full((batch,), prompt_len, jnp.int32)
+
+    with mesh_lib.use_mesh(mesh):
+        out = generate_tokens(cfg, params, tokens, lengths,
+                              use_eos_stop=False)  # warmup/compile
+        jax.device_get(out.tokens)
+        t0 = time.perf_counter()
+        out = generate_tokens(cfg, params, tokens, lengths,
+                              use_eos_stop=False)
+        jax.device_get(out.tokens)
+        dt = time.perf_counter() - t0
+
+    return {
+        "decode_tokens_per_sec": round(batch * gen_len / dt, 1),
+        "mesh": dict(mesh.shape),
+        "model": name,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "gen_len": gen_len,
+        "device": jax.devices()[0].device_kind,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--size", default="7b")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--gen", type=int, default=128)
+    ap.add_argument("--params_dtype", default="bfloat16",
+                    choices=["float32", "bfloat16", "float16"])
+    args = ap.parse_args(argv)
+    rec = run(args.model, args.size, args.tp, args.pp, args.batch,
+              args.prompt, args.gen, args.params_dtype)
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
