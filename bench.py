@@ -55,6 +55,17 @@ def chip_peak_flops(device_kind: str) -> float:
     return next((v for k, v in peaks.items() if k in kind), 197e12)
 
 
+def chip_hbm_bandwidth(device_kind: str) -> float:
+    """HBM bytes/s per chip, for the decode bandwidth roofline."""
+    bws = {
+        "v5 lite": 819e9, "v5e": 819e9,
+        "v5p": 2765e9, "v5": 2765e9,
+        "v4": 1228e9, "v6e": 1640e9, "v6 lite": 1640e9,
+    }
+    kind = device_kind.lower().replace("tpu ", "")
+    return next((v for k, v in bws.items() if k in kind), 819e9)
+
+
 def _bench_model(seq: int, recompute: str):
     from megatron_llm_tpu.config import llama2_config
 
@@ -76,7 +87,31 @@ def _bench_model(seq: int, recompute: str):
     )
 
 
-def _train_point(seq: int, mb: int, recompute: str, iters: int, peak: float):
+def _bench_model_7b_width(seq: int, num_layers: int):
+    """Llama-2-7B *width* (hidden 4096, ffn 11008, 32 q-heads × d128) at
+    reduced depth so training state fits one chip; GQA (8 kv-heads) trims
+    the kv projections the way the 34B/70B presets do.  MFU at this width
+    is the number comparable to the BASELINE 7B configs — per-layer matmul
+    shapes are exactly the 7B ones, depth only repeats them."""
+    from megatron_llm_tpu.config import llama2_config
+
+    return llama2_config(
+        "7b",
+        hidden_size=4096,
+        num_layers=num_layers,
+        num_attention_heads=32,
+        num_kv_heads=8,
+        ffn_hidden_size=11008,
+        seq_length=seq,
+        max_position_embeddings=seq,
+        params_dtype="bfloat16",
+        attention_impl="flash",
+        recompute="full",
+    )
+
+
+def _train_point(seq: int, mb: int, recompute: str, iters: int, peak: float,
+                 model=None):
     """One training-throughput measurement → (tokens/sec, mfu, loss)."""
     import jax
     import jax.numpy as jnp
@@ -91,7 +126,7 @@ def _train_point(seq: int, mb: int, recompute: str, iters: int, peak: float):
     from megatron_llm_tpu.training.step import init_train_state, make_train_step
 
     cfg = RuntimeConfig(
-        model=_bench_model(seq, recompute),
+        model=model if model is not None else _bench_model(seq, recompute),
         parallel=ParallelConfig(),
         optimizer=OptimizerConfig(lr=1e-4, clip_grad=1.0),
         train=TrainConfig(train_iters=100, micro_batch_size=mb,
@@ -137,13 +172,28 @@ def _train_point(seq: int, mb: int, recompute: str, iters: int, peak: float):
     # carried-over HBM allocations made the 32k row intermittently spill
     # (measured 0.63 isolated vs 0.17 contaminated in one process).
     del state, batch, step
-    if seq >= 8192:
+    if seq >= 8192 or model is not None:  # big points: free HBM + caches
         jax.clear_caches()
     return tokens_per_sec, mfu, loss, n_params
 
 
-def _decode_point():
-    """KV-cache greedy decode throughput (tokens/sec) on the bench model."""
+def _decode_roofline_tps(cfg, n_params: int, batch: int, avg_cache_len: int,
+                         hbm_bw: float) -> float:
+    """Bandwidth-bound decode tokens/s: each decode step must stream the
+    bf16 weights once (shared across the batch) plus each sequence's bf16
+    KV cache; tokens/s = batch / (bytes_per_step / HBM_BW).  Compute and
+    the int32 token traffic are negligible beside these two terms, so the
+    bound is tight for small batches (the reference publishes no decode
+    number; this roofline is the stated target per BASELINE.md)."""
+    param_bytes = 2 * n_params
+    kv_bytes = (batch * 2 * cfg.num_layers * cfg.kv_heads * cfg.head_dim
+                * avg_cache_len * 2)
+    return batch / ((param_bytes + kv_bytes) / hbm_bw)
+
+
+def _decode_point(hbm_bw: float):
+    """KV-cache greedy decode throughput (tokens/sec) on the bench model,
+    plus the fraction of the HBM-bandwidth roofline it achieves."""
     import jax
     import jax.numpy as jnp
 
@@ -156,6 +206,7 @@ def _decode_point():
     # cfg.attention_impl only affects the prefill, where flash is right.
     cfg = _bench_model(prompt_len + gen_len, "selective")
     params = model_lib.init_params(jax.random.key(0), cfg)
+    n_params = sum(p.size for p in jax.tree.leaves(params))
 
     rng = np.random.default_rng(1)
     tokens = np.zeros((b, prompt_len + gen_len), np.int32)
@@ -170,8 +221,32 @@ def _decode_point():
     t0 = time.perf_counter()
     out = generate_tokens(cfg, params, tokens, lengths, use_eos_stop=False)
     jax.device_get(out.tokens)
-    dt = time.perf_counter() - t0
-    return b * gen_len / dt
+    dt_full = time.perf_counter() - t0
+
+    # The roofline models per-step decode streaming only, so subtract the
+    # prefill forward (the same [b, prompt_len] cached forward the generate
+    # loop runs before its first decode step) from the measured window —
+    # otherwise the reported fraction is systematically understated by the
+    # prefill's share of dt.
+    rope = model_lib.rope_tables(cfg)
+
+    @jax.jit
+    def prefill(p, toks):
+        k, v = model_lib.init_kv_cache(cfg, b, prompt_len + gen_len)
+        logits, k, v = model_lib.forward_cached(
+            cfg, p, toks, k, v, jnp.int32(0), rope=rope)
+        return logits[:, -1]
+
+    jax.device_get(prefill(params, tokens[:, :prompt_len]))  # compile
+    t0 = time.perf_counter()
+    jax.device_get(prefill(params, tokens[:, :prompt_len]))
+    dt_prefill = time.perf_counter() - t0
+
+    dt = max(dt_full - dt_prefill, 1e-9)
+    tps = b * gen_len / dt
+    roof = _decode_roofline_tps(cfg, n_params, b,
+                                prompt_len + gen_len // 2, hbm_bw)
+    return tps, roof
 
 
 def _transient_error_types():
@@ -301,7 +376,25 @@ def main() -> None:
             curve.append({"seq_length": seq, "mfu": round(c_mfu, 4),
                           "tokens_per_sec": round(c_tps, 1)})
 
-    decode_tps = _point("decode", _decode_point)
+    # 7B-width point (BASELINE configs are all 7B–70B; the 374M proxy's
+    # matmuls are narrower than any of them).  Full remat + shallow depth
+    # to fit ~14 GB of train state in one chip's HBM; L=2 fallback if the
+    # L=3 state spills.
+    wide = None
+    for layers in (3, 2):
+        wide = _point(f"train@4096/7b-width-L{layers}", _train_point,
+                      4096, 1, "full", 5, peak,
+                      _bench_model_7b_width(4096, layers))
+        if wide is not None:
+            w_tps, w_mfu, _, w_params = wide
+            curve.append({"seq_length": 4096, "mfu": round(w_mfu, 4),
+                          "tokens_per_sec": round(w_tps, 1),
+                          "config": f"7b-width-L{layers}",
+                          "model_params": w_params})
+            break
+
+    hbm_bw = chip_hbm_bandwidth(platform)
+    decode = _point("decode", _decode_point, hbm_bw)
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     record = {
@@ -312,8 +405,12 @@ def main() -> None:
         "seq_length": 1024,
         "device": platform,
         "mfu_vs_seq": curve,
-        "decode_tokens_per_sec": (None if decode_tps is None
-                                  else round(decode_tps, 1)),
+        "decode_tokens_per_sec": (None if decode is None
+                                  else round(decode[0], 1)),
+        "decode_roofline_tokens_per_sec": (None if decode is None
+                                           else round(decode[1], 1)),
+        "decode_roofline_frac": (None if decode is None
+                                 else round(decode[0] / decode[1], 4)),
     }
     if headline is not None:
         record.update({

@@ -145,6 +145,7 @@ def pytest_collection_modifyitems(config, items):
             p == tests_root or tests_root.startswith(p + os.sep))
     full_suite = (all(_covers_suite(a) for a in config.args)
                   and not config.getoption("ignore", None)
+                  and not config.getoption("ignore_glob", None)
                   and not config.getoption("deselect", None))
     if stale and full_suite:
         raise pytest.UsageError(
