@@ -346,3 +346,78 @@ def t5_loss(cfg: ModelConfig, params: Params, batch: dict,
     per_tok = cross_entropy(logits, batch["labels"],
                             vocab_size=cfg.vocab_size)
     return masked_mean_loss(per_tok, batch["loss_mask"])
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel PartitionSpecs (full-stack parallelism for the secondary
+# families — the reference trains BERT/T5 through the same TP machinery as
+# GPT, megatron/core/parallel_state.py + pretrain_bert.py/pretrain_t5.py).
+#
+# Descope note: the reference also offers encoder/decoder SPLIT-RANK
+# pipeline parallelism for T5 (parallel_state.py:110-112,177-184 —
+# pipeline stages partitioned between the two stacks).  Here T5 runs
+# tp × dp (+ ZeRO-1); at the scale the reference ever trains T5 (≤11B,
+# secondary family) tensor sharding alone covers the memory need, and the
+# decoder's cross-attention would force every pipeline tick to carry the
+# full encoder output — a poor trade against the clean tp mapping.  The
+# decoder-only families keep full pp (parallel/pipeline.py).
+# ---------------------------------------------------------------------------
+
+
+
+
+def bert_param_specs(cfg: ModelConfig, parallel) -> Params:
+    """Specs matching ``init_bert_params``: vocab-parallel embedding +
+    Column/Row-parallel encoder stack; the small heads (MLM dense, pooler,
+    NSP) stay replicated as in the reference (bert_model.py uses plain
+    ``get_linear_layer`` for them)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .sharding import _layer_specs, norm_specs
+
+    return {
+        "embedding": {
+            "word": P("tp", None),
+            "position": P(None, None),
+            "tokentype": P(None, None),
+        },
+        "embed_norm": norm_specs(cfg),
+        "layers": _layer_specs(cfg, None, parallel.tensor_parallel),
+        "final_norm": norm_specs(cfg),
+        "lm_head": {
+            "dense": P(None, None),
+            "dense_bias": P(None),
+            "norm": norm_specs(cfg),
+            "bias": P("tp"),  # matches the vocab-sharded tied logits
+        },
+        "pooler": {"w": P(None, None), "b": P(None)},
+        "binary_head": {"w": P(None, None), "b": P(None)},
+    }
+
+
+def t5_param_specs(cfg: ModelConfig, parallel) -> Params:
+    """Specs matching ``init_t5_params``: both stacks Column/Row-parallel,
+    cross-attention sharded like self-attention (q/k/v column, output row)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .sharding import _layer_specs, kv_shard_axes, norm_specs
+
+    kv_tp = kv_shard_axes(cfg, parallel.tensor_parallel)
+    return {
+        "embedding": {
+            "word": P("tp", None),
+            "position": P(None, None),
+        },
+        "encoder": _layer_specs(cfg, None, parallel.tensor_parallel),
+        "decoder": _layer_specs(cfg, None, parallel.tensor_parallel),
+        "cross": {
+            "norm": norm_specs(cfg),  # [nd, h] leaves; unsharded
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, kv_tp),
+            "wv": P(None, None, kv_tp),
+            "wo": P(None, "tp", None),
+        },
+        "enc_norm": norm_specs(cfg),
+        "dec_norm": norm_specs(cfg),
+        "lm_head_bias": P("tp"),
+    }

@@ -38,6 +38,22 @@ CP = "cp"
 EP = "ep"
 
 
+def kv_shard_axes(cfg: ModelConfig, tp_size: int, tp_axes=TP):
+    """Mesh axes for K/V projections: shard over tp only if the kv heads
+    divide evenly — MQA (Falcon-7B kv=1) keeps K/V replicated on every tp
+    shard, which is what the reference does implicitly by tiling
+    (transformer.py:449-456)."""
+    return tp_axes if cfg.kv_heads % max(tp_size, 1) == 0 else None
+
+
+def norm_specs(cfg: ModelConfig, layer_axis: Optional[str] = None) -> Params:
+    """Spec subtree for one norm ({scale[, bias]}), optionally layer-stacked."""
+    s = {"scale": P(layer_axis, None) if layer_axis else P(None)}
+    if cfg.norm_type == "layernorm":
+        s["bias"] = P(layer_axis, None) if layer_axis else P(None)
+    return s
+
+
 def _layer_specs(cfg: ModelConfig, layer_axis: Optional[str],
                  tp_size: int, tp_axes=TP) -> Params:
     """Specs for one (stacked) layer pytree; leading dim = layer axis.
@@ -47,10 +63,7 @@ def _layer_specs(cfg: ModelConfig, layer_axis: Optional[str],
     (serving_param_specs)."""
     L = layer_axis  # None (scan only) or 'pp'
     TP = tp_axes  # noqa: N806 — shadows the module constant on purpose
-    # K/V projections: shard over tp only if the kv heads divide evenly —
-    # MQA (Falcon-7B kv=1) keeps K/V replicated on every tp shard, which is
-    # what the reference does implicitly by tiling (transformer.py:449-456).
-    kv_tp = TP if cfg.kv_heads % max(tp_size, 1) == 0 else None
+    kv_tp = kv_shard_axes(cfg, tp_size, tp_axes)
     attn = {
         "wq": P(L, None, TP),
         "wk": P(L, None, kv_tp),

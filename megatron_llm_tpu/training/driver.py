@@ -160,27 +160,34 @@ def setup_train_state(
         if parallel.pipeline_parallel > 1:
             params = pipe_lib.to_pipeline_params(params, parallel)
             pspecs = pipe_lib.pipeline_param_specs(pspecs, parallel)
-        params = shard_lib.shard_params(params, pspecs, mesh)
-        state = init_train_state(cfg, params)
-
-        ospecs = opt_lib.opt_state_specs(pspecs, params, parallel, state.opt)
-        state_spec = TrainState(
-            params=pspecs, opt=ospecs, iteration=P(), skipped=P())
-        state_sharding = jax.tree.map(
-            lambda s: NamedSharding(mesh, s), state_spec,
-            is_leaf=lambda x: isinstance(x, P))
+        state, state_sharding = _shard_train_state(cfg, mesh, params, pspecs)
         # [accum, micro_batch, seq] leaves: batch over dp, seq over cp (the
         # cp axis is size 1 unless context parallelism is on).
         batch_sharding = NamedSharding(mesh, P(None, "dp", "cp"))
-        state = jax.tree.map(
-            lambda x, s: jax.device_put(x, s), state, state_sharding)
-        state = _dedupe_buffers(state)
 
         # batch sharding is a pytree prefix: one sharding broadcast over
         # whatever keys the batch dict carries
         step_fn = make_train_step(cfg, mesh, state_sharding, batch_sharding)
     return TrainingArtifacts(cfg, mesh, state, state_sharding, batch_sharding,
                              step_fn, pspecs)
+
+
+def _shard_train_state(cfg: RuntimeConfig, mesh, params: PyTree,
+                       pspecs: PyTree):
+    """Shard params + fresh optimizer state (incl. ZeRO-1 dp specs when
+    enabled) onto ``mesh`` → (state, state_sharding).  Single home for the
+    sequence shared by setup_train_state and pretrain_custom."""
+    params = shard_lib.shard_params(params, pspecs, mesh)
+    state = init_train_state(cfg, params)
+    ospecs = opt_lib.opt_state_specs(pspecs, params, cfg.parallel, state.opt)
+    state_spec = TrainState(
+        params=pspecs, opt=ospecs, iteration=P(), skipped=P())
+    state_sharding = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), state_spec,
+        is_leaf=lambda x: isinstance(x, P))
+    state = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state, state_sharding)
+    return _dedupe_buffers(state), state_sharding
 
 
 def _put_batch(batch: dict, sharding) -> dict:
@@ -795,14 +802,17 @@ def pretrain_custom(
     loss_fn,
     valid_dataset=None,
     eval_loss_fn=None,
+    param_specs: Optional[PyTree] = None,
 ) -> TrainState:
-    """Data-parallel training of an arbitrary model family.
+    """Training loop for an arbitrary model family (BERT/T5/biencoder).
 
     ``dataset[i]`` yields a dict of numpy arrays; batches are stacked to
     [accum, micro_total, ...] and the step runs ``loss_fn(cfg, params,
-    microbatch, rng, deterministic)``.  Params stay replicated (dp only —
-    the secondary families don't need tp/pp, matching the reference's usage
-    of BERT/T5 as single-node models).
+    microbatch, rng, deterministic)``.  With ``param_specs`` the params
+    (and optimizer state, incl. ZeRO-1 over dp) are mesh-sharded — tensor
+    parallelism via GSPMD, the same full-stack path the reference gives
+    BERT/T5 (megatron/core/parallel_state.py); without it params stay
+    replicated (dp only).
     """
     cfg.validate()
     timers = Timers()
@@ -813,12 +823,17 @@ def pretrain_custom(
                               config=cfg.to_dict())
 
     mesh = mesh_lib.build_mesh(cfg.parallel)
-    state = init_train_state(cfg, params)
-    # Replicated params + dp-sharded batch; aliased constant buffers are
-    # copied so donation never sees the same buffer twice.
-    replicated = NamedSharding(mesh, P())
-    state_sharding = jax.tree.map(lambda _: replicated, state)
-    state = _dedupe_buffers(jax.device_put(state, replicated))
+    if param_specs is not None:
+        with mesh_lib.use_mesh(mesh):
+            state, state_sharding = _shard_train_state(
+                cfg, mesh, params, param_specs)
+    else:
+        state = init_train_state(cfg, params)
+        # Replicated params + dp-sharded batch; aliased constant buffers
+        # are copied so donation never sees the same buffer twice.
+        replicated = NamedSharding(mesh, P())
+        state_sharding = jax.tree.map(lambda _: replicated, state)
+        state = _dedupe_buffers(jax.device_put(state, replicated))
     batch_sharding = NamedSharding(mesh, P(None, "dp"))
     step_fn = make_train_step(cfg, mesh, state_sharding, batch_sharding,
                               loss_fn=loss_fn)

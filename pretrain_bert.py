@@ -42,6 +42,9 @@ def get_args(argv=None):
     p.add_argument("--save_interval", type=int, default=500)
     p.add_argument("--log_interval", type=int, default=10)
     p.add_argument("--data_parallel", type=int, default=1)
+    p.add_argument("--tensor_parallel", type=int, default=1)
+    p.add_argument("--use_distributed_optimizer", action="store_true",
+                   help="ZeRO-1: shard optimizer state over dp")
     p.add_argument("--seed", type=int, default=1234)
     p.add_argument("--masked_lm_prob", type=float, default=0.15)
     return p.parse_args(argv)
@@ -68,7 +71,10 @@ def bert_runtime_config(args, vocab_size: int) -> RuntimeConfig:
     )
     return RuntimeConfig(
         model=model,
-        parallel=ParallelConfig(data_parallel=args.data_parallel),
+        parallel=ParallelConfig(data_parallel=args.data_parallel,
+                                tensor_parallel=args.tensor_parallel,
+                                use_distributed_optimizer=
+                                args.use_distributed_optimizer),
         optimizer=OptimizerConfig(lr=args.lr, clip_grad=1.0),
         train=TrainConfig(
             train_iters=args.train_iters,
@@ -106,8 +112,11 @@ def main(argv=None):
         MMapIndexedDataset(args.data_path), cfg.train.seq_length,
         cfg.model.vocab_size, special,
         masked_lm_prob=args.masked_lm_prob, seed=args.seed)
-    params = encdec.init_bert_params(jax.random.key(args.seed), cfg.model)
-    return pretrain_custom(cfg, ds, params, bert_loss_fn)
+    params = encdec.init_bert_params(jax.random.key(args.seed), cfg.model,
+                                     tp=args.tensor_parallel)
+    specs = (encdec.bert_param_specs(cfg.model, cfg.parallel)
+             if args.tensor_parallel > 1 else None)
+    return pretrain_custom(cfg, ds, params, bert_loss_fn, param_specs=specs)
 
 
 if __name__ == "__main__":

@@ -46,6 +46,9 @@ def get_args(argv=None):
     p.add_argument("--save_interval", type=int, default=500)
     p.add_argument("--log_interval", type=int, default=10)
     p.add_argument("--data_parallel", type=int, default=1)
+    p.add_argument("--tensor_parallel", type=int, default=1)
+    p.add_argument("--use_distributed_optimizer", action="store_true",
+                   help="ZeRO-1: shard optimizer state over dp")
     p.add_argument("--seed", type=int, default=1234)
     p.add_argument("--masked_lm_prob", type=float, default=0.15)
     return p.parse_args(argv)
@@ -71,7 +74,10 @@ def t5_runtime_config(args) -> RuntimeConfig:
     )
     return RuntimeConfig(
         model=model,
-        parallel=ParallelConfig(data_parallel=args.data_parallel),
+        parallel=ParallelConfig(data_parallel=args.data_parallel,
+                                tensor_parallel=args.tensor_parallel,
+                                use_distributed_optimizer=
+                                args.use_distributed_optimizer),
         optimizer=OptimizerConfig(lr=args.lr, clip_grad=1.0),
         train=TrainConfig(
             train_iters=args.train_iters,
@@ -117,8 +123,11 @@ def main(argv=None):
         cfg.model.vocab_size, special,
         masked_lm_prob=args.masked_lm_prob, seed=args.seed,
         sentinel_ids=sentinel_ids)
-    params = encdec.init_t5_params(jax.random.key(args.seed), cfg.model)
-    return pretrain_custom(cfg, ds, params, t5_loss_fn)
+    params = encdec.init_t5_params(jax.random.key(args.seed), cfg.model,
+                                   tp=args.tensor_parallel)
+    specs = (encdec.t5_param_specs(cfg.model, cfg.parallel)
+             if args.tensor_parallel > 1 else None)
+    return pretrain_custom(cfg, ds, params, t5_loss_fn, param_specs=specs)
 
 
 if __name__ == "__main__":

@@ -122,6 +122,10 @@ _SLOW_TESTS = {
     "test_droppath_training_smoke_grads_finite",
     "test_tp_loss_and_grads_match_unsharded",
     "test_dense_index_retrieves_own_context",
+    "test_tp_sharded_loss_and_grads_match_unsharded",
+    "test_pretrain_t5_entrypoint_tensor_parallel",
+    "test_pretrain_bert_entrypoint_tensor_parallel",
+    "test_windowed_remat_bounds_memory_vpp2_large_M",
 }
 
 
