@@ -126,6 +126,15 @@ _SLOW_TESTS = {
     "test_pretrain_t5_entrypoint_tensor_parallel",
     "test_pretrain_bert_entrypoint_tensor_parallel",
     "test_windowed_remat_bounds_memory_vpp2_large_M",
+    # full-scale-dims trust path: the whole incremental chain is slow-
+    # marked together so the fast tier never skips a stage another stage
+    # depends on
+    "test_7bw_synthetic_weights_exist",
+    "test_7bw_meta_to_native",
+    "test_7bw_hf_to_native",
+    "test_7bw_meta_and_hf_paths_agree",
+    "test_7bw_reshard_tp8_logit_parity",
+    "test_7bw_native_to_hf_roundtrip",
 }
 
 
