@@ -34,6 +34,7 @@ from ..config import ModelConfig, PositionEmbeddingType
 from ..ops.activations import get_activation, is_glu
 from ..ops.attention import attention
 from ..ops.norms import norm_apply, norm_init
+from ..ops.quant import mm
 from ..ops.rope import apply_rope, precompute_rope_freqs
 
 Params = dict
@@ -212,9 +213,9 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
     nq = cfg.num_attention_heads
     nkv = cfg.kv_heads
 
-    q = x @ p["wq"]
-    k = x @ p["wk"]
-    v = x @ p["wv"]
+    q = mm(x, p["wq"])
+    k = mm(x, p["wk"])
+    v = mm(x, p["wv"])
     if "bq" in p:
         q = q + p["bq"]
         k = k + p["bk"]
@@ -272,7 +273,7 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
             block_q=cfg.flash_block_q,
             block_k=cfg.flash_block_k,
         )
-    out = ctx.reshape(b, s, nq * d) @ p["wo"]
+    out = mm(ctx.reshape(b, s, nq * d), p["wo"])
     if "bo" in p:
         out = out + p["bo"]
     if kv_cache is not None:
@@ -290,8 +291,8 @@ def mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
     tensor sharding never slices across the gate/up boundary."""
     act = get_activation(cfg.activation)
     if is_glu(cfg.activation):
-        gate = x @ p["w_gate"]
-        up = x @ p["w_up"]
+        gate = mm(x, p["w_gate"])
+        up = mm(x, p["w_up"])
         if "b_gate" in p:
             gate = gate + p["b_gate"]
             up = up + p["b_up"]
@@ -300,11 +301,11 @@ def mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
         hidden = jnp.concatenate([gate, up], axis=-1)
         hidden = act(hidden)
     else:
-        hidden = x @ p["w_up"]
+        hidden = mm(x, p["w_up"])
         if "b_up" in p:
             hidden = hidden + p["b_up"]
         hidden = act(hidden)
-    out = hidden @ p["w_down"]
+    out = mm(hidden, p["w_down"])
     if "b_down" in p:
         out = out + p["b_down"]
     return out

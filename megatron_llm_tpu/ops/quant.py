@@ -1,0 +1,124 @@
+"""Weight-only int8 quantization for serving.
+
+The reference's low-precision story is optional TransformerEngine FP8 on
+H100 (megatron/model/transformer.py:932-951, off by default).  The TPU
+equivalent worth having first is *weight-only int8 for decode*: bs=1..8
+generation is HBM-bandwidth-bound (see bench.py's decode roofline), so
+halving weight bytes is an up-to-2× decode speedup on v5e, and the MXU
+reads int8 natively.  Training stays bf16/fp32 — this is a serving
+transform, applied after load.
+
+Scheme: symmetric per-output-channel scales (the standard weight-only
+recipe): ``w ≈ q * scale`` with ``q ∈ int8[-127, 127]``,
+``scale = max|wـcol| / 127`` per output column.  A quantized weight is a
+plain ``{"q": int8 [in, out], "scale": fp32 [out]}`` subtree so pytree
+machinery (sharding specs, checkpointing) needs no custom node class.
+
+``mm(x, w)`` is the single matmul dispatch point used by the transformer
+blocks: plain arrays go straight to ``@``; quantized subtrees dequantize
+into the matmul (XLA fuses the convert+scale into the dot read, keeping
+the HBM traffic at int8).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+QUANT_KEYS = ("q", "scale")
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, dict) and set(w) == set(QUANT_KEYS)
+
+
+def quantize_weight(w: jax.Array) -> dict:
+    """[in, out] (or layer-stacked [L, in, out]) weight →
+    {"q": int8, "scale": fp32 [out] / [L, out]} — symmetric,
+    per-output-channel (reduction over the input axis, -2)."""
+    w32 = jnp.asarray(w, jnp.float32)
+    scale = jnp.max(jnp.abs(w32), axis=-2) / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(w32 / scale[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def dequantize_weight(qw: dict, dtype=jnp.float32) -> jax.Array:
+    return (qw["q"].astype(jnp.float32)
+            * qw["scale"][..., None, :]).astype(dtype)
+
+
+def mm(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` for plain or quantized ``w``.
+
+    Quantized path: dequantize in the compute dtype of ``x`` — the scale
+    multiply is applied to the *output* (columns), which is algebraically
+    identical to scaling the weight but keeps the inner dot int8→x.dtype
+    with a [out]-vector epilogue XLA fuses for free.
+    """
+    if is_quantized(w):
+        y = x @ w["q"].astype(x.dtype)
+        return y * w["scale"].astype(x.dtype)
+    return x @ w
+
+
+# Weight leaves worth quantizing: the big projection matmuls.  Norm scales,
+# biases, router (precision-sensitive) and embeddings stay as-is —
+# embeddings are gathers (already cheap per token) and the lm_head's fp32
+# logits matter for sampling quality.
+_QUANT_LEAF_NAMES = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"})
+
+
+def quantize_params(params: dict) -> dict:
+    """Serving transform: quantize every layer projection weight in a
+    *flat-layout* native param tree (matching is by leaf name; dense 2D or
+    layer-stacked 3D weights only — convert pipeline checkpoints with
+    ``parallel.pipeline.from_pipeline_params`` first, exactly as serving
+    already requires)."""
+
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        for k, v in tree.items():
+            # ndim guard: dense [in, out] or layer-stacked [L, in, out]
+            # only.  MoE expert stacks ([L, E, h, f]) flow through einsums
+            # in models/moe.py, not mm() — leave them unquantized.
+            if (k in _QUANT_LEAF_NAMES and not isinstance(v, dict)
+                    and v.ndim in (2, 3)):
+                out[k] = quantize_weight(v)
+            else:
+                out[k] = walk(v)
+        return out
+
+    return walk(params)
+
+
+def quantize_specs(specs: dict) -> dict:
+    """Mirror of :func:`quantize_params` for a PartitionSpec tree: a leaf
+    spec P(..., a) becomes {"q": P(..., a), "scale": P(a)} — the scale
+    vector lives on the weight's output axis."""
+    from jax.sharding import PartitionSpec as P
+
+    def walk(tree):
+        if isinstance(tree, P):
+            return tree
+        out = {}
+        for k, v in tree.items():
+            t = tuple(v) if isinstance(v, P) else ()
+            # rank-4 specs are MoE expert stacks [L, E, h, f], which
+            # quantize_params skips (they flow through einsums) — the
+            # spec must stay a plain leaf to mirror the param tree.
+            if (k in _QUANT_LEAF_NAMES and isinstance(v, P)
+                    and len(t) != 4):
+                # scale drops the input (-2) axis of the weight spec:
+                # P(a, b, c) [L, in, out] → scale [L, out] spec P(a, c)
+                out[k] = {"q": v, "scale": P(*t[:-2], t[-1]) if len(t) >= 2
+                          else P()}
+            else:
+                out[k] = walk(v)
+        return out
+
+    return walk(specs)

@@ -25,6 +25,9 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--max_batch_size", type=int, default=8)
     ap.add_argument("--max_tokens_to_generate", type=int, default=1024)
+    ap.add_argument("--quantize", default=None, choices=["int8"],
+                    help="weight-only int8 (halves decode HBM traffic; "
+                         "ops/quant.py)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel shards for serving")
     ap.add_argument("--pp", type=int, default=1,
@@ -46,6 +49,11 @@ def main(argv=None) -> int:
     lm = factory(args.size)
     tokenizer = build_tokenizer(args.tokenizer_type, args.tokenizer_model)
     params = load_params_for_inference(args.load, lm.cfg)
+    if args.quantize == "int8":
+        from ..ops.quant import quantize_params
+
+        params = quantize_params(params)
+        print("weights quantized to int8 (per-output-channel)")
 
     mesh_ctx = None
     if args.tp > 1 or args.pp > 1:

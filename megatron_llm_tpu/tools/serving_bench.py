@@ -25,7 +25,8 @@ import time
 
 
 def run(model: str, size: str, tp: int, pp: int, batch: int,
-        prompt_len: int, gen_len: int, params_dtype: str) -> dict:
+        prompt_len: int, gen_len: int, params_dtype: str,
+        quantize: str | None = None) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -51,6 +52,10 @@ def run(model: str, size: str, tp: int, pp: int, batch: int,
     parallel = ParallelConfig(pipeline_parallel=pp, tensor_parallel=tp)
     params = model_lib.init_params(jax.random.key(0), cfg,
                                    tp=max(tp * pp, 1))
+    if quantize == "int8":
+        from ..ops.quant import quantize_params
+
+        params = quantize_params(params)
     params, mesh = shard_lib.shard_for_serving(params, cfg, parallel)
 
     rng = np.random.default_rng(0)
@@ -78,6 +83,7 @@ def run(model: str, size: str, tp: int, pp: int, batch: int,
         "prompt_len": prompt_len,
         "gen_len": gen_len,
         "device": jax.devices()[0].device_kind,
+        "quantize": quantize,
     }
 
 
@@ -92,9 +98,10 @@ def main(argv=None) -> int:
     ap.add_argument("--gen", type=int, default=128)
     ap.add_argument("--params_dtype", default="bfloat16",
                     choices=["float32", "bfloat16", "float16"])
+    ap.add_argument("--quantize", default=None, choices=["int8"])
     args = ap.parse_args(argv)
     rec = run(args.model, args.size, args.tp, args.pp, args.batch,
-              args.prompt, args.gen, args.params_dtype)
+              args.prompt, args.gen, args.params_dtype, args.quantize)
     print(json.dumps(rec))
     return 0
 
