@@ -81,14 +81,18 @@ def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
 
     GSPMD has no partitioning rule for the ``pallas_call`` over a
     kv-head-sharded cache, so the kernel is wrapped in a ``shard_map``
-    manual over the tensor axis only (heads/kv-heads are tp-sharded per
-    models/sharding.py; batch/dp and the rest stay GSPMD-managed — the
-    partial-manual pattern of parallel/ring_attention.py).  Returns None
-    when the head counts don't divide tp (MQA keeps K/V replicated and
-    the einsum path is already correct there) — the caller falls back.
+    manual over the head-sharding axes only (batch/dp and the rest stay
+    GSPMD-managed — the partial-manual pattern of
+    parallel/ring_attention.py).  The head axes are tp alone for the
+    training layout, or (pp, tp) combined under the serving re-layout
+    (models/sharding.py:serving_param_specs — decode only ever runs with
+    pp *joined into* tp, so a pp axis here always means the re-layout).
+    Returns None when the head counts don't divide the combined factor
+    (MQA keeps K/V replicated and the einsum path is already correct
+    there) — the caller falls back.
     """
     from jax.sharding import PartitionSpec as P
-    from ..parallel.mesh import TENSOR_AXIS
+    from ..parallel.mesh import PIPELINE_AXIS, TENSOR_AXIS
 
     if TENSOR_AXIS not in mesh.axis_names:
         return None
@@ -96,20 +100,38 @@ def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
         # already inside a manual-tp shard_map: shapes are per-shard and
         # the pallas_call sees local arrays — call straight through.
         return _kernel_decode(q, k_cache, v_cache, cache_len, softmax_scale)
-    tp = mesh.shape[TENSOR_AXIS]
+    combined = tuple(a for a in (PIPELINE_AXIS, TENSOR_AXIS)
+                     if a in mesh.axis_names
+                     and a not in getattr(mesh, "manual_axes", ())
+                     and mesh.shape[a] > 1)
     n_heads, kv_heads = q.shape[2], k_cache.shape[1]
-    if tp > 1 and (n_heads % tp or kv_heads % tp):
+    # Prefer the serving re-layout's combined (pp, tp) head sharding; a
+    # training-layout mesh whose head counts only divide tp (pp shards
+    # layers there, not heads) keeps its tp-only kernel path.  The
+    # shard_map in_specs respec the operands, so either choice is
+    # correct — this only picks the layout that avoids resharding.
+    axes = None
+    for cand in (combined, (TENSOR_AXIS,)):
+        if not cand:
+            continue
+        shards = 1
+        for a in cand:
+            shards *= mesh.shape[a]
+        if n_heads % shards == 0 and kv_heads % shards == 0:
+            axes = cand
+            break
+    if axes is None:
         return None
 
     wrapped = jax.shard_map(
         lambda q_, kc, vc, ln: _kernel_decode(q_, kc, vc, ln, softmax_scale),
         mesh=mesh,
-        in_specs=(P(None, None, TENSOR_AXIS, None),
-                  P(None, TENSOR_AXIS, None, None),
-                  P(None, TENSOR_AXIS, None, None),
+        in_specs=(P(None, None, axes, None),
+                  P(None, axes, None, None),
+                  P(None, axes, None, None),
                   P()),
-        out_specs=P(None, None, TENSOR_AXIS, None),
-        axis_names={TENSOR_AXIS},
+        out_specs=P(None, None, axes, None),
+        axis_names=set(axes),
         check_vma=False,
     )
     return wrapped(q, k_cache, v_cache, jnp.asarray(cache_len, jnp.int32))
@@ -165,9 +187,10 @@ def decode_attention(
         # single-token decode: the Pallas kernel streams the cache through
         # VMEM at near-HBM bandwidth where the XLA lowering runs a kLoop
         # multiply-reduce fusion at a few percent of it.  Under an active
-        # mesh the kernel runs inside a shard_map manual over the tp axis
-        # (kv-head-sharded cache); only un-divisible head counts fall back
-        # to the einsum path.
+        # mesh the kernel runs inside a shard_map manual over the
+        # head-sharding axes — (pp, tp) for the serving re-layout, tp for
+        # the training layout; only head counts dividing neither fall
+        # back to the einsum path.
         mesh = _active_mesh()
         if mesh is None:
             return _kernel_decode(q, k_cache, v_cache, cache_len,

@@ -120,3 +120,65 @@ def test_mqa_under_mesh_falls_back_to_einsum(monkeypatch):
         )(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_path_under_pp_tp_serving_mesh(monkeypatch):
+    """Serving re-layout (pp joins tp): the kernel shard_map goes manual
+    over BOTH axes so the cache stays resident per shard; parity vs the
+    einsum path."""
+    pp, tp = 2, 2
+    rng = np.random.default_rng(3)
+    q, k, v = _rand_qkv(rng, 2, 8, 4, 256, 128)
+    want = decode_attention(q, k, v, jnp.int32(100))
+
+    mesh = mesh_lib.build_mesh(
+        ParallelConfig(pipeline_parallel=pp, tensor_parallel=tp))
+    axes = ("pp", "tp")
+    qs = jax.device_put(q, NamedSharding(mesh, P(None, None, axes, None)))
+    ks = jax.device_put(k, NamedSharding(mesh, P(None, axes, None, None)))
+    vs = jax.device_put(v, NamedSharding(mesh, P(None, axes, None, None)))
+
+    called = {}
+    real = attn_mod._kernel_decode
+
+    def spy(*a, **kw):
+        called["yes"] = True
+        return real(*a, **kw)
+
+    monkeypatch.setattr(attn_mod, "_kernel_decode", spy)
+    monkeypatch.setattr(attn_mod, "_backend", lambda: "tpu")
+    with mesh_lib.use_mesh(mesh):
+        got = jax.jit(
+            lambda q_, k_, v_: decode_attention(q_, k_, v_, jnp.int32(100))
+        )(qs, ks, vs)
+    assert called.get("yes"), "serving-relayout kernel path was not taken"
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kv_heads_not_divisible_by_pp_tp_falls_back(monkeypatch):
+    """kv=2 under pp·tp=4 can't shard the cache over the combined axes;
+    the dispatcher drops to the tp-only kernel layout (kv=2 divides
+    tp=2) and numerics stay exact — the training-layout path is never
+    regressed by the serving-relayout preference."""
+    rng = np.random.default_rng(4)
+    q, k, v = _rand_qkv(rng, 2, 8, 2, 256, 128)
+    want = decode_attention(q, k, v, jnp.int32(60))
+    mesh = mesh_lib.build_mesh(
+        ParallelConfig(pipeline_parallel=2, tensor_parallel=2))
+    called = {}
+    real = attn_mod._kernel_decode
+
+    def spy(*a, **kw):
+        called["yes"] = True
+        return real(*a, **kw)
+
+    monkeypatch.setattr(attn_mod, "_kernel_decode", spy)
+    monkeypatch.setattr(attn_mod, "_backend", lambda: "tpu")
+    with mesh_lib.use_mesh(mesh):
+        got = jax.jit(
+            lambda q_, k_, v_: decode_attention(q_, k_, v_, jnp.int32(60))
+        )(q, k, v)
+    assert called.get("yes"), "tp-only kernel layout was not taken"
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
