@@ -177,23 +177,25 @@ def _train_point(seq: int, mb: int, recompute: str, iters: int, peak: float,
     return tokens_per_sec, mfu, loss, n_params
 
 
-def _decode_roofline_tps(cfg, n_params: int, batch: int, avg_cache_len: int,
-                         hbm_bw: float) -> float:
+def _decode_roofline_tps(cfg, param_bytes: int, batch: int,
+                         avg_cache_len: int, hbm_bw: float) -> float:
     """Bandwidth-bound decode tokens/s: each decode step must stream the
-    bf16 weights once (shared across the batch) plus each sequence's bf16
-    KV cache; tokens/s = batch / (bytes_per_step / HBM_BW).  Compute and
-    the int32 token traffic are negligible beside these two terms, so the
-    bound is tight for small batches (the reference publishes no decode
-    number; this roofline is the stated target per BASELINE.md)."""
-    param_bytes = 2 * n_params
+    weights once (shared across the batch; ``param_bytes`` = actual stored
+    bytes, so int8 quantization moves the roofline) plus each sequence's
+    bf16 KV cache; tokens/s = batch / (bytes_per_step / HBM_BW).  Compute
+    and the int32 token traffic are negligible beside these two terms, so
+    the bound is tight for small batches (the reference publishes no
+    decode number; this roofline is the stated target per BASELINE.md)."""
     kv_bytes = (batch * 2 * cfg.num_layers * cfg.kv_heads * cfg.head_dim
                 * avg_cache_len * 2)
     return batch / ((param_bytes + kv_bytes) / hbm_bw)
 
 
-def _decode_point(hbm_bw: float):
+def _decode_point(hbm_bw: float, quantize: bool = False):
     """KV-cache greedy decode throughput (tokens/sec) on the bench model,
-    plus the fraction of the HBM-bandwidth roofline it achieves."""
+    plus the fraction of the HBM-bandwidth roofline it achieves.  With
+    ``quantize`` the weights are int8 (ops/quant.py) and the roofline's
+    weight term shrinks to 1 byte/param."""
     import jax
     import jax.numpy as jnp
 
@@ -206,7 +208,10 @@ def _decode_point(hbm_bw: float):
     # cfg.attention_impl only affects the prefill, where flash is right.
     cfg = _bench_model(prompt_len + gen_len, "selective")
     params = model_lib.init_params(jax.random.key(0), cfg)
-    n_params = sum(p.size for p in jax.tree.leaves(params))
+    if quantize:
+        from megatron_llm_tpu.ops.quant import quantize_params
+
+        params = quantize_params(params)
 
     rng = np.random.default_rng(1)
     tokens = np.zeros((b, prompt_len + gen_len), np.int32)
@@ -244,7 +249,9 @@ def _decode_point(hbm_bw: float):
 
     dt = max(dt_full - dt_prefill, 1e-9)
     tps = b * gen_len / dt
-    roof = _decode_roofline_tps(cfg, n_params, b,
+    param_bytes = sum(p.size * p.dtype.itemsize
+                      for p in jax.tree.leaves(params))
+    roof = _decode_roofline_tps(cfg, param_bytes, b,
                                 prompt_len + gen_len // 2, hbm_bw)
     return tps, roof
 
@@ -395,6 +402,7 @@ def main() -> None:
 
     hbm_bw = chip_hbm_bandwidth(platform)
     decode = _point("decode", _decode_point, hbm_bw)
+    decode_q = _point("decode/int8", _decode_point, hbm_bw, True)
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     record = {
@@ -411,6 +419,11 @@ def main() -> None:
                                            else round(decode[1], 1)),
         "decode_roofline_frac": (None if decode is None
                                  else round(decode[0] / decode[1], 4)),
+        "decode_tokens_per_sec_int8": (None if decode_q is None
+                                       else round(decode_q[0], 1)),
+        "decode_int8_roofline_frac": (None if decode_q is None
+                                      else round(decode_q[0] / decode_q[1],
+                                                 4)),
     }
     if headline is not None:
         record.update({

@@ -30,34 +30,45 @@ def _run_main(monkeypatch, train_fn, decode_fn):
     return json.loads(lines[0])
 
 
-def _ok_train(seq, mb, rc, iters, peak):
+def _ok_train(seq, mb, rc, iters, peak, model=None):
     return 1000.0 * 1024 / seq, 0.5, 2.0, 123456
 
 
+def _ok_decode(hbm_bw, quantize=False):
+    # (tokens/sec, roofline tokens/sec)
+    return (3000.0, 8000.0) if quantize else (2000.0, 7000.0)
+
+
 def test_all_points_ok(monkeypatch):
-    rec = _run_main(monkeypatch, _ok_train, lambda: 2000.0)
+    rec = _run_main(monkeypatch, _ok_train, _ok_decode)
     assert rec["metric"] == "mfu" and rec["value"] == 0.5
     assert rec["decode_tokens_per_sec"] == 2000.0
-    assert len(rec["mfu_vs_seq"]) == 5
+    assert rec["decode_roofline_frac"] == round(2000.0 / 7000.0, 4)
+    assert rec["decode_tokens_per_sec_int8"] == 3000.0
+    # 5 seq points + the 7B-width point
+    assert len(rec["mfu_vs_seq"]) == 6
+    assert any(p.get("config", "").startswith("7b-width")
+               for p in rec["mfu_vs_seq"])
 
 
 def test_decode_crash_keeps_headline(monkeypatch):
-    def bad_decode():
+    def bad_decode(hbm_bw, quantize=False):
         raise NameError("boom")  # the round-2 failure class
 
     rec = _run_main(monkeypatch, _ok_train, bad_decode)
     assert rec["value"] == 0.5 and rec["vs_baseline"] is not None
     assert rec["decode_tokens_per_sec"] is None
-    assert len(rec["mfu_vs_seq"]) == 5
+    assert rec["decode_tokens_per_sec_int8"] is None
+    assert len(rec["mfu_vs_seq"]) == 6
 
 
 def test_one_curve_point_crash_keeps_rest(monkeypatch):
-    def train(seq, mb, rc, iters, peak):
+    def train(seq, mb, rc, iters, peak, model=None):
         if seq == 16384:
             raise TypeError("deterministic bug at one seq")
-        return _ok_train(seq, mb, rc, iters, peak)
+        return _ok_train(seq, mb, rc, iters, peak, model)
 
-    rec = _run_main(monkeypatch, train, lambda: 2000.0)
+    rec = _run_main(monkeypatch, train, _ok_decode)
     assert rec["value"] == 0.5
     seqs = [p["seq_length"] for p in rec["mfu_vs_seq"]]
     assert 16384 not in seqs and 32768 in seqs
@@ -66,11 +77,11 @@ def test_one_curve_point_crash_keeps_rest(monkeypatch):
 def test_headline_crash_uses_fallback_then_partial(monkeypatch):
     calls = []
 
-    def train(seq, mb, rc, iters, peak):
+    def train(seq, mb, rc, iters, peak, model=None):
         calls.append((seq, mb))
         raise ValueError("always fails")
 
-    rec = _run_main(monkeypatch, train, lambda: 2000.0)
+    rec = _run_main(monkeypatch, train, _ok_decode)
     # primary + fallback headline attempted, then every curve point
     assert (1024, 12) in calls and (1024, 8) in calls
     assert rec["value"] is None and rec["mfu_vs_seq"] == []
