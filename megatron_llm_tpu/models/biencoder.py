@@ -26,16 +26,17 @@ from .transformer import Params, _normal
 
 def init_biencoder_params(key: jax.Array, cfg: ModelConfig,
                           projection_dim: int = 0,
-                          shared: bool = False) -> Params:
+                          shared: bool = False, tp: int = 1) -> Params:
     """Query + context towers (+ optional linear projection head).
 
     ``projection_dim`` > 0 adds the REALM-style embedding projection
     (biencoder_model.py projection_dim); 0 uses the pooled [CLS] directly.
+    ``tp`` pads the vocab for tensor sharding (biencoder_param_specs).
     """
     kq, kc, kp = jax.random.split(key, 3)
 
     def tower(k):
-        t = encdec.init_bert_params(k, cfg)
+        t = encdec.init_bert_params(k, cfg, tp=tp)
         t.pop("lm_head")
         t.pop("binary_head")
         return t
@@ -203,3 +204,28 @@ class DenseIndex:
         order = np.argsort(-part_scores, axis=-1)
         idx = np.take_along_axis(part, order, axis=-1)
         return idx, np.take_along_axis(scores, idx, axis=-1)
+
+
+def biencoder_param_specs(cfg: ModelConfig, parallel,
+                          projection_dim: int = 0,
+                          shared: bool = False) -> Params:
+    """Tensor-parallel PartitionSpecs matching ``init_biencoder_params``:
+    each tower is a BERT trunk (encdec.bert_param_specs minus the MLM and
+    NSP heads); the small projection heads stay replicated (reference
+    biencoder_model.py uses plain linear layers there)."""
+    from jax.sharding import PartitionSpec as P
+
+    def tower_specs():
+        t = encdec.bert_param_specs(cfg, parallel)
+        t.pop("lm_head")
+        t.pop("binary_head")
+        return t
+
+    specs: Params = {"query": tower_specs()}
+    if not shared:
+        specs["context"] = tower_specs()
+    if projection_dim:
+        specs["projection"] = {"q": P(None, None)}
+        if not shared:
+            specs["projection"]["c"] = P(None, None)
+    return specs

@@ -50,6 +50,9 @@ def get_args(argv=None):
     p.add_argument("--save_interval", type=int, default=500)
     p.add_argument("--log_interval", type=int, default=10)
     p.add_argument("--data_parallel", type=int, default=1)
+    p.add_argument("--tensor_parallel", type=int, default=1)
+    p.add_argument("--use_distributed_optimizer", action="store_true",
+                   help="ZeRO-1: shard optimizer state over dp")
     p.add_argument("--seed", type=int, default=1234)
     p.add_argument("--tokenizer_model", default=None,
                    help="HF tokenizer path/name: derives vocab + special "
@@ -114,7 +117,10 @@ def main(argv=None):
     )
     cfg = RuntimeConfig(
         model=model,
-        parallel=ParallelConfig(data_parallel=args.data_parallel),
+        parallel=ParallelConfig(data_parallel=args.data_parallel,
+                                tensor_parallel=args.tensor_parallel,
+                                use_distributed_optimizer=
+                                args.use_distributed_optimizer),
         optimizer=OptimizerConfig(lr=args.lr, clip_grad=1.0),
         train=TrainConfig(
             train_iters=args.train_iters,
@@ -134,14 +140,22 @@ def main(argv=None):
     params = biencoder.init_biencoder_params(
         jax.random.key(args.seed), cfg.model,
         projection_dim=args.projection_dim,
-        shared=args.shared_query_context_model)
+        shared=args.shared_query_context_model,
+        tp=args.tensor_parallel)
+    specs = (biencoder.biencoder_param_specs(
+                 cfg.model, cfg.parallel,
+                 projection_dim=args.projection_dim,
+                 shared=args.shared_query_context_model)
+             if (args.tensor_parallel > 1
+                 or args.use_distributed_optimizer) else None)
 
     def loss_fn(rcfg, p, mb, rng, deterministic):
         return biencoder.retrieval_loss(rcfg.model, p, mb, rng,
                                         deterministic,
                                         pooling=args.pooling)
 
-    return pretrain_custom(cfg, ds, params, loss_fn)
+    return pretrain_custom(cfg, ds, params, loss_fn,
+                           param_specs=specs)
 
 
 if __name__ == "__main__":

@@ -126,7 +126,8 @@ def main(argv=None):
     params = encdec.init_t5_params(jax.random.key(args.seed), cfg.model,
                                    tp=args.tensor_parallel)
     specs = (encdec.t5_param_specs(cfg.model, cfg.parallel)
-             if args.tensor_parallel > 1 else None)
+             if (args.tensor_parallel > 1
+                 or args.use_distributed_optimizer) else None)
     return pretrain_custom(cfg, ds, params, t5_loss_fn, param_specs=specs)
 
 

@@ -135,6 +135,7 @@ _SLOW_TESTS = {
     "test_7bw_meta_and_hf_paths_agree",
     "test_7bw_reshard_tp8_logit_parity",
     "test_7bw_native_to_hf_roundtrip",
+    "test_pretrain_ict_entrypoint_tensor_parallel",
 }
 
 

@@ -115,3 +115,28 @@ def test_pretrain_bert_entrypoint_tensor_parallel(corpus, tmp_path):
     assert int(state.iteration) == 3
     word = state.params["embedding"]["word"]
     assert "tp" in str(word.sharding.spec)
+
+
+def test_pretrain_ict_entrypoint_tensor_parallel(corpus, tmp_path):
+    """ICT biencoder through tp=2 × dp=2 (both towers sharded by
+    biencoder_param_specs)."""
+    import pretrain_ict
+
+    state = pretrain_ict.main([
+        "--data_path", corpus,
+        "--vocab_size", "96",
+        "--hidden_size", "32", "--num_layers", "2",
+        "--num_attention_heads", "4",
+        "--query_seq_length", "16", "--block_seq_length", "48",
+        "--projection_dim", "16",
+        "--micro_batch_size", "4", "--global_batch_size", "8",
+        "--train_iters", "3", "--log_interval", "1",
+        "--data_parallel", "2", "--tensor_parallel", "2",
+        "--use_distributed_optimizer",
+    ])
+    assert int(state.iteration) == 3
+    word = state.params["query"]["embedding"]["word"]
+    assert "tp" in str(word.sharding.spec)
+    # ZeRO-1 reaches the two-tower tree: moments sharded over dp
+    mu_word = state.opt.mu["query"]["embedding"]["word"]
+    assert "dp" in str(mu_word.sharding.spec)
