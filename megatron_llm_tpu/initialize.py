@@ -72,3 +72,44 @@ def initialize_distributed(
 
 def is_initialized() -> bool:
     return _initialized
+
+
+# ---------------------------------------------------------------------------
+# Recommended XLA performance flags (the TPU analogue of the reference's
+# CUDA_DEVICE_MAX_CONNECTIONS=1 overlap contract, arguments.py:340-348 —
+# there the ordering hack *enables* comm/compute overlap; here the
+# latency-hiding scheduler owns overlap and these knobs widen it)
+# ---------------------------------------------------------------------------
+
+# Ordered dict of flag → why.  Not applied automatically: XLA_FLAGS must be
+# set before backend initialization, which usually happens at import time —
+# a library mutating os.environ post-import would silently do nothing.  Use
+# `python -m megatron_llm_tpu.initialize` to print an export line, or call
+# performance_xla_flags() from a launcher before importing jax.
+PERFORMANCE_XLA_FLAGS = {
+    "--xla_tpu_enable_data_parallel_all_reduce_opt=true":
+        "dp gradient all-reduce decomposition/overlap",
+    "--xla_tpu_data_parallel_opt_different_sized_ops=true":
+        "extend the dp overlap pass to mixed-size reduction ops",
+    "--xla_tpu_enable_async_collective_fusion=true":
+        "run collective-fusion regions asynchronously",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true":
+        "include ZeRO-1 param all-gathers in async fusion",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true":
+        "let async collectives span multiple schedule steps",
+    "--xla_tpu_overlap_compute_collective_tc=true":
+        "overlap TensorCore compute with collectives",
+    "--xla_enable_async_all_gather=true":
+        "async all-gathers generally (sp/tp gathers)",
+}
+
+
+def performance_xla_flags() -> str:
+    """Space-joined recommended flags, for prepending to ``XLA_FLAGS``."""
+    return " ".join(PERFORMANCE_XLA_FLAGS)
+
+
+if __name__ == "__main__":
+    existing = os.environ.get("XLA_FLAGS", "")
+    print(f"export XLA_FLAGS=\"{existing + ' ' if existing else ''}"
+          f"{performance_xla_flags()}\"")

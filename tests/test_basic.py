@@ -44,3 +44,13 @@ def test_initialize_distributed_single_host_noop():
     initialize_distributed()
     assert is_initialized()
     initialize_distributed()  # second call is a no-op
+
+
+def test_performance_xla_flags_wellformed():
+    from megatron_llm_tpu.initialize import (PERFORMANCE_XLA_FLAGS,
+                                             performance_xla_flags)
+
+    s = performance_xla_flags()
+    assert all(f.startswith("--xla") and "=" in f
+               for f in PERFORMANCE_XLA_FLAGS)
+    assert all(f in s for f in PERFORMANCE_XLA_FLAGS)
