@@ -186,16 +186,19 @@ def _decode_roofline_tps(cfg, param_bytes: int, batch: int,
     and the int32 token traffic are negligible beside these two terms, so
     the bound is tight for small batches (the reference publishes no
     decode number; this roofline is the stated target per BASELINE.md)."""
-    kv_bytes = (batch * 2 * cfg.num_layers * cfg.kv_heads * cfg.head_dim
-                * avg_cache_len * 2)
+    kv_elt_bytes = (1 + 4 / cfg.head_dim
+                    if cfg.kv_cache_quant == "int8" else 2)
+    kv_bytes = int(batch * 2 * cfg.num_layers * cfg.kv_heads
+                   * cfg.head_dim * avg_cache_len * kv_elt_bytes)
     return batch / ((param_bytes + kv_bytes) / hbm_bw)
 
 
 def _decode_point(hbm_bw: float, quantize: bool = False):
     """KV-cache greedy decode throughput (tokens/sec) on the bench model,
     plus the fraction of the HBM-bandwidth roofline it achieves.  With
-    ``quantize`` the weights are int8 (ops/quant.py) and the roofline's
-    weight term shrinks to 1 byte/param."""
+    ``quantize`` both the weights (ops/quant.py) AND the KV cache
+    (ops/kv_quant.py) are int8, and both roofline terms shrink
+    accordingly."""
     import jax
     import jax.numpy as jnp
 
@@ -207,6 +210,10 @@ def _decode_point(hbm_bw: float, quantize: bool = False):
     # decode_attention): Pallas decode kernel on TPU, einsum fallback —
     # cfg.attention_impl only affects the prefill, where flash is right.
     cfg = _bench_model(prompt_len + gen_len, "selective")
+    if quantize:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, kv_cache_quant="int8").validate()
     params = model_lib.init_params(jax.random.key(0), cfg)
     if quantize:
         from megatron_llm_tpu.ops.quant import quantize_params

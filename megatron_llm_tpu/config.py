@@ -95,6 +95,10 @@ class ModelConfig:
     rope_beta_fast: float = 32.0
     rope_beta_slow: float = 1.0
     rope_attention_factor: Optional[float] = None
+    # serving: "int8" stores the decode KV cache as int8 + per-row scales
+    # (ops/kv_quant.py) — half the cache HBM traffic per decode step;
+    # training is unaffected (the cache exists only on the decode path)
+    kv_cache_quant: str = "none"
     # structure flags
     use_bias: bool = False  # bias on linear layers (GPT yes, Llama no)
     qkv_bias: bool = False  # Falcon-7B style attention bias
@@ -223,6 +227,8 @@ class ModelConfig:
             assert not self.use_bias, (
                 "MoE MLPs are bias-free (models/moe.py); use_bias=True with "
                 "num_experts > 0 is not supported")
+        assert self.kv_cache_quant in ("none", "int8"), (
+            f"unknown kv_cache_quant {self.kv_cache_quant!r}")
         return self
 
 

@@ -264,8 +264,11 @@ def _beam_search_impl(cfg: ModelConfig, params, prompt,  # [prompt_len]
             tokens, alive_words[:, None].astype(jnp.int32), (0, cur))
         # Reorder the KV cache to follow the surviving beams (reference:
         # swap_key_value_dict, forward_step.py/generation.py:383-386).
-        k_cache = jnp.take(k_cache, alive_beam_ids, axis=1)
-        v_cache = jnp.take(v_cache, alive_beam_ids, axis=1)
+        # tree.map: the int8 cache is a {"q", "scale"} pytree whose leaves
+        # all carry the beam on axis 1 ([L, b, ...]).
+        k_cache, v_cache = jax.tree.map(
+            lambda a: jnp.take(a, alive_beam_ids, axis=1),
+            (k_cache, v_cache))
 
         logits, k_cache, v_cache = model_lib.forward_cached(
             cfg, params, alive_words[:, None].astype(jnp.int32),

@@ -67,19 +67,63 @@ def _decode_kernel(scale: float, nk: int, block_k: int,
                        ).astype(o_ref.dtype)
 
 
-def flash_decode(
-    q: jax.Array,        # [b, n_heads, d] — ONE new token's queries
-    k_cache: jax.Array,  # [b, kv_heads, max_len, d]
-    v_cache: jax.Array,
-    cache_len: jax.Array,  # scalar int32: valid slots = cache_len (incl. new)
-    *,
-    softmax_scale: float | None = None,
-    block_k: int = 512,
-    interpret: bool | None = None,
-) -> jax.Array:
-    """→ [b, n_heads, d] attention output for the single new token."""
+
+def _decode_kernel_int8(scale: float, nk: int, block_k: int,
+                        len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                        o_ref, m_scr, l_scr, acc_scr):
+    """int8-cache variant: K/V blocks arrive as int8 with per-row fp32
+    scales; the scales fold into the score columns (K) and the probability
+    rows (V) — algebraically exact dequantization, int8 HBM traffic."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0]                                   # [g_pad, d]
+    k = k_ref[0, 0].astype(jnp.float32)               # [block_k, d] int8→f32
+    ks = ks_ref[0, 0]                                 # [block_k]
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32), k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * ks[None, :] * scale                            # [g_pad, block_k]
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_k
+    s = jnp.where(cols < len_ref[0], s, NEG_INF)
+
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_scr[:] = jnp.broadcast_to(
+        alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True),
+        l_scr.shape)
+    v = v_ref[0, 0].astype(jnp.float32)               # [block_k, d]
+    vs = vs_ref[0, 0]                                 # [block_k]
+    pv = jax.lax.dot_general(
+        p * vs[None, :], v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scr[:] = acc_scr[:] * alpha + pv
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)
+                       ).astype(o_ref.dtype)
+
+
+def _decode_call(kernel_fn, q, caches, cache_len, softmax_scale,
+                 block_k, interpret, extra_in_specs):
+    """Shared host-side harness for the decode kernels: block sizing,
+    GQA-group padding, scalar-prefetch plumbing, grid/specs.  ``caches``
+    is the ordered operand list after q; ``extra_in_specs`` its BlockSpecs
+    (cache blocks and, for the int8 variant, their per-row scales)."""
     b, n_heads, d = q.shape
-    _, kv_heads, max_len, _ = k_cache.shape
+    max_len = caches[0].shape[2]
+    kv_heads = caches[0].shape[1]
     group = n_heads // kv_heads
     if softmax_scale is None:
         softmax_scale = 1.0 / float(np.sqrt(d))
@@ -101,18 +145,14 @@ def flash_decode(
 
     grid = (b, kv_heads, nk)
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, float(softmax_scale), nk, block_k),
+        functools.partial(kernel_fn, float(softmax_scale), nk, block_k),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, g_pad, d),
                              lambda bi, hi, ki, lens: (bi, hi, 0, 0)),
-                pl.BlockSpec((1, 1, block_k, d),
-                             lambda bi, hi, ki, lens: (bi, hi, ki, 0)),
-                pl.BlockSpec((1, 1, block_k, d),
-                             lambda bi, hi, ki, lens: (bi, hi, ki, 0)),
-            ],
+            ] + extra_in_specs(block_k, d),
             out_specs=pl.BlockSpec((1, 1, g_pad, d),
                                    lambda bi, hi, ki, lens: (bi, hi, 0, 0)),
             scratch_shapes=[
@@ -126,5 +166,54 @@ def flash_decode(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(lens, qg, k_cache, v_cache)
+    )(lens, qg, *caches)
     return out[:, :, :group].reshape(b, n_heads, d)
+
+
+def _cache_block_spec(block_k, d):
+    return pl.BlockSpec((1, 1, block_k, d),
+                        lambda bi, hi, ki, lens: (bi, hi, ki, 0))
+
+
+def _scale_block_spec(block_k):
+    return pl.BlockSpec((1, 1, block_k),
+                        lambda bi, hi, ki, lens: (bi, hi, ki))
+
+
+def flash_decode(
+    q: jax.Array,        # [b, n_heads, d] — ONE new token's queries
+    k_cache: jax.Array,  # [b, kv_heads, max_len, d]
+    v_cache: jax.Array,
+    cache_len: jax.Array,  # scalar int32: valid slots = cache_len (incl. new)
+    *,
+    softmax_scale: float | None = None,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """→ [b, n_heads, d] attention output for the single new token."""
+    return _decode_call(
+        _decode_kernel, q, [k_cache, v_cache], cache_len, softmax_scale,
+        block_k, interpret,
+        lambda bk, d: [_cache_block_spec(bk, d), _cache_block_spec(bk, d)])
+
+
+def flash_decode_int8(
+    q: jax.Array,          # [b, n_heads, d] — ONE new token's queries
+    k_q: jax.Array,        # [b, kv_heads, max_len, d] int8
+    k_scale: jax.Array,    # [b, kv_heads, max_len] fp32
+    v_q: jax.Array,
+    v_scale: jax.Array,
+    cache_len: jax.Array,
+    *,
+    softmax_scale: float | None = None,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """→ [b, n_heads, d] decode attention over an int8 KV cache
+    (ops/kv_quant.py form: per-row fp32 scales folded into the scores /
+    probabilities inside the kernel)."""
+    return _decode_call(
+        _decode_kernel_int8, q, [k_q, k_scale, v_q, v_scale], cache_len,
+        softmax_scale, block_k, interpret,
+        lambda bk, d: [_cache_block_spec(bk, d), _scale_block_spec(bk),
+                       _cache_block_spec(bk, d), _scale_block_spec(bk)])

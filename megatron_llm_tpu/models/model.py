@@ -223,7 +223,17 @@ def init_kv_cache(cfg: ModelConfig, batch_size: int, max_len: int,
     contiguous, so the decode GEMVs contract straight over it — the
     seq-major layout forced XLA to materialize a transposed copy of the
     whole cache every step (measured ~20 ms/step at max_len=1024 vs ~1 ms
-    bandwidth floor)."""
+    bandwidth floor).
+
+    With ``cfg.kv_cache_quant == "int8"`` each side is the int8
+    {"q", "scale"} form of ops/kv_quant.py — half the decode cache
+    traffic; the whole decode path threads it as a pytree."""
+    if cfg.kv_cache_quant == "int8":
+        from ..ops.kv_quant import init_quantized_cache
+
+        shape = (cfg.num_layers, batch_size, cfg.kv_heads, max_len,
+                 cfg.head_dim)
+        return init_quantized_cache(shape), init_quantized_cache(shape)
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, batch_size, cfg.kv_heads, max_len, cfg.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)

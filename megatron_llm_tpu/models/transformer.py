@@ -246,15 +246,14 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
 
     if kv_cache is not None:
         from ..ops.attention import decode_attention
+        from ..ops.kv_quant import cache_update
 
         k_cache, v_cache, cache_len = kv_cache  # [b, nkv, max_len, d]
         # head-major rows [b, nkv, s, d] — contiguous with the cache layout
-        new_k = jnp.transpose(k, (0, 2, 1, 3)).astype(k_cache.dtype)
-        new_v = jnp.transpose(v, (0, 2, 1, 3)).astype(v_cache.dtype)
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, new_k, (0, 0, cache_len, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, new_v, (0, 0, cache_len, 0))
+        new_k = jnp.transpose(k, (0, 2, 1, 3))
+        new_v = jnp.transpose(v, (0, 2, 1, 3))
+        k_cache = cache_update(k_cache, new_k, cache_len)
+        v_cache = cache_update(v_cache, new_v, cache_len)
         ctx = decode_attention(
             q, k_cache, v_cache, cache_len,
             softmax_scale=softmax_scale,
@@ -470,11 +469,12 @@ def stack_forward_cached(cfg: ModelConfig, stacked: Params, x: jax.Array,
         body, x, (stacked, k_cache, v_cache))
     # one batched row write [L, b, nkv, s_new, d] — XLA aliases the DUS
     # with the loop-carried cache buffer, so decode writes s_new rows
-    # instead of round-tripping the whole cache
-    new_k = jax.lax.dynamic_update_slice(
-        k_cache, rows_k, (0, 0, 0, cache_len, 0))
-    new_v = jax.lax.dynamic_update_slice(
-        v_cache, rows_v, (0, 0, 0, cache_len, 0))
+    # instead of round-tripping the whole cache.  cache_update also
+    # quantizes the rows when the cache is the int8 form (kv_quant.py).
+    from ..ops.kv_quant import cache_update
+
+    new_k = cache_update(k_cache, rows_k, cache_len)
+    new_v = cache_update(v_cache, rows_v, cache_len)
     return x, new_k, new_v
 
 

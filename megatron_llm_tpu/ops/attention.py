@@ -67,7 +67,18 @@ def _mesh_active() -> bool:
 
 
 def _kernel_decode(q, k_cache, v_cache, cache_len, softmax_scale):
-    """The single call site of the Pallas decode kernel: [b,1,h,d] in/out."""
+    """The single call site of the Pallas decode kernels: [b,1,h,d]
+    in/out; dispatches the int8-cache variant for quantized dicts."""
+    from .kv_quant import is_quantized_cache
+
+    if is_quantized_cache(k_cache):
+        from ..kernels.flash_decode import flash_decode_int8
+
+        out = flash_decode_int8(
+            q[:, 0], k_cache["q"], k_cache["scale"],
+            v_cache["q"], v_cache["scale"], cache_len + 1,
+            softmax_scale=softmax_scale)
+        return out[:, None]
     from ..kernels.flash_decode import flash_decode
 
     out = flash_decode(q[:, 0], k_cache, v_cache, cache_len + 1,
@@ -92,6 +103,7 @@ def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
     there) — the caller falls back.
     """
     from jax.sharding import PartitionSpec as P
+    from .kv_quant import is_quantized_cache
     from ..parallel.mesh import PIPELINE_AXIS, TENSOR_AXIS
 
     if TENSOR_AXIS not in mesh.axis_names:
@@ -104,7 +116,9 @@ def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
                      if a in mesh.axis_names
                      and a not in getattr(mesh, "manual_axes", ())
                      and mesh.shape[a] > 1)
-    n_heads, kv_heads = q.shape[2], k_cache.shape[1]
+    kv_q = is_quantized_cache(k_cache)
+    n_heads = q.shape[2]
+    kv_heads = (k_cache["q"] if kv_q else k_cache).shape[1]
     # Prefer the serving re-layout's combined (pp, tp) head sharding; a
     # training-layout mesh whose head counts only divide tp (pp shards
     # layers there, not heads) keeps its tp-only kernel path.  The
@@ -123,13 +137,15 @@ def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
     if axes is None:
         return None
 
+    # kv-head-sharded cache spec — for the int8 dict form, the per-row
+    # scale tensor shards on the same head axis
+    cache_spec = ({"q": P(None, axes, None, None),
+                   "scale": P(None, axes, None)} if kv_q
+                  else P(None, axes, None, None))
     wrapped = jax.shard_map(
         lambda q_, kc, vc, ln: _kernel_decode(q_, kc, vc, ln, softmax_scale),
         mesh=mesh,
-        in_specs=(P(None, None, axes, None),
-                  P(None, axes, None, None),
-                  P(None, axes, None, None),
-                  P()),
+        in_specs=(P(None, None, axes, None), cache_spec, cache_spec, P()),
         out_specs=P(None, None, axes, None),
         axis_names=set(axes),
         check_vma=False,
@@ -160,8 +176,8 @@ def make_causal_mask(seq_q: int, seq_k: int, dtype=jnp.float32) -> jax.Array:
 
 def decode_attention(
     q: jax.Array,        # [b, s, n_heads, d] — the new tokens' queries
-    k_cache: jax.Array,  # [b, kv_heads, max_len, d] head-major, updated
-    v_cache: jax.Array,  # [b, kv_heads, max_len, d]
+    k_cache,             # [b, kv_heads, max_len, d] head-major, updated —
+    v_cache,             # or int8 {"q", "scale"} dicts (ops/kv_quant.py)
     cache_len,           # scalar int32: absolute position of q's first token
     *,
     softmax_scale: float | None = None,
@@ -176,12 +192,55 @@ def decode_attention(
     ~1 ms bandwidth floor this path approaches).  Slots past the fill
     level hold garbage but are masked by the causal-with-offset
     inequality j <= cache_len + i.
+
+    int8-quantized caches stream int8 through both contractions with the
+    per-row scales applied outside the dots (scores column-scaled by
+    k-scales; probs pre-scaled by v-scales) — algebraically exact
+    dequantization without materializing an fp copy of the cache.
     """
+    from .kv_quant import is_quantized_cache
+
+    kv_q = is_quantized_cache(k_cache)
+    k_arr = k_cache["q"] if kv_q else k_cache
     b, s, n_heads, d = q.shape
-    _, kv_heads, max_len, _ = k_cache.shape
+    _, kv_heads, max_len, _ = k_arr.shape
     group = n_heads // kv_heads
     if softmax_scale is None:
         softmax_scale = 1.0 / float(np.sqrt(d))
+
+    if kv_q:
+        # int8 path: same kernel/mesh dispatch shape as the unquantized
+        # one (the kernel variant is flash_decode_int8; _kernel_decode and
+        # _sharded_flash_decode are both dict-aware), with the
+        # scale-folded einsum below as the universal fallback.
+        if decode_kernel_eligible(s, d, max_len, _backend()):
+            mesh = _active_mesh()
+            if mesh is None:
+                return _kernel_decode(q, k_cache, v_cache, cache_len,
+                                      softmax_scale)
+            out = _sharded_flash_decode(q, k_cache, v_cache, cache_len,
+                                        softmax_scale, mesh)
+            if out is not None:
+                return out
+        qg = jnp.transpose(q.reshape(b, s, kv_heads, group, d),
+                           (0, 2, 3, 1, 4)).reshape(b, kv_heads,
+                                                    group * s, d)
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", qg, k_cache["q"].astype(qg.dtype),
+            preferred_element_type=jnp.float32)
+        scores = scores * k_cache["scale"][:, :, None, :] * softmax_scale
+        i = jnp.arange(s)
+        j = jnp.arange(max_len)
+        keep = j[None, :] <= (cache_len + i[:, None])
+        keep = jnp.tile(keep, (group, 1))
+        scores = jnp.where(keep[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = (probs * v_cache["scale"][:, :, None, :]).astype(q.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs,
+                         v_cache["q"].astype(q.dtype))
+        out = jnp.transpose(out.reshape(b, kv_heads, group, s, d),
+                            (0, 3, 1, 2, 4))
+        return out.reshape(b, s, n_heads, d)
 
     if decode_kernel_eligible(s, d, max_len, _backend()):
         # single-token decode: the Pallas kernel streams the cache through

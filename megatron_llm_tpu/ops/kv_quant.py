@@ -1,0 +1,67 @@
+"""int8 KV-cache quantization for serving (opt-in, composes with the
+weight-only int8 of ops/quant.py for a fully int8-resident decode).
+
+Decode streams the whole cache every step, so at long contexts the cache
+— not the weights — dominates HBM traffic (bench.py's decode roofline
+terms); int8 rows halve it.  Scheme: symmetric per-row scales, one fp32
+scale per (batch, kv_head, position) row of [head_dim] values — K and V
+rows are written once at their position and never rewritten, so the scale
+granularity matches the write granularity exactly and requantization
+never occurs.
+
+A quantized cache is ``{"q": int8 [..., max_len, d],
+"scale": fp32 [..., max_len]}`` — a plain dict subtree, so the scan-xs /
+dynamic-update-slice / while-loop-carry plumbing of the decode path works
+unchanged on it (pytrees all the way down).
+
+The reference has no quantized inference cache; its InferenceParams holds
+compute-dtype tensors (megatron/model/transformer.py:423-496).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def is_quantized_cache(cache) -> bool:
+    return isinstance(cache, dict) and set(cache) == {"q", "scale"}
+
+
+def init_quantized_cache(shape: tuple) -> dict:
+    """Empty cache for ``shape`` = [..., max_len, head_dim]."""
+    return {"q": jnp.zeros(shape, jnp.int8),
+            "scale": jnp.zeros(shape[:-1], jnp.float32)}
+
+
+def quantize_rows(rows: jax.Array) -> dict:
+    """[..., s, d] new rows → {"q": int8, "scale": fp32 [..., s]}."""
+    r32 = rows.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(r32), axis=-1) / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(r32 / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def dequantize_cache(cache: dict, dtype=jnp.float32) -> jax.Array:
+    return (cache["q"].astype(jnp.float32)
+            * cache["scale"][..., None]).astype(dtype)
+
+
+def cache_update(cache, rows, pos):
+    """Write new-token ``rows`` [..., s, d] into ``cache`` at position
+    ``pos`` along the -2 (sequence) axis.  Handles both plain arrays and
+    quantized dicts — the single write point of the decode path
+    (models/transformer.py), so the representations can't drift."""
+    nd = rows.ndim
+    start = (0,) * (nd - 2) + (pos, 0)
+    if is_quantized_cache(cache):
+        qr = quantize_rows(rows)
+        return {
+            "q": jax.lax.dynamic_update_slice(cache["q"], qr["q"], start),
+            "scale": jax.lax.dynamic_update_slice(
+                cache["scale"], qr["scale"], start[:-1]),
+        }
+    return jax.lax.dynamic_update_slice(
+        cache, rows.astype(cache.dtype), start)

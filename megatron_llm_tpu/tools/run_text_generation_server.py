@@ -28,6 +28,9 @@ def main(argv=None) -> int:
     ap.add_argument("--quantize", default=None, choices=["int8"],
                     help="weight-only int8 (halves decode HBM traffic; "
                          "ops/quant.py)")
+    ap.add_argument("--kv_quant", default=None, choices=["int8"],
+                    help="int8 KV cache (halves decode cache traffic; "
+                         "ops/kv_quant.py)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel shards for serving")
     ap.add_argument("--pp", type=int, default=1,
@@ -47,6 +50,13 @@ def main(argv=None) -> int:
                "falcon": families.falcon,
                "gpt": families.gpt}[args.model]
     lm = factory(args.size)
+    if args.kv_quant:
+        import dataclasses
+
+        from ..models.families import CausalLM
+
+        lm = CausalLM(dataclasses.replace(
+            lm.cfg, kv_cache_quant=args.kv_quant).validate())
     tokenizer = build_tokenizer(args.tokenizer_type, args.tokenizer_model)
     params = load_params_for_inference(args.load, lm.cfg)
     if args.quantize == "int8":

@@ -26,7 +26,8 @@ import time
 
 def run(model: str, size: str, tp: int, pp: int, batch: int,
         prompt_len: int, gen_len: int, params_dtype: str,
-        quantize: str | None = None) -> dict:
+        quantize: str | None = None,
+        kv_quant: str | None = None) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -47,6 +48,7 @@ def run(model: str, size: str, tp: int, pp: int, batch: int,
         max_position_embeddings=max(cfg.max_position_embeddings,
                                     prompt_len + gen_len),
         params_dtype=params_dtype,
+        kv_cache_quant=kv_quant or "none",
     ).validate()
 
     parallel = ParallelConfig(pipeline_parallel=pp, tensor_parallel=tp)
@@ -84,6 +86,7 @@ def run(model: str, size: str, tp: int, pp: int, batch: int,
         "gen_len": gen_len,
         "device": jax.devices()[0].device_kind,
         "quantize": quantize,
+        "kv_quant": kv_quant,
     }
 
 
@@ -99,9 +102,11 @@ def main(argv=None) -> int:
     ap.add_argument("--params_dtype", default="bfloat16",
                     choices=["float32", "bfloat16", "float16"])
     ap.add_argument("--quantize", default=None, choices=["int8"])
+    ap.add_argument("--kv_quant", default=None, choices=["int8"])
     args = ap.parse_args(argv)
     rec = run(args.model, args.size, args.tp, args.pp, args.batch,
-              args.prompt, args.gen, args.params_dtype, args.quantize)
+              args.prompt, args.gen, args.params_dtype, args.quantize,
+              args.kv_quant)
     print(json.dumps(rec))
     return 0
 

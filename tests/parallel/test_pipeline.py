@@ -75,6 +75,7 @@ def _reference_loss(cfg, params, batch):
         (1, 2, 1, 2, 4),   # interleaved (tight): 2 virtual chunks per stage
         (1, 4, 1, 2, 4),   # interleaved (tight) at pp=4 (16 layers)
         (1, 2, 1, 2, 5),   # interleaved legacy order (M % pp != 0)
+        (1, 2, 1, 3, 6),   # tight at vpp=3, 3 microbatch groups (12 layers)
     ],
 )
 def test_pipeline_matches_reference(dp, pp, tp, vpp, M):
