@@ -9,6 +9,7 @@ python -m megatron_llm_tpu.tools.run_text_generation_server \
     --tokenizer_type sentencepiece --tokenizer_model "${2:-tokenizer.model}" \
     ${SERVE_TP:+--tp "$SERVE_TP"} ${SERVE_PP:+--pp "$SERVE_PP"} \
     ${SERVE_QUANT:+--quantize "$SERVE_QUANT"} \
+    ${SERVE_KV_QUANT:+--kv_quant "$SERVE_KV_QUANT"} \
     --port 5000 &
 sleep 10
 curl -X PUT localhost:5000/api -H 'Content-Type: application/json' \

@@ -151,6 +151,163 @@ def evaluate_f1(guess_file: str, answer_file: str) -> float:
     return sum(f1_score(g, a) for g, a in zip(guesses, answers)) / len(guesses)
 
 
+# ---------------------------------------------------------------------------
+# Dataset preprocessing (reference tasks/msdp/preprocessing.py:42-240):
+# Wizard-of-Wikipedia / Wizard-of-Internet raw dumps → the tab-separated
+# ``topic\tdialogue context\tknowledge\tresponse`` rows the prompting
+# stages consume, plus the knowledge/response reference files for eval.
+# ---------------------------------------------------------------------------
+
+
+def _clean_field(text: str) -> str:
+    return text.replace("\n", "").replace("\r", "").replace("\t", "")
+
+
+def _word_tokens(text: str) -> list:
+    """Evaluation tokenization: the reference uses nltk word_tokenize on
+    responses; this stdlib equivalent splits words and punctuation runs
+    (the F1 metric re-normalizes, so exact nltk parity is not load-bearing)."""
+    return re.findall(r"[\w']+|[^\w\s]", text)
+
+
+def process_wow_dataset(raw_file: str, processed_file: str,
+                        knwl_ref_file: Optional[str] = None,
+                        resp_ref_file: Optional[str] = None) -> int:
+    """Wizard-of-Wikipedia json → processed rows; → number of rows.
+
+    A wizard turn contributes one row: topic from the checked passage
+    (falling back to the dialog's chosen topic), knowledge from the
+    checked sentence (``no_passages_used`` when absent), context = prior
+    turns joined by `` [SEP] ``.
+    """
+    import contextlib
+
+    with open(raw_file) as f:
+        dialog_data = json.load(f)
+    n = 0
+    with contextlib.ExitStack() as stack:
+        fproc = stack.enter_context(open(processed_file, "w"))
+        fknwl = (stack.enter_context(open(knwl_ref_file, "w"))
+                 if knwl_ref_file else None)
+        fresp = (stack.enter_context(open(resp_ref_file, "w"))
+                 if resp_ref_file else None)
+        for sample in dialog_data:
+            turn_list: list = []
+            for j, turn in enumerate(sample["dialog"]):
+                text = turn["text"]
+                if not text.endswith(("?", ".", "!")):
+                    text = text + "."
+                if j == 0:
+                    turn_list.append(text)
+                    continue
+                if "wizard" in turn["speaker"].lower():
+                    sent = list(turn.get("checked_sentence", {}).values())
+                    passage = list(turn.get("checked_passage", {}).values())
+                    knowledge = sent[0] if sent else "no_passages_used"
+                    topic = (passage[0] if len(passage) == 1
+                             else sample["chosen_topic"])
+                    row = "\t".join(_clean_field(x) for x in (
+                        topic, " [SEP] ".join(turn_list), knowledge, text))
+                    fproc.write(row + "\n")
+                    n += 1
+                    if fknwl:
+                        fknwl.write(_clean_field(knowledge) + "\n")
+                    if fresp:
+                        fresp.write(" ".join(_word_tokens(text)) + "\n")
+                    turn_list.append(text)
+                else:
+                    turn_list.append(text)
+    return n
+
+
+def process_woi_dataset(raw_file: str, processed_file: str,
+                        knwl_ref_file: Optional[str] = None,
+                        resp_ref_file: Optional[str] = None) -> int:
+    """Wizard-of-Internet jsonl → processed rows; → number of rows.
+
+    The last search query becomes the topic; the selected content
+    sentence the knowledge.  Rows without a usable topic/knowledge are
+    skipped (the reference drops ``no_topic`` rows too).
+    """
+    import contextlib
+
+    n = 0
+    with contextlib.ExitStack() as stack:
+        fr = stack.enter_context(open(raw_file))
+        fproc = stack.enter_context(open(processed_file, "w"))
+        fknwl = (stack.enter_context(open(knwl_ref_file, "w"))
+                 if knwl_ref_file else None)
+        fresp = (stack.enter_context(open(resp_ref_file, "w"))
+                 if resp_ref_file else None)
+        for line in fr:
+            line = line.strip()
+            if not line:
+                continue
+            item = list(json.loads(line).values())[0]
+            turn_list: list = []
+            search_text = ""
+            for entry in item["dialog_history"]:
+                action = entry["action"]
+                if action == "Wizard => SearchAgent":
+                    search_text = entry["text"]
+                elif action == "Wizard => Apprentice":
+                    if not turn_list:
+                        turn_list.append(entry["text"])
+                        continue
+                    contents = entry["context"]["contents"]
+                    selects = entry["context"]["selected_contents"]
+                    no_knowledge = bool(selects[0][0])
+                    selects = selects[1:]
+                    knwl_sent = ""
+                    topic = "no_topic"
+                    if not no_knowledge:
+                        topic = search_text
+                        for content, select in zip(contents, selects):
+                            for c, sflag in zip(content["content"], select):
+                                if sflag:
+                                    knwl_sent = c
+                                    break
+                            if knwl_sent:
+                                break
+                    if not knwl_sent:
+                        topic, knwl_sent = "no_topic", "no_passages_used"
+                    response = entry["text"]
+                    if topic != "no_topic":
+                        row = "\t".join(_clean_field(x) for x in (
+                            topic, " [SEP] ".join(turn_list), knwl_sent,
+                            response))
+                        fproc.write(row + "\n")
+                        n += 1
+                        if fknwl:
+                            fknwl.write(_clean_field(knwl_sent) + "\n")
+                        if fresp:
+                            fresp.write(
+                                " ".join(_word_tokens(response)) + "\n")
+                    turn_list.append(response)
+                elif action == "Apprentice => Wizard":
+                    turn_list.append(entry["text"])
+    return n
+
+
+def select_prompts_by_similarity(query: str, examples: Sequence[str],
+                                 prompts: Sequence[str], topk: int,
+                                 embed_fn) -> list:
+    """Top-k most similar examples' prompts, least-similar first (the
+    reference feeds prompts nearest-last so the closest example sits
+    right before the query — preprocessing.py:323-361).
+
+    ``embed_fn(texts) -> [n, d]`` is any sentence embedder — e.g. the
+    in-tree biencoder (models/biencoder.py:embed_text) where the
+    reference loads a DPR encoder.
+    """
+    import numpy as np
+
+    embs = np.asarray(embed_fn(list(examples) + [query]), np.float32)
+    sims = embs[:-1] @ embs[-1]
+    order = np.argsort(-sims)[:topk][::-1]
+    return [prompts[int(i)] for i in order]
+
+
 def main(argv: Optional[list] = None) -> int:
     import argparse
 
@@ -159,9 +316,21 @@ def main(argv: Optional[list] = None) -> int:
     pe = sub.add_parser("evaluate", help="F1 of guess vs answer file")
     pe.add_argument("--guess_file", required=True)
     pe.add_argument("--answer_file", required=True)
+    for name in ("preprocess-wow", "preprocess-woi"):
+        pp = sub.add_parser(name, help="raw dump -> tab-separated rows")
+        pp.add_argument("--raw_file", required=True)
+        pp.add_argument("--processed_file", required=True)
+        pp.add_argument("--knwl_ref_file", default=None)
+        pp.add_argument("--resp_ref_file", default=None)
     ns = p.parse_args(argv)
     if ns.cmd == "evaluate":
         print(json.dumps({"f1": evaluate_f1(ns.guess_file, ns.answer_file)}))
+    elif ns.cmd in ("preprocess-wow", "preprocess-woi"):
+        fn = (process_wow_dataset if ns.cmd == "preprocess-wow"
+              else process_woi_dataset)
+        n = fn(ns.raw_file, ns.processed_file, ns.knwl_ref_file,
+               ns.resp_ref_file)
+        print(f"wrote {n} rows to {ns.processed_file}")
     return 0
 
 

@@ -189,3 +189,96 @@ def test_ict_dataset_titles_and_block_data(tmp_path):
     toks, mask = ds.get_block(start, end, doc)
     assert toks.shape == (48,)
     assert toks[0] == sp.cls
+
+
+# ---------------------------------------------------------------------------
+# MSDP dataset preprocessing (reference tasks/msdp/preprocessing.py:42-240)
+# ---------------------------------------------------------------------------
+
+
+def test_process_wow_dataset(tmp_path):
+    import json
+
+    from megatron_llm_tpu.tasks import msdp
+
+    raw = [{
+        "chosen_topic": "Blue",
+        "dialog": [
+            {"speaker": "0_Apprentice", "text": "I love the color blue"},
+            {"speaker": "1_Wizard",
+             "text": "Blue is a primary colour",
+             "checked_sentence": {"s": "Blue is one of the three primary "
+                                       "colours."},
+             "checked_passage": {"p": "Blue"}},
+            {"speaker": "0_Apprentice", "text": "Tell me more!"},
+            {"speaker": "1_Wizard", "text": "It is between violet and cyan.",
+             "checked_sentence": {}, "checked_passage": {}},
+        ],
+    }]
+    rf = tmp_path / "raw.json"
+    rf.write_text(json.dumps(raw))
+    out = tmp_path / "proc.tsv"
+    kn = tmp_path / "knwl.txt"
+    rs = tmp_path / "resp.txt"
+    n = msdp.process_wow_dataset(str(rf), str(out), str(kn), str(rs))
+    assert n == 2
+    rows = out.read_text().splitlines()
+    t0 = rows[0].split("\t")
+    assert t0[0] == "Blue"
+    assert "[SEP]" not in t0[0]
+    assert t0[2].startswith("Blue is one")
+    # second wizard turn: no checked sentence → no_passages_used, topic
+    # falls back to chosen_topic; context carries all prior turns
+    t1 = rows[1].split("\t")
+    assert t1[2] == "no_passages_used"
+    assert t1[1].count("[SEP]") == 2
+    assert len(kn.read_text().splitlines()) == 2
+    assert len(rs.read_text().splitlines()) == 2
+
+
+def test_process_woi_dataset(tmp_path):
+    import json
+
+    from megatron_llm_tpu.tasks import msdp
+
+    item = {"dlg1": {"dialog_history": [
+        {"action": "Wizard => Apprentice", "text": "first turn greeting"},
+        {"action": "Apprentice => Wizard", "text": "hi what about mars"},
+        {"action": "Wizard => SearchAgent", "text": "mars facts"},
+        {"action": "SearchAgent => Wizard", "text": "results"},
+        {"action": "Wizard => Apprentice",
+         "text": "Mars is the fourth planet.",
+         "context": {
+             "contents": [{"content": ["Mars is the fourth planet from "
+                                       "the Sun.", "Irrelevant."]}],
+             "selected_contents": [[False], [True, False]],
+         }},
+    ]}}
+    rf = tmp_path / "raw.jsonl"
+    rf.write_text(json.dumps(item) + "\n")
+    out = tmp_path / "proc.tsv"
+    n = msdp.process_woi_dataset(str(rf), str(out))
+    assert n == 1
+    topic, ctx, knwl, resp = out.read_text().strip().split("\t")
+    assert topic == "mars facts"
+    assert knwl.startswith("Mars is the fourth planet from")
+    assert resp == "Mars is the fourth planet."
+
+
+def test_select_prompts_by_similarity():
+    import numpy as np
+
+    from megatron_llm_tpu.tasks import msdp
+
+    examples = ["alpha beta", "gamma delta", "alpha alpha"]
+    prompts = ["P0", "P1", "P2"]
+
+    def embed(texts):
+        # toy embedder: count of 'alpha' and 'gamma'
+        return np.array([[t.count("alpha"), t.count("gamma")]
+                         for t in texts], np.float32)
+
+    got = msdp.select_prompts_by_similarity(
+        "alpha question", examples, prompts, topk=2, embed_fn=embed)
+    # closest example ("alpha alpha") must come LAST (nearest-last order)
+    assert got == ["P0", "P2"]
