@@ -3,8 +3,13 @@
 # config 5.  The pipelined schedule streams microbatches (embed at stage 0,
 # CE head at the last stage inside the tick loop); docs/pipeline_memory.md
 # gives the per-chip memory budget for this exact configuration (~14.5 GB
-# of 95 GB HBM with full remat + ZeRO-1).
+# of 95 GB HBM with full remat + ZeRO-1).  M = 512/(1*4) = 128 microbatches
+# divides pp=8, so the tight interleaved schedule runs and the remat
+# window bounds the O(M*vpp) boundary memory.
 set -euo pipefail
+
+# async-collective / overlap XLA flags (must precede backend init)
+eval "$(python -m megatron_llm_tpu.initialize)"
 
 python finetune.py \
     --model llama2 --model_size 70b \
@@ -12,6 +17,7 @@ python finetune.py \
     --data_path "$1" \
     --tokenizer_type sentencepiece --tokenizer_model "$2" \
     --tp 8 --pp 8 --dp 4 --virtual_pipeline_stages 2 \
+    --pipeline_remat_window 16 \
     --sequence_parallel --use_distributed_optimizer \
     --params_dtype bfloat16 --attention_impl flash --recompute full \
     --micro_batch_size 1 --global_batch_size 512 \
