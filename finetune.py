@@ -96,7 +96,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="checkpoint the pipeline tick loop in windows of W "
                         "ticks: bounds activation memory at large "
                         "grad-accum counts (M>=64) for ~+25%% FLOPs; "
-                        "0 = off, vpp=1 only")
+                        "0 = off, -1 = memory-minimizing auto choice; "
+                        "with vpp>1 needs num_microbatches %% pp == 0")
     g.add_argument("--sequence_parallel", action="store_true")
     g.add_argument("--use_distributed_optimizer", action="store_true")
 

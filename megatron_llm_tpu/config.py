@@ -275,6 +275,8 @@ class ParallelConfig:
     # (megatron/schedules.py:606-722), at ~+25% FLOPs when on.  With
     # vpp > 1 it requires num_microbatches % pp == 0 (the tight
     # interleaved schedule, whose carry has no circular buffer).
+    # -1 = auto: the memory-minimizing W from the analytic model
+    # (parallel/pipeline.py:auto_remat_window).
     pipeline_remat_window: int = 0
     # ZeRO-1: shard optimizer state over dp
     # (reference: megatron/optimizer/distrib_optimizer.py)
@@ -300,7 +302,11 @@ class ParallelConfig:
             f"unknown context_parallel_layout "
             f"{self.context_parallel_layout!r}")
         if self.pipeline_remat_window:
-            assert self.pipeline_remat_window > 0
+            assert (self.pipeline_remat_window > 0
+                    or self.pipeline_remat_window == -1), (
+                "pipeline_remat_window: W > 0, or -1 for the "
+                "memory-minimizing auto choice (parallel/pipeline.py:"
+                "auto_remat_window)")
             if self.virtual_pipeline_stages > 1:
                 assert self.num_microbatches % self.pipeline_parallel == 0, (
                     "pipeline_remat_window with vpp > 1 needs "

@@ -232,10 +232,10 @@ def pipeline_activation_bytes(
     v = cfg.padded_vocab_size()
 
     per_boundary = mb * seq_shard * h * B
-    c = {"full": 1.0,
-         "selective": 4.0,
-         "none": 4.0 + 3.0 * cfg.ffn_size / h}[recompute]
+    c = _recompute_cost(cfg, recompute)
     tight = vpp == 1 or M % pp == 0
+    if window == -1:  # the auto sentinel resolves to the same W the
+        window = auto_remat_window(cfg, pp=pp, vpp=vpp, M=M)  # loss runs
     if window and window > 0 and tight and T > window:
         n_win = -(-T // window)
         boundary = (n_win + 2 * window) * per_boundary
@@ -259,6 +259,30 @@ def pipeline_activation_bytes(
     terms["total"] = sum(terms.values())
     terms["upper_bound"] = 2 * terms["total"]
     return terms
+
+
+def _recompute_cost(cfg: ModelConfig, recompute: str) -> float:
+    """Saved-values-per-layer coefficient of the analytic memory model —
+    the single source for both the estimator and the auto window choice
+    (validated by tests/parallel/test_pipeline_memory.py)."""
+    return {"full": 1.0,
+            "selective": 4.0,
+            "none": 4.0 + 3.0 * cfg.ffn_size / cfg.hidden_size}[recompute]
+
+
+def auto_remat_window(cfg: ModelConfig, *, pp: int, vpp: int, M: int) -> int:
+    """Memory-minimizing window size for the tick-loop remat.
+
+    From the analytic model (pipeline_activation_bytes): live boundaries
+    ≈ ceil(T/W) window carries + (2 + lpc·c)·W in-window tensors, so the
+    optimum is W* = sqrt(T / (2 + lpc·c)).  Selected by
+    ``pipeline_remat_window = -1`` (CLI ``--pipeline_remat_window -1``).
+    """
+    T = M * vpp + pp - 1
+    lpc = cfg.num_layers // (pp * vpp)
+    c = _recompute_cost(cfg, cfg.recompute)
+    w = int(round((T / (2.0 + lpc * c)) ** 0.5))
+    return max(w, 1)
 
 
 # ---------------------------------------------------------------------------
@@ -563,6 +587,8 @@ def pipeline_loss(
                 aux0, jnp.zeros((), jnp.float32),
                 stats0)
         W = parallel.pipeline_remat_window
+        if W == -1:
+            W = auto_remat_window(model_cfg, pp=pp, vpp=vpp, M=M)
         if W and W > 0 and tight and T > W:
             # Windowed rematerialization: the plain scan saves every tick's
             # boundary in/out for the backward replay (2·T tensors); at

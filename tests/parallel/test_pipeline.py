@@ -367,3 +367,62 @@ def test_full_train_step_dp_sharded_batch_argument():
         state, metrics = step(state, batch, jax.random.key(7))
         assert np.isfinite(float(metrics["loss"]))
         assert int(state.iteration) == 1
+
+
+def test_auto_remat_window_matches_unwindowed():
+    """pipeline_remat_window=-1 picks W from the memory model; loss AND
+    grads (the windowed path only changes the backward replay) must be
+    identical to the plain schedule, including ragged padding ticks."""
+    pp, M = 2, 20
+    # recompute="full" (c=1) keeps the auto denominator small so the
+    # chosen W lands strictly between 1 and T
+    cfg = tiny_config(num_layers=4, params_dtype="float32",
+                      recompute="full", seq_length=32,
+                      max_position_embeddings=32)
+    base = ParallelConfig(pipeline_parallel=pp, num_microbatches=M)
+    auto = ParallelConfig(pipeline_parallel=pp, num_microbatches=M,
+                          pipeline_remat_window=-1).validate()
+    w = pipe.auto_remat_window(cfg, pp=pp, vpp=1, M=M)
+    T = M + pp - 1
+    assert 1 < w < T  # a real window, with -(-T // w) * w > T padding
+    # the analytic estimator resolves the sentinel the same way
+    est = pipe.pipeline_activation_bytes(
+        cfg, pp=pp, vpp=1, M=M, mb=2, seq_shard=cfg.seq_length,
+        recompute=cfg.recompute, window=-1)
+    est_w = pipe.pipeline_activation_bytes(
+        cfg, pp=pp, vpp=1, M=M, mb=2, seq_shard=cfg.seq_length,
+        recompute=cfg.recompute, window=w)
+    assert est == est_w
+    mesh = mesh_lib.build_mesh(base)
+
+    params = model_lib.init_params(jax.random.key(5), cfg)
+    batch = _batch(cfg, M, mb=2, seed=13)
+    p_params = pipe.to_pipeline_params(params, base)
+    specs = shard_lib.param_specs(cfg, base)
+    p_specs = pipe.pipeline_param_specs(specs, base)
+    p_params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        p_params, p_specs, is_leaf=lambda v: isinstance(v, P))
+
+    def runtime(par):
+        return RuntimeConfig(model=cfg, parallel=par,
+                             optimizer=OptimizerConfig(),
+                             train=TrainConfig(seq_length=cfg.seq_length))
+
+    with mesh_lib.use_mesh(mesh):
+        loss_plain, grads_plain = jax.jit(jax.value_and_grad(
+            lambda p: pipe.pipeline_loss(runtime(base), p, batch, mesh=mesh)
+        ))(p_params)
+        loss_auto, grads_auto = jax.jit(jax.value_and_grad(
+            lambda p: pipe.pipeline_loss(runtime(auto), p, batch, mesh=mesh)
+        ))(p_params)
+    np.testing.assert_allclose(np.asarray(loss_auto),
+                               np.asarray(loss_plain), rtol=1e-6, atol=1e-6)
+    for (path, a), (_, b) in zip(
+        jax.tree.leaves_with_path(grads_plain),
+        jax.tree.leaves_with_path(grads_auto),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-5, atol=1e-6,
+            err_msg=f"auto-window grad mismatch at "
+                    f"{jax.tree_util.keystr(path)}")
