@@ -153,6 +153,18 @@ def pipeline_param_specs(specs: PyTree, parallel: ParallelConfig) -> PyTree:
 # ---------------------------------------------------------------------------
 
 
+
+def tight_indices(rel, pp: int, vpp: int):
+    """(microbatch, chunk) worked at ``rel`` ticks into a stage's schedule
+    under the tight group-interleaved order — microbatches advance in
+    groups of pp, each group cycling through all vpp chunks.  Pure
+    arithmetic: works on traced jnp values (the tick body) and Python
+    ints (tests) alike; callers clamp/mask out-of-range ``rel``.
+    """
+    g = rel // pp
+    return (g // vpp) * pp + rel % pp, g % vpp
+
+
 def _stage_tick(cfg: ModelConfig, chunks: PyTree, chunk_idx, x, side,
                 rng, layer_offset=0):
     """Apply this device's current layer chunk to one microbatch.
@@ -461,9 +473,8 @@ def pipeline_loss(
                 # (m, chunk-1) boundary stage 0 needs at tick t, so no
                 # M-sized circular buffer exists and windowed remat
                 # composes the same as at vpp = 1.
-                g = relc // pp
-                chunk_idx = g % vpp
-                m_idx = jnp.clip((g // vpp) * pp + relc % pp, 0, M - 1)
+                m_raw, chunk_idx = tight_indices(relc, pp, vpp)
+                m_idx = jnp.clip(m_raw, 0, M - 1)
             else:
                 m_idx = relc % M
                 chunk_idx = jnp.clip(rel // M, 0, vpp - 1)
@@ -532,10 +543,9 @@ def pipeline_loss(
             if tight:
                 rel_l = t - (pp - 1)  # last stage's rel at this tick
                 relc_l = jnp.clip(rel_l, 0, None)
-                g_l = relc_l // pp
-                out_idx = (g_l // vpp) * pp + relc_l % pp
+                out_idx, chunk_l = tight_indices(relc_l, pp, vpp)
                 head_valid = ((rel_l >= 0) & (rel_l < M * vpp)
-                              & (g_l % vpp == vpp - 1) & (stage == pp - 1))
+                              & (chunk_l == vpp - 1) & (stage == pp - 1))
             else:
                 out_idx = t - (vpp - 1) * M - (pp - 1)
                 head_valid = ((out_idx >= 0) & (out_idx < M)
