@@ -194,11 +194,10 @@ def _decode_roofline_tps(cfg, param_bytes: int, batch: int,
 
 
 def _decode_point(hbm_bw: float, quantize: bool = False):
-    """KV-cache greedy decode throughput (tokens/sec) on the bench model,
-    plus the fraction of the HBM-bandwidth roofline it achieves.  With
-    ``quantize`` both the weights (ops/quant.py) AND the KV cache
-    (ops/kv_quant.py) are int8, and both roofline terms shrink
-    accordingly."""
+    """→ (decode tokens/sec, roofline tokens/sec, prefill tokens/sec) on
+    the bench model.  With ``quantize`` both the weights (ops/quant.py)
+    AND the KV cache (ops/kv_quant.py) are int8, and both roofline terms
+    shrink accordingly."""
     import jax
     import jax.numpy as jnp
 
@@ -256,11 +255,12 @@ def _decode_point(hbm_bw: float, quantize: bool = False):
 
     dt = max(dt_full - dt_prefill, 1e-9)
     tps = b * gen_len / dt
+    prefill_tps = b * prompt_len / max(dt_prefill, 1e-9)
     param_bytes = sum(p.size * p.dtype.itemsize
                       for p in jax.tree.leaves(params))
     roof = _decode_roofline_tps(cfg, param_bytes, b,
                                 prompt_len + gen_len // 2, hbm_bw)
-    return tps, roof
+    return tps, roof, prefill_tps
 
 
 def _transient_error_types():
@@ -431,6 +431,8 @@ def main() -> None:
         "decode_int8_roofline_frac": (None if decode_q is None
                                       else round(decode_q[0] / decode_q[1],
                                                  4)),
+        "prefill_tokens_per_sec": (None if decode is None
+                                   else round(decode[2], 1)),
     }
     if headline is not None:
         record.update({

@@ -35,8 +35,9 @@ def _ok_train(seq, mb, rc, iters, peak, model=None):
 
 
 def _ok_decode(hbm_bw, quantize=False):
-    # (tokens/sec, roofline tokens/sec)
-    return (3000.0, 8000.0) if quantize else (2000.0, 7000.0)
+    # (tokens/sec, roofline tokens/sec, prefill tokens/sec)
+    return ((3000.0, 8000.0, 9000.0) if quantize
+            else (2000.0, 7000.0, 9000.0))
 
 
 def test_all_points_ok(monkeypatch):
@@ -45,6 +46,7 @@ def test_all_points_ok(monkeypatch):
     assert rec["decode_tokens_per_sec"] == 2000.0
     assert rec["decode_roofline_frac"] == round(2000.0 / 7000.0, 4)
     assert rec["decode_tokens_per_sec_int8"] == 3000.0
+    assert rec["prefill_tokens_per_sec"] == 9000.0
     # 5 seq points + the 7B-width point
     assert len(rec["mfu_vs_seq"]) == 6
     assert any(p.get("config", "").startswith("7b-width")
