@@ -84,7 +84,7 @@ def _decode_kernel_int8(scale: float, nk: int, block_k: int,
 
     q = q_ref[0, 0]                                   # [g_pad, d]
     k = k_ref[0, 0].astype(jnp.float32)               # [block_k, d] int8→f32
-    ks = ks_ref[0, 0]                                 # [block_k]
+    ks = ks_ref[0, 0][:, 0]                           # [block_k, 1] → [block_k]
     s = jax.lax.dot_general(
         q.astype(jnp.float32), k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -100,7 +100,7 @@ def _decode_kernel_int8(scale: float, nk: int, block_k: int,
         alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True),
         l_scr.shape)
     v = v_ref[0, 0].astype(jnp.float32)               # [block_k, d]
-    vs = vs_ref[0, 0]                                 # [block_k]
+    vs = vs_ref[0, 0][:, 0]                           # [block_k]
     pv = jax.lax.dot_general(
         p * vs[None, :], v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -176,8 +176,12 @@ def _cache_block_spec(block_k, d):
 
 
 def _scale_block_spec(block_k):
-    return pl.BlockSpec((1, 1, block_k),
-                        lambda bi, hi, ki, lens: (bi, hi, ki))
+    # Scales ride as [b, kv, max_len, 1]: a trailing unit dim keeps the
+    # block's last two dims (block_k, 1) legal under the TPU (8, 128)
+    # tiling rule (last dim equals the array dim; a 3-D [.., block_k]
+    # block with a size-1 sublane dim is rejected by the Mosaic lowering).
+    return pl.BlockSpec((1, 1, block_k, 1),
+                        lambda bi, hi, ki, lens: (bi, hi, ki, 0))
 
 
 def flash_decode(
@@ -213,7 +217,8 @@ def flash_decode_int8(
     (ops/kv_quant.py form: per-row fp32 scales folded into the scores /
     probabilities inside the kernel)."""
     return _decode_call(
-        _decode_kernel_int8, q, [k_q, k_scale, v_q, v_scale], cache_len,
+        _decode_kernel_int8, q,
+        [k_q, k_scale[..., None], v_q, v_scale[..., None]], cache_len,
         softmax_scale, block_k, interpret,
         lambda bk, d: [_cache_block_spec(bk, d), _scale_block_spec(bk),
                        _cache_block_spec(bk, d), _scale_block_spec(bk)])
