@@ -87,7 +87,8 @@ def _bench_model(seq: int, recompute: str):
     )
 
 
-def _bench_model_7b_width(seq: int, num_layers: int):
+def _bench_model_7b_width(seq: int, num_layers: int,
+                          recompute: str = "selective"):
     """Llama-2-7B *width* (hidden 4096, ffn 11008, 32 q-heads × d128) at
     reduced depth so training state fits one chip; GQA (8 kv-heads) trims
     the kv projections the way the 34B/70B presets do.  MFU at this width
@@ -106,7 +107,7 @@ def _bench_model_7b_width(seq: int, num_layers: int):
         max_position_embeddings=seq,
         params_dtype="bfloat16",
         attention_impl="flash",
-        recompute="full",
+        recompute=recompute,
     )
 
 
@@ -391,19 +392,22 @@ def main() -> None:
                           "tokens_per_sec": round(c_tps, 1)})
 
     # 7B-width point (BASELINE configs are all 7B–70B; the 374M proxy's
-    # matmuls are narrower than any of them).  Full remat + shallow depth
-    # to fit ~14 GB of train state in one chip's HBM; L=2 fallback if the
-    # L=3 state spills.
+    # matmuls are narrower than any of them).  Shallow depth to fit
+    # ~11-13 GB of train state in one chip's HBM.  Measured ladder on
+    # v5e (2026-07-31): L3/mb2/selective 0.556, L2/mb2/selective 0.535,
+    # L3/mb1/full 0.441 — mb ≥ 2 + selective remat is the lever; the
+    # full-remat L2 rung is the spill fallback.
     wide = None
-    for layers in (3, 2):
+    for layers, mb, rc in ((3, 2, "selective"), (2, 2, "selective"),
+                           (2, 1, "full")):
         wide = _point(f"train@4096/7b-width-L{layers}", _train_point,
-                      4096, 1, "full", 5, peak,
-                      _bench_model_7b_width(4096, layers))
+                      4096, mb, rc, 5, peak,
+                      _bench_model_7b_width(4096, layers, rc))
         if wide is not None:
             w_tps, w_mfu, _, w_params = wide
             curve.append({"seq_length": 4096, "mfu": round(w_mfu, 4),
                           "tokens_per_sec": round(w_tps, 1),
-                          "config": f"7b-width-L{layers}",
+                          "config": f"7b-width-L{layers}-mb{mb}-{rc}",
                           "model_params": w_params})
             break
 
