@@ -281,6 +281,12 @@ class ParallelConfig:
     # ZeRO-1: shard optimizer state over dp
     # (reference: megatron/optimizer/distrib_optimizer.py)
     use_distributed_optimizer: bool = False
+    # Encoder/decoder split-rank pipeline parallelism (T5): the first
+    # ``pipeline_split_rank`` stages hold the encoder stack, the rest the
+    # decoder (reference: megatron/core/parallel_state.py:110-112,177-184,
+    # ``pipeline_model_parallel_split_rank``).  None → pp // 2 when the
+    # encdec pipeline is used; ignored by decoder-only families.
+    pipeline_split_rank: Optional[int] = None
 
     @property
     def world_size(self) -> int:
@@ -315,6 +321,11 @@ class ParallelConfig:
                     "reference's interleaved 1F1B asserts) — otherwise the "
                     "legacy circular buffer would be re-saved at every "
                     "window boundary, inflating memory")
+        if self.pipeline_split_rank is not None:
+            assert 0 < self.pipeline_split_rank < self.pipeline_parallel, (
+                f"pipeline_split_rank {self.pipeline_split_rank} must lie "
+                f"strictly inside the pipeline ({self.pipeline_parallel} "
+                "stages) — at least one stage each for encoder and decoder")
         return self
 
 

@@ -354,14 +354,11 @@ def t5_loss(cfg: ModelConfig, params: Params, batch: dict,
 # families — the reference trains BERT/T5 through the same TP machinery as
 # GPT, megatron/core/parallel_state.py + pretrain_bert.py/pretrain_t5.py).
 #
-# Descope note: the reference also offers encoder/decoder SPLIT-RANK
-# pipeline parallelism for T5 (parallel_state.py:110-112,177-184 —
-# pipeline stages partitioned between the two stacks).  Here T5 runs
-# tp × dp (+ ZeRO-1); at the scale the reference ever trains T5 (≤11B,
-# secondary family) tensor sharding alone covers the memory need, and the
-# decoder's cross-attention would force every pipeline tick to carry the
-# full encoder output — a poor trade against the clean tp mapping.  The
-# decoder-only families keep full pp (parallel/pipeline.py).
+# Encoder/decoder SPLIT-RANK pipeline parallelism
+# (parallel_state.py:110-112,177-184 — pipeline stages partitioned between
+# the two stacks) lives in parallel/pipeline_encdec.py: the encoder output
+# rides the ppermute ring into every decoder stage's cross-attention, and
+# BERT runs the same ring encoder-only.
 # ---------------------------------------------------------------------------
 
 

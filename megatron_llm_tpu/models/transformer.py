@@ -142,6 +142,13 @@ class AttnSideInputs:
     # cfg.sequence_parallel_axis (+ the cp axis when cp is GSPMD-auto; the
     # pipeline omits cp because cp is manual inside its shard_map).
     seq_shard_axes: tuple = ()
+    # Explicit additive attention bias [b, 1, sq, sk] (fp32, -inf = masked).
+    # Used where the mask is *data-dependent* — the split-rank
+    # encoder-decoder pipeline selects causal-vs-bidirectional per stage at
+    # runtime (parallel/pipeline_encdec.py), which a static ``causal`` flag
+    # can't express.  Forces the einsum attention path (a bias rules out the
+    # flash kernel's implicit-mask layout).
+    attn_bias: Optional[jax.Array] = None
 
 
 def seq_constrain(x: jax.Array, axes: tuple):
@@ -267,6 +274,7 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
             softmax_scale=softmax_scale,
             dropout_rate=0.0 if side.deterministic else cfg.attention_dropout,
             dropout_rng=drop_rng,
+            bias=side.attn_bias,
             cp_axis=cfg.context_parallel_axis,
             cp_zigzag=cfg.context_parallel_zigzag,
             block_q=cfg.flash_block_q,

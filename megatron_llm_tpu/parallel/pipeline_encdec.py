@@ -1,0 +1,698 @@
+"""Split-rank pipeline parallelism for the encoder-decoder (T5) and
+encoder-only (BERT) families.
+
+Reference mapping:
+
+- ``pipeline_model_parallel_split_rank`` partitions the pipeline stages
+  between the encoder and decoder stacks
+  (megatron/core/parallel_state.py:110-112 — rank < split holds encoder,
+  rank >= split holds decoder; the embedding group spans first, split and
+  last ranks, :177-184) and ``megatron/model/t5_model.py`` routes the
+  encoder output into every decoder stage's cross-attention.
+- BERT runs through the same 1F1B schedule with all stages holding encoder
+  layers (``megatron/model/bert_model.py`` + schedules.py).
+
+TPU-first shape: the same differentiable ppermute ring as the decoder-only
+pipeline (``parallel/pipeline.py``) — one SPMD program whose ``jax.grad``
+*is* the backward pipeline.  Two things differ from the decoder-only ring:
+
+1. **The carry is a pair** ``(hidden, enc_ctx)``.  The encoder's final
+   hidden state is captured at the split stage (where the microbatch
+   crosses from encoder to decoder chunks) and then *rides the ring* with
+   its microbatch, so every decoder stage cross-attends over the right
+   encoder output.  In the reference this takes dedicated
+   encoder→decoder p2p plumbing (schedules.py forward passes carry
+   ``encoder_hidden_state``); here it is one extra ppermute operand, and
+   the encoder's cross-attention gradients arrive through the ppermute
+   transpose with no extra machinery.
+2. **Stage behavior is data-dependent** (encoder vs decoder chunk).  A
+   single uniform layer body runs on every stage: self-attention takes an
+   explicit additive bias selected per stage (bidirectional+padding for
+   encoder stages, causal+padding for decoder stages — a static ``causal``
+   flag can't vary across a manual mesh axis), and cross-attention runs
+   everywhere but is multiplied by ``is_decoder`` — encoder stages hold
+   zero cross weights, the mask keeps the forward exact *and* the dummy
+   cotangents zero, so the zero weights are a fixed point of training.
+
+Layer→stage assignment: encoder layers ``reshape(split, lpc)`` over stages
+[0, split), decoder layers ``reshape(pp - split, lpc)`` over [split, pp).
+Both segments must share one layers-per-chunk (the uniform [pp, lpc, ...]
+stacking); T5's default symmetric depths with split = pp/2 satisfy this.
+
+Schedule: plain 1F1B (T = M + pp - 1 ticks).  The reference likewise
+restricts the interleaved schedule to decoder-only models
+(megatron/training.py:206-221 builds virtual chunks only for GPT).
+Windowed tick-loop rematerialization (``pipeline_remat_window``) composes
+exactly as in the decoder-only ring.
+
+Sequence lengths: encoder and decoder sequences may differ; the ring carry
+is padded to ``max(s_enc, s_dec)`` and padding rides as segment-0 (pad)
+positions that the attention bias already excludes — cheaper than a
+dynamic-shape ring, which XLA would recompile per shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..config import ModelConfig, ParallelConfig, RuntimeConfig
+from ..models.encdec import cross_attention_block
+from ..models.transformer import (
+    AttnSideInputs,
+    _dropout,
+    attention_block,
+    mlp_block,
+)
+from ..ops.norms import norm_apply
+from .cross_entropy import cross_entropy
+from . import mesh as mesh_lib
+from .pipeline import auto_remat_window
+
+PyTree = Any
+PP = mesh_lib.PIPELINE_AXIS
+
+
+def resolve_split(parallel: ParallelConfig) -> int:
+    """Encoder/decoder stage split (reference default: pp // 2 when
+    ``pipeline_model_parallel_split_rank`` is unset)."""
+    pp = parallel.pipeline_parallel
+    split = parallel.pipeline_split_rank
+    if split is None:
+        split = pp // 2
+    assert 0 < split < pp, (split, pp)
+    return split
+
+
+def _check_chunks(n_enc: int, n_dec: int, split: int, pp: int) -> int:
+    enc_stages, dec_stages = split, pp - split
+    assert n_enc % enc_stages == 0, (
+        f"encoder layers {n_enc} must divide over {enc_stages} stages")
+    assert n_dec % dec_stages == 0, (
+        f"decoder layers {n_dec} must divide over {dec_stages} stages")
+    lpc_e, lpc_d = n_enc // enc_stages, n_dec // dec_stages
+    assert lpc_e == lpc_d, (
+        f"encoder ({n_enc}/{enc_stages}={lpc_e}) and decoder "
+        f"({n_dec}/{dec_stages}={lpc_d}) layers-per-stage must match for "
+        "the uniform stage stacking; choose split so both segments get "
+        "equal chunks (T5's symmetric depths with split = pp/2 do)")
+    return lpc_e
+
+
+# ---------------------------------------------------------------------------
+# Stage-stacked parameter layouts
+# ---------------------------------------------------------------------------
+
+
+def t5_to_pipeline_params(params: PyTree, parallel: ParallelConfig) -> PyTree:
+    """``init_t5_params`` layout → split-rank pipeline layout.
+
+    Returns {"layers": [pp, lpc, ...] self blocks (encoder stages first),
+    "cross": [pp, lpc, ...] cross blocks (zeros on encoder stages), plus
+    the replicated io leaves (embedding, enc_norm, dec_norm, lm_head_bias)}.
+    """
+    pp = parallel.pipeline_parallel
+    split = resolve_split(parallel)
+    enc = params["encoder"]
+    dec = params["decoder"]
+    n_enc = jax.tree.leaves(enc)[0].shape[0]
+    n_dec = jax.tree.leaves(dec)[0].shape[0]
+    lpc = _check_chunks(n_enc, n_dec, split, pp)
+
+    def stack_self(e, d):
+        return jnp.concatenate([
+            e.reshape(split, lpc, *e.shape[1:]),
+            d.reshape(pp - split, lpc, *d.shape[1:]),
+        ])
+
+    def stack_cross(c):
+        staged = c.reshape(pp - split, lpc, *c.shape[1:])
+        pad = jnp.zeros((split, lpc) + c.shape[1:], c.dtype)
+        return jnp.concatenate([pad, staged])
+
+    return {
+        "layers": jax.tree.map(stack_self, enc, dec),
+        "cross": jax.tree.map(stack_cross, params["cross"]),
+        "embedding": params["embedding"],
+        "enc_norm": params["enc_norm"],
+        "dec_norm": params["dec_norm"],
+        "lm_head_bias": params["lm_head_bias"],
+    }
+
+
+def t5_from_pipeline_params(staged: PyTree,
+                            parallel: ParallelConfig) -> PyTree:
+    """Inverse of :func:`t5_to_pipeline_params` (checkpoint interop)."""
+    pp = parallel.pipeline_parallel
+    split = resolve_split(parallel)
+
+    def unstack_enc(x):
+        e = x[:split]
+        return e.reshape(e.shape[0] * e.shape[1], *e.shape[2:])
+
+    def unstack_dec(x):
+        d = x[split:]
+        return d.reshape(d.shape[0] * d.shape[1], *d.shape[2:])
+
+    return {
+        "embedding": staged["embedding"],
+        "encoder": jax.tree.map(unstack_enc, staged["layers"]),
+        "decoder": jax.tree.map(unstack_dec, staged["layers"]),
+        "cross": jax.tree.map(unstack_dec, staged["cross"]),
+        "enc_norm": staged["enc_norm"],
+        "dec_norm": staged["dec_norm"],
+        "lm_head_bias": staged["lm_head_bias"],
+    }
+
+
+def bert_to_pipeline_params(params: PyTree,
+                            parallel: ParallelConfig) -> PyTree:
+    """``init_bert_params`` layout → [pp, lpc, ...] staged layers."""
+    pp = parallel.pipeline_parallel
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda x: x.reshape(pp, x.shape[0] // pp, *x.shape[1:]),
+        params["layers"])
+    return out
+
+
+def bert_from_pipeline_params(staged: PyTree, parallel) -> PyTree:
+    out = dict(staged)
+    out["layers"] = jax.tree.map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
+        staged["layers"])
+    return out
+
+
+def _staged_specs(layer_specs: PyTree) -> PyTree:
+    """Per-layer-stack specs P(None, *dims) → P('pp', None, *dims) for the
+    [pp, lpc, ...] layout (the leading layer dim of the flat spec becomes
+    the lpc dim)."""
+    return jax.tree.map(
+        lambda s: P(PP, *tuple(s)) if len(tuple(s)) else P(PP, None),
+        layer_specs, is_leaf=lambda s: isinstance(s, P))
+
+
+def t5_pipeline_param_specs(cfg: ModelConfig, parallel) -> PyTree:
+    from ..models.encdec import t5_param_specs
+
+    base = t5_param_specs(cfg, parallel)
+    return {
+        "layers": _staged_specs(base["encoder"]),
+        "cross": _staged_specs(base["cross"]),
+        "embedding": base["embedding"],
+        "enc_norm": base["enc_norm"],
+        "dec_norm": base["dec_norm"],
+        "lm_head_bias": base["lm_head_bias"],
+    }
+
+
+def bert_pipeline_param_specs(cfg: ModelConfig, parallel) -> PyTree:
+    from ..models.encdec import bert_param_specs
+
+    base = bert_param_specs(cfg, parallel)
+    out = dict(base)
+    out["layers"] = _staged_specs(base["layers"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Shared tick machinery
+# ---------------------------------------------------------------------------
+
+
+def _pad_seq(x: jax.Array, smax: int) -> jax.Array:
+    """Pad dim 1 (sequence) of [mb, s, ...] up to smax with zeros."""
+    s = x.shape[1]
+    if s == smax:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[1] = (0, smax - s)
+    return jnp.pad(x, pad)
+
+
+def _segment_eq_bias(seg: jax.Array, causal: bool) -> jax.Array:
+    """[mb, s] segment ids (content=1, pad=0) → additive [mb, 1, s, s]
+    fp32 bias: attend iff same segment (and j ≤ i when causal).  The
+    diagonal is always allowed, so no softmax row is ever all-masked."""
+    allow = seg[:, :, None] == seg[:, None, :]
+    if causal:
+        s = seg.shape[1]
+        allow = allow & (jnp.arange(s)[None, :, None]
+                         >= jnp.arange(s)[None, None, :])
+    return jnp.where(allow, 0.0, -jnp.inf).astype(jnp.float32)[:, None]
+
+
+def _window_scan(tick, init, T: int, window: int):
+    """Run ``lax.scan(tick, init, arange(T))``, optionally remat-windowed
+    (the decoder-only ring's pipeline_remat_window, pipeline.py:599-626).
+    Padding ticks (t ≥ T) must be no-ops in ``tick`` (masked updates)."""
+    if window and window > 0 and T > window:
+        n_win = -(-T // window)
+        ticks = jnp.arange(n_win * window).reshape(n_win, window)
+
+        def window_body(carry, ts):
+            carry, _ = jax.lax.scan(tick, carry, ts)
+            return carry, None
+
+        carry, _ = jax.lax.scan(
+            jax.checkpoint(window_body, prevent_cse=False), init, ticks)
+        return carry
+    carry, _ = jax.lax.scan(tick, init, jnp.arange(T))
+    return carry
+
+
+def _dp_manual_axis(mesh):
+    return (mesh_lib.DATA_AXIS
+            if (mesh_lib.DATA_AXIS in mesh.axis_names
+                and dict(mesh.shape).get(mesh_lib.DATA_AXIS, 1) > 1)
+            else None)
+
+
+# ---------------------------------------------------------------------------
+# T5 split-rank pipelined loss
+# ---------------------------------------------------------------------------
+
+
+def t5_pipeline_loss(
+    cfg: RuntimeConfig,
+    params: PyTree,  # t5_to_pipeline_params layout
+    batch: dict,  # leaves [M, mb, ...]
+    *,
+    mesh,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Mean masked CE over M microbatches through the split-rank pipeline.
+
+    ``batch``: enc_tokens [M, mb, s_enc], dec_tokens/labels/loss_mask
+    [M, mb, s_dec], optional enc_pad_mask/dec_pad_mask.  Exactness vs the
+    unpipelined ``encdec.t5_loss`` is tested in
+    tests/parallel/test_pipeline_encdec.py.
+    """
+    model_cfg = cfg.model
+    parallel = cfg.parallel
+    pp = parallel.pipeline_parallel
+    split = resolve_split(parallel)
+    assert parallel.virtual_pipeline_stages == 1, (
+        "interleaved (vpp > 1) schedules are decoder-only, as in the "
+        "reference (megatron/training.py:206-221)")
+    assert parallel.context_parallel == 1, (
+        "context parallelism is decoder-only")
+
+    enc_tokens = batch["enc_tokens"]
+    dec_tokens = batch["dec_tokens"]
+    labels = batch["labels"]
+    loss_mask = batch["loss_mask"]
+    M = enc_tokens.shape[0]
+    s_enc, s_dec = enc_tokens.shape[2], dec_tokens.shape[2]
+    smax = max(s_enc, s_dec)
+    enc_pad = batch.get("enc_pad_mask")
+    if enc_pad is None:
+        enc_pad = jnp.ones(enc_tokens.shape, jnp.float32)
+    dec_pad = batch.get("dec_pad_mask")
+    if dec_pad is None:
+        dec_pad = jnp.ones(dec_tokens.shape, jnp.float32)
+
+    T = M + pp - 1
+    ring = [(s, (s + 1) % pp) for s in range(pp)]
+    compute_dtype = model_cfg.dtype
+    deterministic = rng is None
+
+    def cast(tree):
+        return jax.tree.map(lambda x: x.astype(compute_dtype), tree)
+
+    io_params = {"embedding": params["embedding"],
+                 "enc_norm": params["enc_norm"],
+                 "dec_norm": params["dec_norm"],
+                 "lm_head_bias": params["lm_head_bias"]}
+
+    dp_axis = _dp_manual_axis(mesh)
+
+    W = parallel.pipeline_remat_window
+    if W == -1:
+        W = auto_remat_window(model_cfg, pp=pp, vpp=1, M=M)
+
+    def pipelined(layers, cross, io_p, enc_tok, dec_tok, lab, msk,
+                  epad, dpad):
+        # layers/cross arrive [1, lpc, ...] (pp manual) → drop stage dim
+        layers_l = jax.tree.map(lambda c: c[0], layers)
+        cross_l = jax.tree.map(lambda c: c[0], cross)
+        stage = jax.lax.axis_index(PP)
+        # LOCAL microbatch rows (dp slices the mb dim): the carry shapes
+        # must come from the sliced operands, not the global batch — a
+        # global-mb carry would make the stage-0 jnp.where broadcast each
+        # shard's rows, silently duplicating them.
+        mb_l = enc_tok.shape[1]
+        is_dec = stage >= split
+        is_dec_f = is_dec.astype(compute_dtype)
+
+        rng_l = rng
+        if dp_axis is not None and rng_l is not None:
+            rng_l = jax.random.fold_in(rng_l, jax.lax.axis_index(dp_axis))
+
+        def dsum(x):
+            return jax.lax.psum(x, dp_axis) if dp_axis is not None else x
+
+        def embed(tokens, position_len):
+            e = cast(io_p["embedding"])
+            pos = jnp.arange(position_len)[None, :]
+            return (e["word"][tokens] + e["position"][pos]
+                    ).astype(compute_dtype)
+
+        def head_fn(h, lab_m, msk_m):
+            hp = cast({"dec_norm": io_p["dec_norm"]})
+            dec = h[:, :s_dec]
+            dec = norm_apply(model_cfg.norm_type, dec, hp["dec_norm"],
+                             model_cfg.norm_eps, impl=model_cfg.norm_impl)
+            word = cast(io_p["embedding"])["word"]
+            logits = (dec @ word.T).astype(jnp.float32)
+            logits = logits + io_p["lm_head_bias"]
+            per_tok = cross_entropy(logits, lab_m,
+                                    vocab_size=model_cfg.vocab_size)
+            m = msk_m.astype(jnp.float32)
+            num = dsum(jnp.sum(per_tok * m))
+            den = jnp.maximum(dsum(jnp.sum(m)), 1.0)
+            return num / den
+
+        head_fn = jax.checkpoint(head_fn, prevent_cse=False)
+
+        def chunk_apply(h, ctx, self_bias, epad_m, tick_rng):
+            """Apply this stage's lpc layers: self-attn (stage-selected
+            bias) → cross-attn (·is_dec) → MLP, the t5_decoder_forward
+            ordering (models/encdec.py) which degenerates bitwise to the
+            encoder layer when cross is zero."""
+
+            def body(carry, inp):
+                hh, idx = carry
+                p_self, p_cross = cast(inp)
+                lrng = (jax.random.fold_in(tick_rng, idx)
+                        if tick_rng is not None else None)
+
+                def drop(x, salt):
+                    if lrng is None:
+                        return x
+                    return _dropout(x, model_cfg.hidden_dropout,
+                                    jax.random.fold_in(lrng, salt),
+                                    deterministic)
+
+                side = AttnSideInputs(deterministic=deterministic,
+                                      causal=False, attn_bias=self_bias)
+                h1 = norm_apply(model_cfg.norm_type, hh,
+                                p_self["input_norm"], model_cfg.norm_eps,
+                                impl=model_cfg.norm_impl)
+                hh = hh + drop(attention_block(model_cfg, p_self["attn"],
+                                               h1, side, lrng), 2)
+                c1 = norm_apply(model_cfg.norm_type, hh, p_cross["norm"],
+                                model_cfg.norm_eps, impl=model_cfg.norm_impl)
+                hh = hh + drop(
+                    cross_attention_block(model_cfg, p_cross, c1, ctx,
+                                          epad_m) * is_dec_f, 3)
+                m1 = norm_apply(model_cfg.norm_type, hh,
+                                p_self["post_attn_norm"], model_cfg.norm_eps,
+                                impl=model_cfg.norm_impl)
+                hh = hh + drop(mlp_block(model_cfg, p_self["mlp"], m1), 4)
+                return (hh, idx + 1), None
+
+            if model_cfg.recompute != "none":
+                body = jax.checkpoint(body, prevent_cse=False)
+            (h, _), _ = jax.lax.scan(body, (h, 0), (layers_l, cross_l))
+            return h
+
+        def tick(carry, t):
+            state_h, state_ctx, loss_sum = carry
+            rel = t - stage
+            m_idx = jnp.clip(rel, 0, M - 1)
+
+            tok_e = jax.lax.dynamic_index_in_dim(enc_tok, m_idx, 0,
+                                                 keepdims=False)
+            tok_d = jax.lax.dynamic_index_in_dim(dec_tok, m_idx, 0,
+                                                 keepdims=False)
+            epad_m = jax.lax.dynamic_index_in_dim(epad, m_idx, 0,
+                                                  keepdims=False)
+            dpad_m = jax.lax.dynamic_index_in_dim(dpad, m_idx, 0,
+                                                  keepdims=False)
+
+            # Stage 0: embed the entering microbatch's encoder tokens.
+            fresh_enc = _pad_seq(embed(tok_e, s_enc), smax)
+            # Split stage: the arriving carry is the encoder's final
+            # hidden — capture it (through the final encoder norm) as the
+            # cross-attention context and restart the ring carry with the
+            # decoder embedding of the same microbatch.
+            enc_out = norm_apply(
+                model_cfg.norm_type, state_h[:, :s_enc],
+                cast({"n": io_p["enc_norm"]})["n"],
+                model_cfg.norm_eps, impl=model_cfg.norm_impl)
+            fresh_dec = _pad_seq(embed(tok_d, s_dec), smax)
+
+            h_cur = jnp.where(stage == 0, fresh_enc, state_h)
+            h_cur = jnp.where(stage == split, fresh_dec, h_cur)
+            ctx_cur = jnp.where(stage == split, enc_out, state_ctx)
+
+            seg_e = _pad_seq(epad_m.astype(jnp.int32), smax)
+            seg_d = _pad_seq(dpad_m.astype(jnp.int32), smax)
+            self_bias = jnp.where(is_dec,
+                                  _segment_eq_bias(seg_d, causal=True),
+                                  _segment_eq_bias(seg_e, causal=False))
+
+            tick_rng = None
+            if rng_l is not None:
+                tick_rng = jax.random.fold_in(
+                    jax.random.fold_in(rng_l, m_idx), stage)
+
+            out = chunk_apply(h_cur, ctx_cur, self_bias, epad_m, tick_rng)
+
+            # Streamed head on the microbatch finishing at this tick.
+            out_idx = t - (pp - 1)
+            head_valid = ((out_idx >= 0) & (out_idx < M)
+                          & (stage == pp - 1))
+            w_idx = jnp.clip(out_idx, 0, M - 1)
+            lab_m = jax.lax.dynamic_index_in_dim(lab, w_idx, 0,
+                                                 keepdims=False)
+            msk_m = jax.lax.dynamic_index_in_dim(msk, w_idx, 0,
+                                                 keepdims=False)
+            mb_loss = head_fn(out, lab_m, msk_m)
+            loss_sum = loss_sum + jnp.where(head_valid, mb_loss, 0.0)
+
+            new_h = jax.lax.ppermute(out, PP, ring)
+            new_ctx = jax.lax.ppermute(ctx_cur, PP, ring)
+            return (new_h, new_ctx, loss_sum), None
+
+        init = (jnp.zeros((mb_l, smax, model_cfg.hidden_size),
+                          compute_dtype),
+                jnp.zeros((mb_l, s_enc, model_cfg.hidden_size),
+                          compute_dtype),
+                jnp.zeros((), jnp.float32))
+        _, _, loss_sum = _window_scan(tick, init, T, W)
+        # fp32 scalar psum over pp (see pipeline.py: bf16 boundary
+        # collectives crash XLA:CPU's AllReducePromotion).
+        return jax.lax.psum(loss_sum, PP)
+
+    layer_in_specs = jax.tree.map(lambda _: P(PP), params["layers"])
+    cross_in_specs = jax.tree.map(lambda _: P(PP), params["cross"])
+    manual_axes = {PP}
+    side_spec = P(None)
+    if dp_axis is not None:
+        manual_axes.add(dp_axis)
+        side_spec = P(None, dp_axis)
+    fn = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(layer_in_specs, cross_in_specs, P(), side_spec, side_spec,
+                  side_spec, side_spec, side_spec, side_spec),
+        out_specs=P(),
+        axis_names=manual_axes,
+        check_vma=False,
+    )
+    loss_total = fn(params["layers"], params["cross"], io_params,
+                    enc_tokens, dec_tokens, labels, loss_mask, enc_pad,
+                    dec_pad)
+    return loss_total / M
+
+
+# ---------------------------------------------------------------------------
+# BERT pipelined loss (encoder-only: all pp stages hold encoder layers)
+# ---------------------------------------------------------------------------
+
+
+def bert_pipeline_loss(
+    cfg: RuntimeConfig,
+    params: PyTree,  # bert_to_pipeline_params layout
+    batch: dict,  # leaves [M, mb, ...]
+    *,
+    mesh,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Masked-LM (+ NSP) loss through the pipeline; exactness vs
+    ``encdec.bert_loss`` tested in tests/parallel/test_pipeline_encdec.py.
+    """
+    from ..models.transformer import stack_forward
+
+    model_cfg = cfg.model
+    parallel = cfg.parallel
+    pp = parallel.pipeline_parallel
+    assert parallel.virtual_pipeline_stages == 1, (
+        "interleaved (vpp > 1) schedules are decoder-only here and in the "
+        "reference (megatron/training.py:206-221)")
+    assert parallel.context_parallel == 1
+
+    tokens = batch["tokens"]
+    pad_mask = batch["pad_mask"]
+    labels = batch["labels"]
+    loss_mask = batch["loss_mask"]
+    tokentype = batch.get("tokentype_ids")
+    is_random = batch.get("is_random")
+    M, _, s = tokens.shape
+
+    T = M + pp - 1
+    ring = [(st, (st + 1) % pp) for st in range(pp)]
+    compute_dtype = model_cfg.dtype
+    deterministic = rng is None
+    lpc = jax.tree.leaves(params["layers"])[0].shape[1]
+
+    def cast(tree):
+        return jax.tree.map(lambda x: x.astype(compute_dtype), tree)
+
+    io_params = {k: params[k] for k in
+                 ("embedding", "embed_norm", "final_norm", "lm_head",
+                  "pooler", "binary_head")}
+    dp_axis = _dp_manual_axis(mesh)
+
+    W = parallel.pipeline_remat_window
+    if W == -1:
+        W = auto_remat_window(model_cfg, pp=pp, vpp=1, M=M)
+
+    def pipelined(layers, io_p, tok, pad, lab, msk, tt, is_rand):
+        layers_l = jax.tree.map(lambda c: c[0], layers)
+        stage = jax.lax.axis_index(PP)
+        mb_l = tok.shape[1]  # local rows — see the T5 pipelined comment
+
+        rng_l = rng
+        if dp_axis is not None and rng_l is not None:
+            rng_l = jax.random.fold_in(rng_l, jax.lax.axis_index(dp_axis))
+
+        def dsum(x):
+            return jax.lax.psum(x, dp_axis) if dp_axis is not None else x
+
+        def embed(tok_m, tt_m):
+            e = cast(io_p["embedding"])
+            pos = jnp.arange(s)[None, :]
+            x = e["word"][tok_m] + e["position"][pos] + e["tokentype"][tt_m]
+            return norm_apply(
+                model_cfg.norm_type, x, cast(io_p["embed_norm"]),
+                model_cfg.norm_eps, impl=model_cfg.norm_impl,
+            ).astype(compute_dtype)
+
+        def head_fn(h, lab_m, msk_m, rand_m):
+            """final norm → MLM transform → tied logits (+ NSP), the
+            bert_encode/bert_forward tail (models/encdec.py)."""
+            x = norm_apply(model_cfg.norm_type, h,
+                           cast(io_p["final_norm"]), model_cfg.norm_eps,
+                           impl=model_cfg.norm_impl)
+            hd = cast({"lm_head": io_p["lm_head"],
+                       "pooler": io_p["pooler"],
+                       "binary_head": io_p["binary_head"]})
+            head = hd["lm_head"]
+            tfm = x @ head["dense"] + head["dense_bias"]
+            tfm = jax.nn.gelu(tfm)
+            tfm = norm_apply(model_cfg.norm_type, tfm, head["norm"],
+                             model_cfg.norm_eps, impl=model_cfg.norm_impl)
+            word = cast(io_p["embedding"])["word"]
+            mlm_logits = (tfm @ word.T).astype(jnp.float32)
+            mlm_logits = mlm_logits + io_p["lm_head"]["bias"]
+            per_tok = cross_entropy(mlm_logits, lab_m,
+                                    vocab_size=model_cfg.vocab_size)
+            m = msk_m.astype(jnp.float32)
+            num = dsum(jnp.sum(per_tok * m))
+            den = jnp.maximum(dsum(jnp.sum(m)), 1.0)
+            mb_loss = num / den
+            if rand_m is not None:
+                pooled = jnp.tanh(x[:, 0] @ hd["pooler"]["w"]
+                                  + hd["pooler"]["b"])
+                bin_logits = (pooled @ hd["binary_head"]["w"]
+                              + hd["binary_head"]["b"]).astype(jnp.float32)
+                nsp = cross_entropy(bin_logits[:, None, :],
+                                    rand_m[:, None], vocab_size=2)
+                mb_loss = mb_loss + dsum(jnp.sum(nsp)) / dsum(
+                    jnp.full((), float(nsp.size), jnp.float32))
+            return mb_loss
+
+        head_fn = jax.checkpoint(head_fn, prevent_cse=False)
+
+        def tick(carry, t):
+            state_h, loss_sum = carry
+            rel = t - stage
+            m_idx = jnp.clip(rel, 0, M - 1)
+
+            tok_m = jax.lax.dynamic_index_in_dim(tok, m_idx, 0,
+                                                 keepdims=False)
+            pad_m = jax.lax.dynamic_index_in_dim(pad, m_idx, 0,
+                                                 keepdims=False)
+            tt_m = (jnp.zeros_like(tok_m) if tt is None else
+                    jax.lax.dynamic_index_in_dim(tt, m_idx, 0,
+                                                 keepdims=False))
+            fresh = embed(tok_m, tt_m)
+            h_cur = jnp.where(stage == 0, fresh, state_h)
+
+            tick_rng = None
+            if rng_l is not None:
+                tick_rng = jax.random.fold_in(
+                    jax.random.fold_in(rng_l, m_idx), stage)
+
+            side = AttnSideInputs(
+                segment_ids=pad_m.astype(jnp.int32),
+                deterministic=deterministic, causal=False)
+            # Cast per tick: with fp32 caller params the scan transpose
+            # accumulates each tick's weight cotangents in fp32
+            # (pipeline.py:_stage_tick does the same for the decoder ring).
+            out, _aux = stack_forward(model_cfg, cast(layers_l), h_cur,
+                                      side, tick_rng,
+                                      layer_offset=stage * lpc)
+
+            out_idx = t - (pp - 1)
+            head_valid = ((out_idx >= 0) & (out_idx < M)
+                          & (stage == pp - 1))
+            w_idx = jnp.clip(out_idx, 0, M - 1)
+            lab_m = jax.lax.dynamic_index_in_dim(lab, w_idx, 0,
+                                                 keepdims=False)
+            msk_m = jax.lax.dynamic_index_in_dim(msk, w_idx, 0,
+                                                 keepdims=False)
+            rand_m = (None if is_rand is None else
+                      jax.lax.dynamic_index_in_dim(is_rand, w_idx, 0,
+                                                   keepdims=False))
+            mb_loss = head_fn(out, lab_m, msk_m, rand_m)
+            loss_sum = loss_sum + jnp.where(head_valid, mb_loss, 0.0)
+
+            return (jax.lax.ppermute(out, PP, ring), loss_sum), None
+
+        init = (jnp.zeros((mb_l, s, model_cfg.hidden_size), compute_dtype),
+                jnp.zeros((), jnp.float32))
+        _, loss_sum = _window_scan(tick, init, T, W)
+        return jax.lax.psum(loss_sum, PP)
+
+    layer_in_specs = jax.tree.map(lambda _: P(PP), params["layers"])
+    manual_axes = {PP}
+    side_spec = P(None)
+    if dp_axis is not None:
+        manual_axes.add(dp_axis)
+        side_spec = P(None, dp_axis)
+    in_specs = [layer_in_specs, P(), side_spec, side_spec, side_spec,
+                side_spec]
+    # Optional operands can't be None through shard_map in_specs; bind
+    # their presence statically.
+    in_specs.append(side_spec if tokentype is not None else None)
+    in_specs.append(side_spec if is_random is not None else None)
+
+    fn = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=P(),
+        axis_names=manual_axes,
+        check_vma=False,
+    )
+    loss_total = fn(params["layers"], io_params, tokens, pad_mask, labels,
+                    loss_mask, tokentype, is_random)
+    return loss_total / M

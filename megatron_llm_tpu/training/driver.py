@@ -803,6 +803,7 @@ def pretrain_custom(
     valid_dataset=None,
     eval_loss_fn=None,
     param_specs: Optional[PyTree] = None,
+    pipeline_loss_fn=None,
 ) -> TrainState:
     """Training loop for an arbitrary model family (BERT/T5/biencoder).
 
@@ -813,8 +814,25 @@ def pretrain_custom(
     parallelism via GSPMD, the same full-stack path the reference gives
     BERT/T5 (megatron/core/parallel_state.py); without it params stay
     replicated (dp only).
+
+    With ``pipeline_loss_fn`` (and ``pipeline_parallel > 1``) the step
+    instead differentiates the family's pipelined schedule
+    (parallel/pipeline_encdec.py: T5 split-rank, BERT encoder pipeline);
+    ``params``/``param_specs`` must then already be in the stage-stacked
+    pipeline layout, and the grad-accum count doubles as the microbatch
+    count of the schedule (the reference derives num_microbatches the
+    same way, megatron/microbatches.py).
     """
     cfg.validate()
+    if pipeline_loss_fn is not None:
+        assert cfg.parallel.pipeline_parallel > 1 and param_specs is not None
+        assert cfg.grad_accum_steps == cfg.parallel.num_microbatches, (
+            f"global_batch_size/(micro_batch*dp) = {cfg.grad_accum_steps} "
+            f"must equal parallel.num_microbatches "
+            f"({cfg.parallel.num_microbatches}) for the pipelined step")
+        assert eval_loss_fn is None, (
+            "eval_loss_fn is not supported with pipeline_loss_fn — "
+            "evaluation reuses the pipelined schedule")
     timers = Timers()
     writer = NullWriter()
     if jax.process_index() == 0:
@@ -836,7 +854,8 @@ def pretrain_custom(
         state = _dedupe_buffers(jax.device_put(state, replicated))
     batch_sharding = NamedSharding(mesh, P(None, "dp"))
     step_fn = make_train_step(cfg, mesh, state_sharding, batch_sharding,
-                              loss_fn=loss_fn)
+                              loss_fn=loss_fn,
+                              pipeline_loss_fn=pipeline_loss_fn)
 
     iteration = 0
     consumed = 0
@@ -873,8 +892,15 @@ def pretrain_custom(
     def sample_index(position: int) -> int:
         return int(epoch_order(position // n)[position % n])
 
-    eval_fn = eval_loss_fn or loss_fn
-    eval_jit = jax.jit(lambda p, mb: eval_fn(cfg, p, mb, None, True))
+    if pipeline_loss_fn is not None:
+        # Evaluation reuses the pipelined schedule on a single
+        # microbatch group: [micro_total, ...] → [1, micro_total, ...].
+        eval_jit = jax.jit(lambda p, mb: pipeline_loss_fn(
+            cfg, p, jax.tree.map(lambda x: x[None], mb), mesh=mesh,
+            rng=None))
+    else:
+        eval_fn = eval_loss_fn or loss_fn
+        eval_jit = jax.jit(lambda p, mb: eval_fn(cfg, p, mb, None, True))
     eval_rng = np.random.default_rng(cfg.train.seed + 977)
 
     base_rng = jax.random.key(cfg.train.seed)

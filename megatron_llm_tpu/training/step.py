@@ -217,24 +217,33 @@ def _accumulate_grads(cfg: RuntimeConfig, params, batch, rng, rope,
 
 
 def _pipeline_grads(cfg: RuntimeConfig, params, batch, rng, rope,
-                    loss_scale, mesh):
-    """Grads via the pipelined schedule (parallel/pipeline.py) when pp > 1.
+                    loss_scale, mesh, pipeline_loss_fn=None):
+    """Grads via a pipelined schedule when pp > 1 — the decoder-LM ring
+    (parallel/pipeline.py) by default, or a family-specific schedule via
+    ``pipeline_loss_fn`` (parallel/pipeline_encdec.py).
 
     The microbatch loop *is* the pipeline here — one differentiable program
     whose jax.grad is the backward pipeline (reference: schedules.py:606-722
     drives backward through autograd send/recv hooks instead).
     """
-    from ..parallel import pipeline as pipe
+    if pipeline_loss_fn is None:
+        from ..parallel import pipeline as pipe
+
+        def loss_of(p32):
+            return pipe.pipeline_loss(cfg, p32, batch, mesh=mesh, rng=rng,
+                                      rope=rope)
+    else:
+        def loss_of(p32):
+            return pipeline_loss_fn(cfg, p32, batch, mesh=mesh, rng=rng)
 
     def scaled_loss(p32):
-        loss = pipe.pipeline_loss(cfg, p32, batch, mesh=mesh, rng=rng,
-                                  rope=rope)
+        loss = loss_of(p32)
         return loss * loss_scale, loss
 
-    # Differentiate w.r.t. an fp32 view: pipeline_loss casts to compute
-    # dtype at each per-tick use site, so the scan transposes accumulate
-    # weight cotangents across microbatches in fp32 — the same invariant
-    # _accumulate_grads keeps via its per-microbatch fp32 sum.
+    # Differentiate w.r.t. an fp32 view: the pipelined losses cast to
+    # compute dtype at each per-tick use site, so the scan transposes
+    # accumulate weight cotangents across microbatches in fp32 — the same
+    # invariant _accumulate_grads keeps via its per-microbatch fp32 sum.
     params32 = jax.tree.map(lambda p: p.astype(jnp.float32), params)
     (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params32)
     return grads, loss
@@ -242,14 +251,21 @@ def _pipeline_grads(cfg: RuntimeConfig, params, batch, rng, rope,
 
 def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
                base_rng: Optional[jax.Array] = None, rope=None, mesh=None,
-               loss_fn=None):
+               loss_fn=None, pipeline_loss_fn=None):
     """One optimizer step over ``grad_accum`` microbatches.
 
     Returns (new_state, metrics).  Donate ``state`` when jitting.
+
+    ``pipeline_loss_fn(cfg, params, batch, mesh=, rng=)`` supplies a
+    family-specific pipelined schedule for pp > 1 (the encoder-decoder
+    split-rank pipelines of parallel/pipeline_encdec.py); without it pp > 1
+    uses the decoder-LM pipeline of parallel/pipeline.py.
     """
-    if loss_fn is not None and cfg.parallel.pipeline_parallel > 1:
+    if (loss_fn is not None and cfg.parallel.pipeline_parallel > 1
+            and pipeline_loss_fn is None):
         raise NotImplementedError(
-            "custom loss_fn is not supported with pipeline parallelism")
+            "custom loss_fn is not supported with pipeline parallelism "
+            "(pass pipeline_loss_fn for the encdec families)")
     if loss_fn is not None and cfg.model.context_parallel_zigzag:
         # the zigzag batch permutation lives in compute_loss; a custom loss
         # would silently run zigzag attention on natural-order tokens
@@ -269,7 +285,7 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
         # MoE routing stats are not fanned out of the pipelined schedule —
         # only the aux loss crosses the shard_map boundary
         grads, loss = _pipeline_grads(cfg, state.params, batch, rng, rope,
-                                      loss_scale, mesh)
+                                      loss_scale, mesh, pipeline_loss_fn)
     else:
         grads, loss, moe_stats = _accumulate_grads(
             cfg, state.params, batch, rng, rope, loss_scale, loss_fn)
@@ -337,7 +353,8 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
 
 
 def make_train_step(cfg: RuntimeConfig, mesh=None, state_sharding=None,
-                    batch_sharding=None, loss_fn=None):
+                    batch_sharding=None, loss_fn=None,
+                    pipeline_loss_fn=None):
     """jit-compile ``train_step`` with donated state.
 
     RoPE tables are closed over as constants (computed once, not per step —
@@ -359,7 +376,8 @@ def make_train_step(cfg: RuntimeConfig, mesh=None, state_sharding=None,
                else contextlib.nullcontext())
         with ctx:
             return train_step(cfg, state, batch, base_rng, rope=rope,
-                              mesh=mesh, loss_fn=loss_fn)
+                              mesh=mesh, loss_fn=loss_fn,
+                              pipeline_loss_fn=pipeline_loss_fn)
 
     kwargs = {}
     if state_sharding is not None:

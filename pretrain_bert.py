@@ -43,6 +43,9 @@ def get_args(argv=None):
     p.add_argument("--log_interval", type=int, default=10)
     p.add_argument("--data_parallel", type=int, default=1)
     p.add_argument("--tensor_parallel", type=int, default=1)
+    p.add_argument("--pipeline_parallel", type=int, default=1,
+                   help="encoder pipeline over pp stages (reference "
+                        "trains BERT through the same 1F1B schedule)")
     p.add_argument("--use_distributed_optimizer", action="store_true",
                    help="ZeRO-1: shard optimizer state over dp")
     p.add_argument("--seed", type=int, default=1234)
@@ -69,10 +72,14 @@ def bert_runtime_config(args, vocab_size: int) -> RuntimeConfig:
         attention_dropout=0.1,
         seq_length=args.seq_length,
     )
+    accum = args.global_batch_size // (args.micro_batch_size
+                                       * args.data_parallel)
     return RuntimeConfig(
         model=model,
         parallel=ParallelConfig(data_parallel=args.data_parallel,
                                 tensor_parallel=args.tensor_parallel,
+                                pipeline_parallel=args.pipeline_parallel,
+                                num_microbatches=accum,
                                 use_distributed_optimizer=
                                 args.use_distributed_optimizer),
         optimizer=OptimizerConfig(lr=args.lr, clip_grad=1.0),
@@ -117,7 +124,15 @@ def main(argv=None):
     specs = (encdec.bert_param_specs(cfg.model, cfg.parallel)
              if (args.tensor_parallel > 1
                  or args.use_distributed_optimizer) else None)
-    return pretrain_custom(cfg, ds, params, bert_loss_fn, param_specs=specs)
+    pipeline_loss_fn = None
+    if args.pipeline_parallel > 1:
+        from megatron_llm_tpu.parallel import pipeline_encdec as pe
+
+        params = pe.bert_to_pipeline_params(params, cfg.parallel)
+        specs = pe.bert_pipeline_param_specs(cfg.model, cfg.parallel)
+        pipeline_loss_fn = pe.bert_pipeline_loss
+    return pretrain_custom(cfg, ds, params, bert_loss_fn, param_specs=specs,
+                           pipeline_loss_fn=pipeline_loss_fn)
 
 
 if __name__ == "__main__":

@@ -47,6 +47,11 @@ def get_args(argv=None):
     p.add_argument("--log_interval", type=int, default=10)
     p.add_argument("--data_parallel", type=int, default=1)
     p.add_argument("--tensor_parallel", type=int, default=1)
+    p.add_argument("--pipeline_parallel", type=int, default=1,
+                   help="encoder/decoder split-rank pipeline (reference: "
+                        "pipeline_model_parallel_split_rank)")
+    p.add_argument("--pipeline_split_rank", type=int, default=None,
+                   help="stages holding the encoder (default pp // 2)")
     p.add_argument("--use_distributed_optimizer", action="store_true",
                    help="ZeRO-1: shard optimizer state over dp")
     p.add_argument("--seed", type=int, default=1234)
@@ -72,10 +77,15 @@ def t5_runtime_config(args) -> RuntimeConfig:
         tie_embed_logits=True,
         seq_length=args.encoder_seq_length,
     )
+    accum = args.global_batch_size // (args.micro_batch_size
+                                       * args.data_parallel)
     return RuntimeConfig(
         model=model,
         parallel=ParallelConfig(data_parallel=args.data_parallel,
                                 tensor_parallel=args.tensor_parallel,
+                                pipeline_parallel=args.pipeline_parallel,
+                                pipeline_split_rank=args.pipeline_split_rank,
+                                num_microbatches=accum,
                                 use_distributed_optimizer=
                                 args.use_distributed_optimizer),
         optimizer=OptimizerConfig(lr=args.lr, clip_grad=1.0),
@@ -128,7 +138,15 @@ def main(argv=None):
     specs = (encdec.t5_param_specs(cfg.model, cfg.parallel)
              if (args.tensor_parallel > 1
                  or args.use_distributed_optimizer) else None)
-    return pretrain_custom(cfg, ds, params, t5_loss_fn, param_specs=specs)
+    pipeline_loss_fn = None
+    if args.pipeline_parallel > 1:
+        from megatron_llm_tpu.parallel import pipeline_encdec as pe
+
+        params = pe.t5_to_pipeline_params(params, cfg.parallel)
+        specs = pe.t5_pipeline_param_specs(cfg.model, cfg.parallel)
+        pipeline_loss_fn = pe.t5_pipeline_loss
+    return pretrain_custom(cfg, ds, params, t5_loss_fn, param_specs=specs,
+                           pipeline_loss_fn=pipeline_loss_fn)
 
 
 if __name__ == "__main__":
