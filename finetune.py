@@ -67,6 +67,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    choices=["flash", "dot"])
     g.add_argument("--recompute", default="selective",
                    choices=["none", "selective", "full"])
+    g.add_argument("--quantize_matmuls", default="none",
+                   choices=["none", "int8"],
+                   help="W8A8 projection matmuls on the int8 MXU with "
+                        "straight-through backward (the TE-FP8 analogue, "
+                        "ref transformer.py:932-951)")
     g.add_argument("--hidden_dropout", type=float, default=None,
                    help="residual dropout rate (default: model preset)")
     g.add_argument("--lima_dropout", action="store_true",
@@ -186,6 +191,7 @@ def build_config(args):
         params_dtype=args.params_dtype,
         attention_impl=args.attention_impl,
         recompute=args.recompute,
+        quantize_matmuls=args.quantize_matmuls,
     )
     if args.seq_length:
         overrides["seq_length"] = args.seq_length

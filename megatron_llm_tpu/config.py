@@ -138,6 +138,23 @@ class ModelConfig:
     # math XLA fuses into neighbors; the default — XLA's fusion is already
     # near-bandwidth-bound for norms).
     norm_impl: str = "xla"
+    # Quantized TRAINING matmuls: "none" (default) | "int8" — the layer
+    # projection matmuls (QKV/out, MLP up/gate/down) run W8A8 on the int8
+    # MXU (per-token activation scales x per-channel weight scales,
+    # dynamic); the backward evaluates the dense formulas on the
+    # dequantized int8 operands (TE semantics); master weights,
+    # embeddings, lm_head, norms and the attention einsum stay bf16/fp32.
+    # The TPU analogue of the reference's optional TransformerEngine FP8
+    # (megatron/model/transformer.py:932-951, off by default there too).
+    # Measured on v5e (2026-07-31): ~parity with bf16 at 7B-width
+    # (23.9k vs 23.6k tok/s, full remat — the cheaper replay matmuls
+    # offset the quantize overhead) but a net loss at 374M (0.477 vs
+    # 0.53 MFU); prefer it only where activation-memory pressure or
+    # future wider-matmul shapes favor the 2x int8 MXU peak.  Note the
+    # int8 dots escape the "selective" remat policy as int32 saveables —
+    # pair with recompute="full" at memory-tight shapes.
+    # ops/quant.py:int8_training_matmul.
+    quantize_matmuls: str = "none"
     # recompute: "none" | "selective" | "full"
     recompute: str = "selective"
     # When set (to a mesh axis name, canonically "cp"), attention runs the
@@ -229,6 +246,8 @@ class ModelConfig:
                 "num_experts > 0 is not supported")
         assert self.kv_cache_quant in ("none", "int8"), (
             f"unknown kv_cache_quant {self.kv_cache_quant!r}")
+        assert self.quantize_matmuls in ("none", "int8"), (
+            f"unknown quantize_matmuls {self.quantize_matmuls!r}")
         return self
 
 

@@ -24,7 +24,6 @@ import jax.numpy as jnp
 from ..config import ModelConfig
 from ..ops.attention import attention
 from ..ops.norms import norm_apply, norm_init
-from ..ops.quant import mm
 from ..parallel.cross_entropy import cross_entropy, masked_mean_loss
 from .transformer import (
     AttnSideInputs,
@@ -35,6 +34,7 @@ from .transformer import (
     init_stack_params,
     layer_forward,
     mlp_block,
+    proj,
 )
 
 
@@ -249,16 +249,16 @@ def cross_attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
     nq = cfg.num_attention_heads
     nkv = cfg.kv_heads
     se = enc_out.shape[1]
-    q = mm(x, p["wq"]).reshape(b, s, nq, d)
-    k = mm(enc_out, p["wk"]).reshape(b, se, nkv, d)
-    v = mm(enc_out, p["wv"]).reshape(b, se, nkv, d)
+    q = proj(cfg, x, p["wq"]).reshape(b, s, nq, d)
+    k = proj(cfg, enc_out, p["wk"]).reshape(b, se, nkv, d)
+    v = proj(cfg, enc_out, p["wv"]).reshape(b, se, nkv, d)
     bias = None
     if enc_pad_mask is not None:
         bias = jnp.where(enc_pad_mask[:, None, None, :] > 0, 0.0, -jnp.inf
                          ).astype(jnp.float32)
     ctx = attention(q, k, v, impl="dot", causal=False, bias=bias,
                     softmax_scale=1.0 / (d ** 0.5))
-    return mm(ctx.reshape(b, s, nq * d), p["wo"])
+    return proj(cfg, ctx.reshape(b, s, nq * d), p["wo"])
 
 
 def t5_decoder_forward(cfg: ModelConfig, stacked: Params, cross: Params,

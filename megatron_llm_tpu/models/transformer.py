@@ -34,10 +34,19 @@ from ..config import ModelConfig, PositionEmbeddingType
 from ..ops.activations import get_activation, is_glu
 from ..ops.attention import attention
 from ..ops.norms import norm_apply, norm_init
-from ..ops.quant import mm
+from ..ops.quant import int8_training_matmul, is_quantized, mm
 from ..ops.rope import apply_rope, precompute_rope_freqs
 
 Params = dict
+
+
+def proj(cfg, x, w):
+    """Projection matmul dispatch: serving-quantized weights → dequantizing
+    ``mm``; ``quantize_matmuls="int8"`` training → W8A8 on the int8 MXU
+    with straight-through backward (ops/quant.py); else plain ``@``."""
+    if cfg.quantize_matmuls == "int8" and not is_quantized(w):
+        return int8_training_matmul(x, w)
+    return mm(x, w)
 
 
 # ---------------------------------------------------------------------------
@@ -220,9 +229,9 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
     nq = cfg.num_attention_heads
     nkv = cfg.kv_heads
 
-    q = mm(x, p["wq"])
-    k = mm(x, p["wk"])
-    v = mm(x, p["wv"])
+    q = proj(cfg, x, p["wq"])
+    k = proj(cfg, x, p["wk"])
+    v = proj(cfg, x, p["wv"])
     if "bq" in p:
         q = q + p["bq"]
         k = k + p["bk"]
@@ -280,7 +289,7 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
             block_q=cfg.flash_block_q,
             block_k=cfg.flash_block_k,
         )
-    out = mm(ctx.reshape(b, s, nq * d), p["wo"])
+    out = proj(cfg, ctx.reshape(b, s, nq * d), p["wo"])
     if "bo" in p:
         out = out + p["bo"]
     if kv_cache is not None:
@@ -298,8 +307,8 @@ def mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
     tensor sharding never slices across the gate/up boundary."""
     act = get_activation(cfg.activation)
     if is_glu(cfg.activation):
-        gate = mm(x, p["w_gate"])
-        up = mm(x, p["w_up"])
+        gate = proj(cfg, x, p["w_gate"])
+        up = proj(cfg, x, p["w_up"])
         if "b_gate" in p:
             gate = gate + p["b_gate"]
             up = up + p["b_up"]
@@ -308,11 +317,11 @@ def mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
         hidden = jnp.concatenate([gate, up], axis=-1)
         hidden = act(hidden)
     else:
-        hidden = mm(x, p["w_up"])
+        hidden = proj(cfg, x, p["w_up"])
         if "b_up" in p:
             hidden = hidden + p["b_up"]
         hidden = act(hidden)
-    out = mm(hidden, p["w_down"])
+    out = proj(cfg, hidden, p["w_down"])
     if "b_down" in p:
         out = out + p["b_down"]
     return out

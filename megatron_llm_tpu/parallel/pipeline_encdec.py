@@ -172,9 +172,12 @@ def bert_to_pipeline_params(params: PyTree,
                             parallel: ParallelConfig) -> PyTree:
     """``init_bert_params`` layout → [pp, lpc, ...] staged layers."""
     pp = parallel.pipeline_parallel
+    n = jax.tree.leaves(params["layers"])[0].shape[0]
+    assert n % pp == 0, (
+        f"num_layers {n} must divide over pipeline_parallel {pp} stages")
     out = dict(params)
     out["layers"] = jax.tree.map(
-        lambda x: x.reshape(pp, x.shape[0] // pp, *x.shape[1:]),
+        lambda x: x.reshape(pp, n // pp, *x.shape[1:]),
         params["layers"])
     return out
 

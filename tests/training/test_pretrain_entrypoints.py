@@ -193,3 +193,50 @@ def test_pretrain_bert_entrypoint_pipeline(corpus, tmp_path):
     wq = state.params["layers"]["attn"]["wq"]
     spec = str(wq.sharding.spec)
     assert "pp" in spec and "tp" in spec
+
+
+def test_pretrain_custom_pipelined_eval_path(corpus):
+    """The pipelined validation branch of pretrain_custom (eval_jit built
+    from pipeline_loss_fn on a [1, micro_total, ...] microbatch group)
+    must actually run — entry-point defaults never reach it (eval_interval
+    1000 vs 3 iters)."""
+    import jax
+
+    from megatron_llm_tpu.config import (
+        ModelConfig, OptimizerConfig, ParallelConfig, RuntimeConfig,
+        TrainConfig,
+    )
+    from megatron_llm_tpu.data.bert_dataset import (
+        BertDataset, BertSpecialTokens,
+    )
+    from megatron_llm_tpu.data.indexed_dataset import MMapIndexedDataset
+    from megatron_llm_tpu.models import encdec
+    from megatron_llm_tpu.parallel import pipeline_encdec as pe
+    from megatron_llm_tpu.training.driver import pretrain_custom
+
+    model = ModelConfig(
+        vocab_size=96, hidden_size=32, num_layers=2,
+        num_attention_heads=4, num_kv_heads=4, ffn_hidden_size=64,
+        max_position_embeddings=48, norm_type="layernorm",
+        activation="gelu", position_embedding_type="absolute",
+        use_bias=True, tie_embed_logits=True, tokentype_size=2,
+        seq_length=48,
+    ).validate()
+    parallel = ParallelConfig(pipeline_parallel=2,
+                              num_microbatches=2).validate()
+    cfg = RuntimeConfig(
+        model=model, parallel=parallel,
+        optimizer=OptimizerConfig(lr=1e-4, clip_grad=1.0),
+        train=TrainConfig(train_iters=2, micro_batch_size=1,
+                          global_batch_size=2, seq_length=48,
+                          eval_interval=1, eval_iters=1, log_interval=1),
+    ).validate()
+    special = BertSpecialTokens(cls=92, sep=93, mask=94, pad=0)
+    ds = BertDataset(MMapIndexedDataset(corpus), 48, 96, special, seed=0)
+    params = pe.bert_to_pipeline_params(
+        encdec.init_bert_params(jax.random.key(0), model), parallel)
+    specs = pe.bert_pipeline_param_specs(model, parallel)
+    state = pretrain_custom(cfg, ds, params, None, valid_dataset=ds,
+                            param_specs=specs,
+                            pipeline_loss_fn=pe.bert_pipeline_loss)
+    assert int(state.iteration) == 2
