@@ -1,0 +1,24 @@
+#!/bin/bash
+# T5 pretraining through the encoder/decoder SPLIT-RANK pipeline
+# (reference: pretrain_t5.py + pipeline_model_parallel_split_rank,
+# megatron/core/parallel_state.py:110-112) — stages [0, split) hold the
+# encoder stack, [split, pp) the decoder; the encoder output rides the
+# ppermute ring into every decoder stage's cross-attention
+# (parallel/pipeline_encdec.py, docs/parallelism.md).
+#
+# Mesh: dp2 x pp4 (split 2) on 8 chips; ZeRO-1 shards optimizer state
+# over dp.  global_batch / (micro_batch * dp) becomes both the grad-accum
+# count and the pipeline's microbatch count.
+set -euo pipefail
+
+python pretrain_t5.py \
+    --data_path "${CORPUS:-data/t5_corpus}" \
+    --tokenizer_model "${TOKENIZER:-t5-base}" \
+    --hidden_size 1024 --num_layers 24 --num_decoder_layers 24 \
+    --num_attention_heads 16 \
+    --encoder_seq_length 512 --decoder_seq_length 128 \
+    --micro_batch_size 2 --global_batch_size 64 \
+    --data_parallel 2 --pipeline_parallel 4 --pipeline_split_rank 2 \
+    --use_distributed_optimizer \
+    --train_iters 100000 --lr 1e-4 \
+    --save "${SAVE:-ckpts/t5-large}" --save_interval 2000
