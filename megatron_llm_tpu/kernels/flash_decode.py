@@ -210,7 +210,10 @@ def flash_decode_int8(
     cache_len: jax.Array,
     *,
     softmax_scale: float | None = None,
-    block_k: int = 512,
+    # 1024 (vs the bf16 kernel's 512): int8 blocks are half the bytes, and
+    # the larger tile measured ~7% faster at max_len=1024 on v5e; the
+    # harness divides down for shorter caches.
+    block_k: int = 1024,
     interpret: bool | None = None,
 ) -> jax.Array:
     """→ [b, n_heads, d] decode attention over an int8 KV cache
