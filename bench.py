@@ -205,7 +205,14 @@ def _decode_point(hbm_bw: float, quantize: bool = False):
     from megatron_llm_tpu.models import model as model_lib
     from megatron_llm_tpu.generation.generation import generate_tokens
 
-    b, prompt_len, gen_len = 8, 128, 128
+    # gen_len 512 (not 128): the decode rate comes from subtracting a
+    # separately-timed prefill from the full-generate window, and with a
+    # short horizon the two terms are comparable — tunnel timing jitter
+    # on the prefill term then swings the decode estimate by ±40%
+    # (observed 2.6k-4.9k tok/s across clean runs at gen 128).  At 512
+    # steps the prefill correction is a few percent of the window, so its
+    # jitter moves the decode number by ~1%.
+    b, prompt_len, gen_len = 8, 128, 512
     # The kv-cache path has its own dispatcher (ops/attention.py:
     # decode_attention): Pallas decode kernel on TPU, einsum fallback —
     # cfg.attention_impl only affects the prefill, where flash is right.
@@ -227,13 +234,25 @@ def _decode_point(hbm_bw: float, quantize: bool = False):
     tokens = jnp.asarray(tokens)
     lengths = jnp.full((b,), prompt_len, jnp.int32)
 
+    def _min_time(run, n=3):
+        """Best-of-n wall time: tunnel latency drifts wildly between runs
+        (the same decode program measured 3.3k-4.9k tok/s across clean
+        full-bench runs), and the dt_full - dt_prefill subtraction below
+        AMPLIFIES single-shot jitter (a high prefill sample inflates
+        decode tps and vice versa) — minimums of repeated samples keep
+        the official record off the noise tails for ~20s of wall-clock."""
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            jax.device_get(run())
+            best = min(best, time.perf_counter() - t0)
+        return best
+
     out = generate_tokens(cfg, params, tokens, lengths,
                           use_eos_stop=False)  # warmup/compile
     jax.device_get(out.tokens)
-    t0 = time.perf_counter()
-    out = generate_tokens(cfg, params, tokens, lengths, use_eos_stop=False)
-    jax.device_get(out.tokens)
-    dt_full = time.perf_counter() - t0
+    dt_full = _min_time(lambda: generate_tokens(
+        cfg, params, tokens, lengths, use_eos_stop=False).tokens)
 
     # The roofline models per-step decode streaming only, so subtract the
     # prefill forward (the same [b, prompt_len] cached forward the generate
@@ -250,9 +269,7 @@ def _decode_point(hbm_bw: float, quantize: bool = False):
         return logits[:, -1]
 
     jax.device_get(prefill(params, tokens[:, :prompt_len]))  # compile
-    t0 = time.perf_counter()
-    jax.device_get(prefill(params, tokens[:, :prompt_len]))
-    dt_prefill = time.perf_counter() - t0
+    dt_prefill = _min_time(lambda: prefill(params, tokens[:, :prompt_len]))
 
     dt = max(dt_full - dt_prefill, 1e-9)
     tps = b * gen_len / dt

@@ -235,8 +235,10 @@ def test_int8_decode_speedup_and_parity():
     # throughput: int8 must not CATASTROPHICALLY regress — e.g. the kernel
     # silently falling back to a several-x-slower path.  Best-of-3 through
     # the tunnel still jitters ~10-15% (bf16 itself measured 1.7k-3.3k
-    # tok/s across clean runs), so the gate is deliberately coarse; the
-    # measured clean-run ratio is 1.2-1.3x (BENCH_SELF_r04.json).
+    # tok/s across clean runs), so the gate is deliberately coarse;
+    # clean-run ratios span ~1.0x at this 256-token horizon to 1.7-1.8x at
+    # the bench's 512-token horizon where cache reads matter more
+    # (BENCH_SELF_r04.json).
     assert tps_int8 >= 0.85 * tps_bf16, (tps_bf16, tps_int8)
 
     # fidelity: compare the Pallas int8 decode KERNEL against the einsum
