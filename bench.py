@@ -16,6 +16,16 @@ regime, exercising the Pallas flash kernel fwd+bwd) and a KV-cache decode
 throughput row.  Sweep provenance (v5e, 2026-07): head_dim 128 beats 64 by
 +24% MFU (MXU lane width); mb=12 beats 8/16 by ~1%; the fused LM head and
 block_q/k ∈ {512, 2048} variants measured slower — defaults kept.
+Decode negative results (v5e, 2026-07-31, don't re-chase): per-step decode
+time is flat in cache max_len (no hidden O(max_len) copies) and scales with
+LAYER COUNT at fixed weight bytes (6-layer/h2048 is 25% faster per step
+than 24-layer/h1024 with MORE bytes) — the bound is the sequential per-op
+chain, ~100us/layer vs a 38us/layer weight-read floor.  Fusing sibling
+GEMVs (wqkv, gate|up concat) measured 1.01x: XLA's scheduler already
+overlaps independent siblings, and the wider bf16 matmul perturbs logits
+(different accumulation tiling, max|dlogit| 0.057).  Closing the gap needs
+shorter sequential chains (per-layer Pallas megakernels or speculative
+multi-token steps), not op-count reduction.
 """
 
 from __future__ import annotations
