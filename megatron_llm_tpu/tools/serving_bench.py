@@ -27,7 +27,8 @@ import time
 def run(model: str, size: str, tp: int, pp: int, batch: int,
         prompt_len: int, gen_len: int, params_dtype: str,
         quantize: str | None = None,
-        kv_quant: str | None = None) -> dict:
+        kv_quant: str | None = None,
+        speculative: str | None = None) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -67,18 +68,35 @@ def run(model: str, size: str, tp: int, pp: int, batch: int,
     tokens = jnp.asarray(tokens)
     lengths = jnp.full((batch,), prompt_len, jnp.int32)
 
+    if speculative == "pld":
+        from ..generation.speculative import generate_tokens_pld
+
+        def gen():
+            return generate_tokens_pld(cfg, params, tokens, lengths,
+                                       use_eos_stop=False)
+    else:
+        def gen():
+            return generate_tokens(cfg, params, tokens, lengths,
+                                   use_eos_stop=False)
+
     with mesh_lib.use_mesh(mesh):
-        out = generate_tokens(cfg, params, tokens, lengths,
-                              use_eos_stop=False)  # warmup/compile
+        out = gen()  # warmup/compile
         jax.device_get(out.tokens)
         t0 = time.perf_counter()
-        out = generate_tokens(cfg, params, tokens, lengths,
-                              use_eos_stop=False)
+        out = gen()
         jax.device_get(out.tokens)
         dt = time.perf_counter() - t0
 
+    extra = {}
+    if speculative == "pld":
+        # verify forwards per generated token (the speedup mechanism)
+        extra["spec_steps"] = int(out.steps)
+        extra["spec_tokens_per_step"] = round(gen_len / max(int(out.steps),
+                                                            1), 2)
+
     return {
         "decode_tokens_per_sec": round(batch * gen_len / dt, 1),
+        **extra,
         "mesh": dict(mesh.shape),
         "model": name,
         "batch": batch,
@@ -87,6 +105,7 @@ def run(model: str, size: str, tp: int, pp: int, batch: int,
         "device": jax.devices()[0].device_kind,
         "quantize": quantize,
         "kv_quant": kv_quant,
+        "speculative": speculative,
     }
 
 
@@ -103,10 +122,13 @@ def main(argv=None) -> int:
                     choices=["float32", "bfloat16", "float16"])
     ap.add_argument("--quantize", default=None, choices=["int8"])
     ap.add_argument("--kv_quant", default=None, choices=["int8"])
+    ap.add_argument("--speculative", default=None, choices=["pld"],
+                    help="prompt-lookup speculative decoding (greedy; "
+                         "generation/speculative.py)")
     args = ap.parse_args(argv)
     rec = run(args.model, args.size, args.tp, args.pp, args.batch,
               args.prompt, args.gen, args.params_dtype, args.quantize,
-              args.kv_quant)
+              args.kv_quant, args.speculative)
     print(json.dumps(rec))
     return 0
 

@@ -1,0 +1,127 @@
+"""Prompt-lookup speculative decoding: greedy-exactness and acceptance.
+
+The committed stream must be a greedy trajectory of the model — on the
+CPU fp32 path it is bitwise-equal to ``generate_tokens``'s greedy output
+(both paths run the same cached forward math)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.generation.generation import generate_tokens
+from megatron_llm_tpu.generation.speculative import generate_tokens_pld
+from megatron_llm_tpu.models import model as model_lib
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config(params_dtype="float32", seq_length=128,
+                      max_position_embeddings=128)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _prompts(cfg, b, prompt_len, total, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = np.zeros((b, total), np.int32)
+    toks[:, :prompt_len] = rng.integers(3, cfg.vocab_size, (b, prompt_len))
+    return jnp.asarray(toks), jnp.full((b,), prompt_len, jnp.int32)
+
+
+@pytest.mark.parametrize("b,draft_len,ngram", [(1, 5, 3), (3, 4, 2),
+                                               (2, 7, 3)])
+def test_pld_matches_plain_greedy(setup, b, draft_len, ngram):
+    cfg, params = setup
+    tokens, lengths = _prompts(cfg, b, 16, 96)
+    plain = generate_tokens(cfg, params, tokens, lengths,
+                            use_eos_stop=False)
+    spec = generate_tokens_pld(cfg, params, tokens, lengths,
+                               draft_len=draft_len, ngram=ngram,
+                               use_eos_stop=False)
+    np.testing.assert_array_equal(np.asarray(spec.tokens),
+                                  np.asarray(plain.tokens))
+    np.testing.assert_array_equal(np.asarray(spec.lengths),
+                                  np.asarray(plain.lengths))
+    # the whole point: fewer verify forwards than generated tokens when
+    # anything repeats; never MORE than one forward per token (+1 for the
+    # final tail step the plain loop also pays)
+    generated = 96 - 16
+    assert int(spec.steps) <= generated + 1
+
+
+def test_pld_accelerates_repetitive_continuation(setup):
+    """A prompt whose greedy continuation is (near-)periodic must be
+    drafted successfully: steps << generated tokens."""
+    cfg, params = setup
+    # Build a prompt that the MODEL continues periodically: take any
+    # prompt, roll greedy forward 24 tokens, then use (prompt + the
+    # first 12 generated) repeated as the real prompt — the model tends
+    # to keep cycling on tiny random models; instead of relying on that,
+    # verify against the model's OWN plain greedy output and only assert
+    # the step count where the plain output itself repeats.
+    b, prompt_len, total = 1, 24, 120
+    rng = np.random.default_rng(7)
+    period = rng.integers(3, cfg.vocab_size, 6)
+    toks = np.zeros((b, total), np.int32)
+    toks[0, :prompt_len] = np.tile(period, prompt_len // 6 + 1)[:prompt_len]
+    tokens = jnp.asarray(toks)
+    lengths = jnp.full((b,), prompt_len, jnp.int32)
+    plain = generate_tokens(cfg, params, tokens, lengths,
+                            use_eos_stop=False)
+    spec = generate_tokens_pld(cfg, params, tokens, lengths, draft_len=6,
+                               ngram=3, use_eos_stop=False)
+    np.testing.assert_array_equal(np.asarray(spec.tokens),
+                                  np.asarray(plain.tokens))
+    out = np.asarray(plain.tokens)[0, prompt_len:]
+    # how periodic did the model's own continuation turn out?
+    repeats = (out[6:] == out[:-6]).mean()
+    generated = total - prompt_len
+    if repeats > 0.9:  # model cycles → PLD must have drafted it
+        assert int(spec.steps) < generated // 2, (
+            int(spec.steps), generated, repeats)
+
+
+def test_pld_eos_stop(setup):
+    """EOS inside an accepted window must terminate that sample at the
+    right length and freeze its buffer."""
+    cfg, params = setup
+    b, prompt_len, total = 2, 16, 80
+    tokens, lengths = _prompts(cfg, b, prompt_len, total, seed=3)
+    plain = generate_tokens(cfg, params, tokens, lengths, eos_id=2,
+                            use_eos_stop=True)
+    spec = generate_tokens_pld(cfg, params, tokens, lengths, eos_id=2,
+                               draft_len=4, ngram=2, use_eos_stop=True)
+    np.testing.assert_array_equal(np.asarray(spec.lengths),
+                                  np.asarray(plain.lengths))
+    for i in range(b):
+        L = int(plain.lengths[i])
+        np.testing.assert_array_equal(np.asarray(spec.tokens)[i, :L],
+                                      np.asarray(plain.tokens)[i, :L])
+
+
+def test_pld_rejects_ragged_prompts(setup):
+    cfg, params = setup
+    tokens, _ = _prompts(cfg, 2, 16, 64)
+    ragged = jnp.asarray([16, 20], jnp.int32)
+    with pytest.raises(ValueError, match="uniform prompt lengths"):
+        generate_tokens_pld(cfg, params, tokens, ragged)
+
+
+def test_pld_composes_with_int8_cache(setup):
+    """PLD's multi-token verify rows stream through the int8 KV cache
+    exactly like prefill rows do."""
+    import dataclasses
+
+    cfg, params = setup
+    qcfg = dataclasses.replace(cfg, kv_cache_quant="int8").validate()
+    tokens, lengths = _prompts(cfg, 2, 16, 64, seed=5)
+    out = generate_tokens_pld(qcfg, params, tokens, lengths, draft_len=4,
+                              ngram=2, use_eos_stop=False)
+    ref = generate_tokens(qcfg, params, tokens, lengths,
+                          use_eos_stop=False)
+    # int8 cache quantization noise is identical between the two paths on
+    # CPU fp32 compute, so the greedy streams still agree exactly
+    np.testing.assert_array_equal(np.asarray(out.tokens),
+                                  np.asarray(ref.tokens))
