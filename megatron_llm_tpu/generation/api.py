@@ -104,9 +104,15 @@ def generate_and_post_process(
     add_BOS: bool = False,
     use_eod_token_for_early_termination: bool = True,
     random_seed: int = -1,
+    speculative: Optional[str] = None,
 ) -> GenerationResult:
     """Run generation on text prompts and detokenize
-    (reference: api.py:19-67 / generate :70-144)."""
+    (reference: api.py:19-67 / generate :70-144).
+
+    ``speculative="pld"`` routes eligible requests (greedy sampling, no
+    log-probs, uniform prompt lengths) through prompt-lookup speculative
+    decoding (generation/speculative.py); ineligible requests silently
+    use the standard loop — the output contract is identical."""
     import jax
 
     tokens, lengths = tokenize_prompts(
@@ -117,12 +123,34 @@ def generate_and_post_process(
         # calls manual_seed when random_seed != -1, api.py:59-61).
         random_seed = int.from_bytes(os.urandom(4), "little")
     rng = jax.random.key(random_seed)
-    out = generate_tokens(
-        cfg, params, jnp.asarray(tokens), jnp.asarray(lengths),
-        eos_id=tokenizer.eod,
-        top_k=top_k_sampling, top_p=top_p_sampling, temperature=temperature,
-        rng=rng, return_logprobs=return_output_log_probs,
-        use_eos_stop=use_eod_token_for_early_termination)
+
+    def _pld_min_prompt():
+        from .speculative import DEFAULT_NGRAM
+
+        return DEFAULT_NGRAM
+
+    pld_ok = (
+        speculative == "pld"
+        and top_k_sampling == 0 and top_p_sampling == 0.0
+        and not return_output_log_probs
+        and len(set(int(l) for l in lengths)) == 1
+        and min(int(l) for l in lengths) >= _pld_min_prompt()
+    )
+    if pld_ok:
+        from .speculative import generate_tokens_pld
+
+        out = generate_tokens_pld(
+            cfg, params, jnp.asarray(tokens), jnp.asarray(lengths),
+            eos_id=tokenizer.eod,
+            use_eos_stop=use_eod_token_for_early_termination)
+    else:
+        out = generate_tokens(
+            cfg, params, jnp.asarray(tokens), jnp.asarray(lengths),
+            eos_id=tokenizer.eod,
+            top_k=top_k_sampling, top_p=top_p_sampling,
+            temperature=temperature,
+            rng=rng, return_logprobs=return_output_log_probs,
+            use_eos_stop=use_eod_token_for_early_termination)
     toks = np.asarray(out.tokens)
     lens = np.asarray(out.lengths)
     if return_segments:

@@ -32,12 +32,17 @@ class GenerationService:
     so it is directly unit-testable (and reusable from the CLI)."""
 
     def __init__(self, cfg: ModelConfig, params, tokenizer: Tokenizer,
-                 max_batch_size: int = 8, max_tokens_to_generate: int = 1024):
+                 max_batch_size: int = 8, max_tokens_to_generate: int = 1024,
+                 speculative: str | None = None):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
         self.max_batch_size = max_batch_size
         self.max_tokens_to_generate = max_tokens_to_generate
+        # "pld": greedy requests with uniform prompt lengths run
+        # prompt-lookup speculative decoding (generation/speculative.py);
+        # everything else silently uses the standard loop.
+        self.speculative = speculative
         self.lock = threading.Lock()  # one generation at a time (ref :21)
 
     def handle(self, body: dict) -> tuple[int, dict | str]:
@@ -147,7 +152,8 @@ class GenerationService:
                     top_k_sampling=top_k, top_p_sampling=top_p,
                     temperature=temperature, add_BOS=add_BOS,
                     use_eod_token_for_early_termination=not no_early_term,
-                    random_seed=random_seed)
+                    random_seed=random_seed,
+                    speculative=self.speculative)
                 return 200, {"text": res.texts,
                              "segments": res.segments,
                              "logprobs": res.logprobs}

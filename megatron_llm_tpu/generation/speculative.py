@@ -39,6 +39,17 @@ import jax.numpy as jnp
 from ..config import ModelConfig
 from ..models import model as model_lib
 
+# shared with api.py's eligibility check so the two can't drift
+DEFAULT_DRAFT_LEN = 5
+DEFAULT_NGRAM = 3
+
+
+def _greedy_ids(logits, vocab: int):
+    """argmax over the REAL vocabulary — model logits cover the padded
+    vocab (config.padded_vocab_size), and untrained pad columns must never
+    win (sample_with_mode masks them the same way in the plain loop)."""
+    return jnp.argmax(logits[..., :vocab], axis=-1).astype(jnp.int32)
+
 
 @dataclasses.dataclass(frozen=True)
 class SpeculativeOutput:
@@ -95,6 +106,7 @@ def _pld_impl(cfg: ModelConfig, params, tokens, *, prompt_len: int,
               eos_id: int, draft_len: int, ngram: int, use_eos_stop: bool):
     b, max_seq = tokens.shape
     k = draft_len
+    vocab = cfg.vocab_size
     rope = model_lib.rope_tables(cfg)
     k_cache, v_cache = model_lib.init_kv_cache(cfg, b, max_seq)
 
@@ -114,13 +126,13 @@ def _pld_impl(cfg: ModelConfig, params, tokens, *, prompt_len: int,
     def spec_body(carry):
         (cur, tokens, k_cache, v_cache, last_logits, done, out_lengths,
          steps) = carry
-        t0 = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        t0 = _greedy_ids(last_logits, vocab)
         draft = _ngram_draft(tokens, cur, t0, ngram=ngram, draft_len=k)
         window = jnp.concatenate([t0[:, None], draft], axis=1)  # [b, k+1]
 
         logits, k_cache, v_cache = model_lib.forward_cached(
             cfg, params, window, k_cache, v_cache, cur, rope=rope)
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b, k+1]
+        greedy = _greedy_ids(logits, vocab)  # [b, k+1]
 
         # draft[:, i] is accepted iff it equals the model's greedy token
         # after the prefix ending at draft[:, i-1] — cumulative agreement.
@@ -175,7 +187,7 @@ def _pld_impl(cfg: ModelConfig, params, tokens, *, prompt_len: int,
     def tail_body(carry):
         (cur, tokens, k_cache, v_cache, last_logits, done, out_lengths,
          steps) = carry
-        t0 = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+        t0 = _greedy_ids(last_logits, vocab)
         old = jax.lax.dynamic_slice(tokens, (0, cur), (b, 1))
         tokens = jax.lax.dynamic_update_slice(
             tokens, jnp.where(done[:, None], old, t0[:, None]), (0, cur))
@@ -200,8 +212,8 @@ def generate_tokens_pld(
     lengths: jax.Array,  # [b] prompt lengths (must be uniform)
     *,
     eos_id: int = 2,
-    draft_len: int = 5,
-    ngram: int = 3,
+    draft_len: int = DEFAULT_DRAFT_LEN,
+    ngram: int = DEFAULT_NGRAM,
     use_eos_stop: bool = True,
 ) -> SpeculativeOutput:
     """Greedy generation with prompt-lookup speculative decoding.

@@ -31,6 +31,10 @@ def main(argv=None) -> int:
     ap.add_argument("--kv_quant", default=None, choices=["int8"],
                     help="int8 KV cache (halves decode cache traffic; "
                          "ops/kv_quant.py)")
+    ap.add_argument("--speculative", default=None, choices=["pld"],
+                    help="prompt-lookup speculative decoding for greedy "
+                         "requests (multi-token decode steps; "
+                         "generation/speculative.py)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel shards for serving")
     ap.add_argument("--pp", type=int, default=1,
@@ -83,7 +87,8 @@ def main(argv=None) -> int:
     server = MegatronServer(
         lm.cfg, params, tokenizer,
         max_batch_size=args.max_batch_size,
-        max_tokens_to_generate=args.max_tokens_to_generate)
+        max_tokens_to_generate=args.max_tokens_to_generate,
+        speculative=args.speculative)
     print(f"serving on {args.host}:{args.port}")
     if mesh_ctx is not None:
         with mesh_ctx:

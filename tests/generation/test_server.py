@@ -109,3 +109,37 @@ def test_service_direct_multibatch():
                               "random_seed": 7})
     assert status == 200
     assert len(out["text"]) == 2
+
+
+def test_service_speculative_greedy_matches_plain():
+    """speculative="pld" must change only the wall-clock, not the output:
+    a greedy uniform-prompt request returns the same text as the plain
+    service, and non-greedy / ragged requests silently fall back."""
+    cfg = tiny_config(num_layers=1, vocab_size=256,
+                      make_vocab_size_divisible_by=8)
+    params = model_lib.init_params(jax.random.key(2), cfg)
+    tok = NullTokenizer(vocab_size=cfg.vocab_size)
+    plain = GenerationService(cfg, params, tok)
+    spec = GenerationService(cfg, params, tok, speculative="pld")
+
+    body = {"prompts": ["7 8 9 10", "11 12 13 14"],
+            "tokens_to_generate": 8}  # greedy (no top_k/p), uniform len
+    s1, o1 = plain.handle(dict(body))
+    s2, o2 = spec.handle(dict(body))
+    assert s1 == s2 == 200
+    assert o1["text"] == o2["text"]
+
+    # sampling request: must fall back to the standard loop (seeded →
+    # identical between the two services)
+    body = {"prompts": ["7 8 9 10"], "tokens_to_generate": 4,
+            "top_k": 4, "random_seed": 3}
+    s1, o1 = plain.handle(dict(body))
+    s2, o2 = spec.handle(dict(body))
+    assert s1 == s2 == 200
+    assert o1["text"] == o2["text"]
+
+    # ragged prompts: eligibility check falls back, no error
+    body = {"prompts": ["7 8 9", "10 11 12 13 14"],
+            "tokens_to_generate": 4}
+    s2, o2 = spec.handle(dict(body))
+    assert s2 == 200 and len(o2["text"]) == 2

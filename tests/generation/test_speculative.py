@@ -125,3 +125,23 @@ def test_pld_composes_with_int8_cache(setup):
     # CPU fp32 compute, so the greedy streams still agree exactly
     np.testing.assert_array_equal(np.asarray(out.tokens),
                                   np.asarray(ref.tokens))
+
+
+def test_pld_never_emits_padded_vocab_ids():
+    """Logits cover the PADDED vocab; argmax must be restricted to real
+    token ids exactly like the plain loop's sample_with_mode masking —
+    an untrained pad column winning argmax would emit an id the tokenizer
+    cannot decode."""
+    cfg = tiny_config(params_dtype="float32", vocab_size=250,
+                      make_vocab_size_divisible_by=64,  # pads 250 → 256
+                      seq_length=96, max_position_embeddings=96)
+    assert cfg.padded_vocab_size() > cfg.vocab_size
+    params = model_lib.init_params(jax.random.key(4), cfg)
+    tokens, lengths = _prompts(cfg, 2, 16, 96, seed=9)
+    spec = generate_tokens_pld(cfg, params, tokens, lengths,
+                               use_eos_stop=False)
+    assert int(jnp.max(spec.tokens)) < cfg.vocab_size
+    plain = generate_tokens(cfg, params, tokens, lengths,
+                            use_eos_stop=False)
+    np.testing.assert_array_equal(np.asarray(spec.tokens),
+                                  np.asarray(plain.tokens))
