@@ -4,12 +4,15 @@ set -euo pipefail
 # Multi-chip serving: --tp N shards tensors; --pp M from a tp x pp
 # training topology JOINS tp for serving (weights resident, tp*pp-way).
 # --quantize int8 halves decode HBM traffic (weight-only, per-channel).
+# SERVE_SPEC=pld turns on prompt-lookup speculative decoding for greedy
+# requests (multi-token decode steps; docs/inference.md).
 python -m megatron_llm_tpu.tools.run_text_generation_server \
     --load "${1:-ckpts/run1}" \
     --tokenizer_type sentencepiece --tokenizer_model "${2:-tokenizer.model}" \
     ${SERVE_TP:+--tp "$SERVE_TP"} ${SERVE_PP:+--pp "$SERVE_PP"} \
     ${SERVE_QUANT:+--quantize "$SERVE_QUANT"} \
     ${SERVE_KV_QUANT:+--kv_quant "$SERVE_KV_QUANT"} \
+    ${SERVE_SPEC:+--speculative "$SERVE_SPEC"} \
     --port 5000 &
 sleep 10
 curl -X PUT localhost:5000/api -H 'Content-Type: application/json' \
