@@ -99,6 +99,7 @@ def pytest_configure(config):
 # see above).  Centralized here instead of per-file markers so the list
 # mirrors `--durations` output directly.
 _SLOW_TESTS = {
+    "test_int8_training_composes_with_pipeline",
     "test_two_process_dryrun",
     "test_train_step_with_context_parallelism",
     "test_train_step_with_zigzag_layout",
