@@ -138,6 +138,14 @@ class ModelConfig:
     # math XLA fuses into neighbors; the default — XLA's fusion is already
     # near-bandwidth-bound for norms).
     norm_impl: str = "xla"
+    # Fused single-token decode: run the whole layer stack as ONE Pallas
+    # kernel per decode step (kernels/decode_step.py) when eligible —
+    # dense RMSNorm+GLU rotary layers, bf16 cache, no mesh.  Small-batch
+    # decode is otherwise bound by the sequential per-op chain (~100 µs/
+    # layer/step vs a ~38 µs/layer weight-read floor on v5e); the fused
+    # step streams weights+cache through VMEM once and removes the chain.
+    # False forces the composed stack_forward_cached path everywhere.
+    fused_decode: bool = True
     # Quantized TRAINING matmuls: "none" (default) | "int8" — the layer
     # projection matmuls (QKV/out, MLP up/gate/down) run W8A8 on the int8
     # MXU (per-token activation scales x per-channel weight scales,

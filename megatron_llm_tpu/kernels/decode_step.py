@@ -1,0 +1,455 @@
+"""Fused single-token decode step: the whole layer stack in ONE Pallas call.
+
+Why this kernel exists: small-batch decode on v5e is bound by the
+*sequential per-op chain*, not bytes — ~100 µs/layer/step against a
+~38 µs/layer weight-read floor, flat in KV-cache size, unchanged (as a
+roofline fraction) by int8 (bench.py docstring records the measurements
+and the dead ends: sibling-GEMV fusion bought 1.01x because XLA already
+overlaps independent matmuls).  The fix is to remove the chain: run the
+entire decode step — every layer's norm → qkv GEMVs → RoPE → decode
+attention → output projection → norm → MLP GEMVs — as a single Pallas
+kernel with grid ``(num_layers, cache_blocks)``.  The Pallas pipeline
+streams each layer's weights and KV-cache blocks HBM→VMEM exactly once,
+double-buffered against compute, while the residual stream lives in a
+VMEM scratch carried across grid steps.  One kernel launch per decode
+step puts the step on the HBM-bandwidth roofline instead of the
+op-dispatch latency wall.
+
+Scope (eligibility enforced by :func:`fused_decode_eligible`): dense
+pre-LN RMSNorm GLU decoder layers (the Llama family), rotary positions,
+no biases, bf16/f32 weights, unquantized bf16 cache, single new token,
+no active mesh, per-layer working set within the VMEM budget.
+Everything else — prefill, int8, meshes, BERT/T5, 7B-width layers —
+keeps the composed path (models/transformer.py:stack_forward_cached).
+The reference's serving loop runs one token per python-level
+ForwardStep through the whole module tree
+(megatron/text_generation/forward_step.py:44-213); this is the
+TPU-first answer to the same loop.
+
+Design notes:
+- RoPE at a fixed position is a linear map, so the host passes a tiny
+  ``[d, d]`` block-rotation matrix and the kernel applies it with one
+  MXU dot per head — no strided lane shuffles inside the kernel (the
+  interleaved-pair convention of ops/rope.py is baked into the matrix).
+- The new token's K/V never round-trip through HBM: they are computed
+  in-kernel, appended to the online-softmax state directly, and emitted
+  as ``[L, b, kv, d]`` outputs the caller writes into the cache with the
+  usual row-sized dynamic_update_slice (ops/kv_quant.py:cache_update).
+- KV blocks past the cache fill level are never fetched: the cache
+  BlockSpec index map clamps the block index at the fill level (the
+  scalar-prefetch argument), so a short cache in a long buffer costs
+  only its own bytes; the compute for clamped blocks is masked out.
+- Attention over a cache block is vectorized over every (batch, kv)
+  pair at once — broadcast-multiply-reduce on ``(b, kv, block_k, d)``
+  arrays (a GEMV batch does not map onto a single MXU dot, and a
+  measured ``fori_loop``-over-pairs variant with per-pair 2-D tiles ran
+  at ~230 µs/layer: 64 sequential iterations of skinny ``(block_k, 1)``
+  VPU ops are issue-latency-bound).  Mosaic unrolls the two leading
+  dims, which is exactly the wide straight-line vector code the VPU
+  wants here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _phases() -> frozenset:
+    """Debug escape hatch: DECODE_STEP_PHASES=project,attn,finish (any
+    subset; default all) strips kernel phases so per-phase cost can be
+    attributed on hardware.  Timing-only — outputs are garbage when any
+    phase is off."""
+    import os
+
+    raw = os.environ.get("DECODE_STEP_PHASES")
+    if raw is None:
+        return frozenset(("project", "attn", "finish"))
+    return frozenset(p for p in raw.split(",") if p)
+
+
+# elementwise gate activation of each GLU family member
+# (ops/activations.py composes them over concatenated halves; here gate
+# and up are separate operands so the base function applies to the gate)
+_GLU_BASE = {
+    "swiglu": jax.nn.silu,
+    "geglu": functools.partial(jax.nn.gelu, approximate=True),
+    "reglu": jax.nn.relu,
+    "liglu": lambda x: x,
+}
+
+
+def _decode_step_kernel(nk: int, nm: int, block_k: int, b: int, nq: int,
+                        nkv: int, g: int, d: int, eps: float, scale: float,
+                        act,
+                        lens_ref,
+                        x_ref, rot_ref, in_nw_ref, post_nw_ref,
+                        wq_ref, wk_ref, wv_ref, wo_ref,
+                        wg_ref, wu_ref, wd_ref,
+                        kc_ref, vc_ref,
+                        xo_ref, kr_ref, vr_ref,
+                        x_scr, q_scr, kn_scr, vn_scr, ctx_scr, xn2_scr,
+                        m_scr, l_scr, acc_scr):
+    li = pl.program_id(0)
+    ki = pl.program_id(1)
+    n_layers = pl.num_programs(0)
+    pos = lens_ref[0]
+    f32 = jnp.float32
+
+    @pl.when(jnp.logical_and(li == 0, ki == 0))
+    def _first():
+        x_scr[...] = x_ref[...].astype(f32)
+        ctx_scr[...] = jnp.zeros(ctx_scr.shape, f32)
+
+    phases = _phases()
+
+    @pl.when(jnp.logical_and(ki == 0, "project" in phases))
+    def _project():
+        x = x_scr[...]                                   # (b_pad, h) f32
+        nw = in_nw_ref[0].astype(f32)                    # (1, h)
+        xn = x * jax.lax.rsqrt(
+            jnp.mean(x * x, axis=-1, keepdims=True) + eps) * nw
+        xnc = xn.astype(wq_ref.dtype)
+        rot = rot_ref[...]                               # (d, d) f32
+        dims = (((1,), (0,)), ((), ()))
+        q = jax.lax.dot_general(xnc, wq_ref[0], dims,
+                                preferred_element_type=f32)
+        k = jax.lax.dot_general(xnc, wk_ref[0], dims,
+                                preferred_element_type=f32)
+        v = jax.lax.dot_general(xnc, wv_ref[0], dims,
+                                preferred_element_type=f32)
+        for j in range(nkv):
+            kj = jax.lax.dot_general(k[:, j * d:(j + 1) * d], rot, dims,
+                                     preferred_element_type=f32)
+            vj = v[:, j * d:(j + 1) * d]
+            kr_ref[0, :, j, :] = kj[:b].astype(kr_ref.dtype)
+            vr_ref[0, :, j, :] = vj[:b].astype(vr_ref.dtype)
+            kn_scr[:, j, :] = kj[:b]
+            vn_scr[:, j, :] = vj[:b]
+        for hq in range(nq):
+            qh = jax.lax.dot_general(q[:, hq * d:(hq + 1) * d], rot, dims,
+                                     preferred_element_type=f32)
+            q_scr[hq % g, :, hq // g, :] = qh[:b]
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, f32)
+        l_scr[...] = jnp.zeros(l_scr.shape, f32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, f32)
+
+    # --- online-softmax accumulation over this cache block (every tick),
+    # vectorized over all (batch, kv) pairs.  Blocks past the fill level
+    # arrive clamped (stale data) and are fully masked: s = NEG_INF
+    # everywhere → p = 0, m/l/acc unchanged.
+    @pl.when(jnp.logical_and(ki < nk, "attn" in phases))
+    def _attend():
+        k4 = kc_ref[0].astype(f32)                       # (b, nkv, bk, d)
+        v4 = vc_ref[0].astype(f32)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_k), 2)
+        in_range = cols < pos                            # (1, 1, bk)
+        for gg in range(g):
+            qv = q_scr[gg]                               # (b, nkv, d) f32
+            s = jnp.sum(qv[:, :, None, :] * k4, axis=-1) * scale
+            s = jnp.where(in_range, s, NEG_INF)          # (b, nkv, bk)
+            m_prev = m_scr[gg][:, :, :1]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_scr[gg] = jnp.broadcast_to(
+                alpha * l_scr[gg][:, :, :1]
+                + jnp.sum(p, axis=-1, keepdims=True), l_scr[gg].shape)
+            acc_scr[gg] = (acc_scr[gg] * alpha
+                           + jnp.sum(p[..., None] * v4, axis=2))
+            m_scr[gg] = jnp.broadcast_to(m_new, m_scr[gg].shape)
+
+    @pl.when(jnp.logical_and(ki == nk, "finish" in phases))
+    def _finish_attn():
+        # fold in the new token's K/V (never round-tripped through HBM),
+        # apply the output projection + residual, and stage the normed
+        # MLP input — the MLP itself runs across the nm chunk ticks
+        kn = kn_scr[...]                                 # (b, nkv, d)
+        vn = vn_scr[...]
+        for gg in range(g):
+            qv = q_scr[gg]
+            s_new = jnp.sum(qv * kn, axis=-1, keepdims=True) * scale
+            m_prev = m_scr[gg][:, :, :1]
+            m_fin = jnp.maximum(m_prev, s_new)
+            alpha = jnp.exp(m_prev - m_fin)
+            p_new = jnp.exp(s_new - m_fin)
+            l_fin = alpha * l_scr[gg][:, :, :1] + p_new
+            ctx = ((acc_scr[gg] * alpha + p_new * vn)
+                   / jnp.where(l_fin == 0.0, 1.0, l_fin))  # (b, nkv, d)
+            for j in range(nkv):
+                hq = j * g + gg
+                ctx_scr[:b, hq * d:(hq + 1) * d] = ctx[:, j, :]
+
+        dims = (((1,), (0,)), ((), ()))
+        attn = jax.lax.dot_general(
+            ctx_scr[...].astype(wo_ref.dtype), wo_ref[0], dims,
+            preferred_element_type=f32)                   # (b_pad, h)
+        x1 = x_scr[...] + attn
+        nw2 = post_nw_ref[0].astype(f32)
+        xn2_scr[...] = x1 * jax.lax.rsqrt(
+            jnp.mean(x1 * x1, axis=-1, keepdims=True) + eps) * nw2
+        x_scr[...] = x1
+
+    # one MLP column/row chunk per tick ki ∈ [nk, nk+nm): the chunked
+    # w_gate/w_up/w_down blocks stream across ticks instead of arriving
+    # as one per-layer burst the pipeline cannot hide (its copy lookahead
+    # is a single tick), and the down-projection partial sums accumulate
+    # into the residual stream — exact because the GLU activation is
+    # elementwise over the chunked ffn columns
+    @pl.when(jnp.logical_and(ki >= nk, "finish" in phases))
+    def _mlp_chunk():
+        dims = (((1,), (0,)), ((), ()))
+        xn2c = xn2_scr[...].astype(wg_ref.dtype)
+        gate = jax.lax.dot_general(xn2c, wg_ref[0], dims,
+                                   preferred_element_type=f32)
+        up = jax.lax.dot_general(xn2c, wu_ref[0], dims,
+                                 preferred_element_type=f32)
+        hid = (act(gate) * up).astype(wd_ref.dtype)
+        part = jax.lax.dot_general(hid, wd_ref[0], dims,
+                                   preferred_element_type=f32)
+        x_scr[...] = x_scr[...] + part
+
+    @pl.when(jnp.logical_and(li == n_layers - 1, ki == nk + nm - 1))
+    def _emit():
+        xo_ref[...] = x_scr[...].astype(xo_ref.dtype)
+
+
+def rope_rotation_matrix(cos: jax.Array, sin: jax.Array,
+                         pos: jax.Array, d: int) -> jax.Array:
+    """[d, d] linear map equal to interleaved-pair RoPE at ``pos``.
+
+    ``x @ R`` reproduces ops/rope.py:apply_rope for a single position:
+    out[2i] = x[2i]·c_i − x[2i+1]·s_i, out[2i+1] = x[2i]·s_i + x[2i+1]·c_i.
+    Built outside the kernel (one tiny gather + scatters per decode step)
+    so the kernel never does strided lane shuffles.
+    """
+    c = jax.lax.dynamic_slice(cos, (pos, 0), (1, d // 2))[0]
+    s = jax.lax.dynamic_slice(sin, (pos, 0), (1, d // 2))[0]
+    i = jnp.arange(d)
+    even = jnp.arange(0, d, 2)
+    r = jnp.zeros((d, d), jnp.float32)
+    r = r.at[i, i].set(jnp.repeat(c, 2))
+    r = r.at[even, even + 1].set(s)
+    r = r.at[even + 1, even].set(-s)
+    return r
+
+
+def fused_decode_eligible(cfg, params, k_cache, s: int,
+                          platform: str) -> bool:
+    """Static predicate for the fused path (see module docstring scope).
+
+    Factored out (same pattern as ops/attention.decode_kernel_eligible)
+    so CPU tests can assert both the accept and every reject arm.
+    """
+    from ..config import PositionEmbeddingType
+    from ..ops.activations import is_glu
+    from ..ops.attention import _mesh_active
+    from ..ops.kv_quant import is_quantized_cache
+    from ..ops.quant import is_quantized
+
+    if not getattr(cfg, "fused_decode", True) or platform != "tpu":
+        return False
+    if _mesh_active():
+        # sharded caches/params: the kernel is single-device; the mesh
+        # paths keep the composed stack (ops/attention shard_map kernels)
+        return False
+    if s != 1 or is_quantized_cache(k_cache):
+        return False
+    if (cfg.norm_type != "rmsnorm" or cfg.parallel_attn
+            or cfg.num_experts > 0 or cfg.use_bias or cfg.qkv_bias
+            or not is_glu(cfg.activation)
+            or cfg.activation not in _GLU_BASE
+            or cfg.quantize_matmuls != "none"
+            or cfg.position_embedding_type != PositionEmbeddingType.ROTARY):
+        return False
+    layers = params["layers"]
+    if is_quantized(layers["attn"]["wq"]) or "mlp_norm" in layers:
+        return False
+    if not (is_glu(cfg.activation) and "w_gate" in layers["mlp"]):
+        return False
+    d = cfg.head_dim
+    h = cfg.hidden_size
+    max_len = k_cache.shape[3]
+    b = k_cache.shape[1]
+    if not (d % 128 == 0 and h % 128 == 0 and cfg.ffn_size % 128 == 0
+            and (cfg.num_attention_heads * d) % 128 == 0
+            and (cfg.kv_heads * d) % 128 == 0
+            and max_len % 128 == 0):
+        return False
+    return _vmem_fit(cfg, b, min(256, max_len), k_cache.dtype.itemsize)
+
+
+def _mlp_chunks(ffn: int, cap: int = 4) -> int:
+    """Number of MLP column/row chunk ticks: the largest divisor of
+    ffn/128 not exceeding ``cap`` (chunk widths must stay 128-aligned).
+    More chunks spread the per-layer weight DMA across more ticks."""
+    lanes = ffn // 128
+    for nm in range(cap, 0, -1):
+        if lanes % nm == 0:
+            return nm
+    return 1
+
+
+def _vmem_fit(cfg, b: int, block_k: int, itemsize: int,
+              budget: int = 100 * 1024 * 1024) -> bool:
+    """Whole-layer-resident VMEM estimate: the kernel holds one layer's
+    weights + two KV blocks, double-buffered, plus fp32 scratch.  Layers
+    wider than the budget (e.g. 7B-width: ~354 MB/layer bf16) must keep
+    the composed path — Mosaic would fail the scoped-vmem allocation."""
+    d = cfg.head_dim
+    h = cfg.hidden_size
+    nq, nkv, ffn = cfg.num_attention_heads, cfg.kv_heads, cfg.ffn_size
+    weight_elts = (h * nq * d + 2 * h * nkv * d + nq * d * h
+                   + (3 if cfg.is_glu else 2) * h * ffn // _mlp_chunks(ffn))
+    cache_elts = 2 * b * nkv * block_k * d
+    blocks = (weight_elts + cache_elts) * itemsize * 2  # double-buffered
+    b_pad = max(8, -(-b // 8) * 8)
+    g = nq // nkv
+    scratch = 4 * (2 * b_pad * h + b_pad * nq * d
+                   + g * b * nkv * (2 * d + 2 * 128) + 2 * b * nkv * d
+                   # the (b, nkv, block_k, d) broadcast-reduce temporaries
+                   + 3 * b * nkv * block_k * d)
+    return blocks + scratch <= budget
+
+
+def fused_decode_step(
+    cfg,
+    stacked,             # params["layers"]: stacked [L, ...] pytree
+    x: jax.Array,        # [b, h] — embedded hidden of the ONE new token
+    k_cache: jax.Array,  # [L, b, kv_heads, max_len, d] (NOT yet updated)
+    v_cache: jax.Array,
+    cache_len: jax.Array,  # scalar int32: valid cache rows (= new token pos)
+    rope: tuple,           # (cos, sin) tables from rope_tables(cfg)
+    *,
+    block_k: int = 256,
+    interpret: bool | None = None,
+):
+    """→ ``(hidden [b, h], k_rows [L, b, kv, 1, d], v_rows ...)``.
+
+    ``hidden`` is the stack output BEFORE the final norm; the caller
+    applies final norm + unembedding and writes the returned K/V rows
+    into its cache at ``cache_len`` (ops/kv_quant.py:cache_update) —
+    the same contract as stack_forward_cached with s=1.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    b, h = x.shape
+    L, _, nkv, max_len, d = k_cache.shape
+    nq = cfg.num_attention_heads
+    g = nq // nkv
+    ffn = cfg.ffn_size
+    eps = float(cfg.norm_eps)
+    scale = 1.0 / float(np.sqrt(d))
+    act = _GLU_BASE[cfg.activation]
+
+    block_k = min(block_k, max_len)
+    while max_len % block_k:
+        block_k //= 2
+    assert block_k >= 128, (max_len, block_k)
+    nk = max_len // block_k
+    nm = _mlp_chunks(ffn)
+    f_chunk = ffn // nm
+
+    b_pad = max(8, -(-b // 8) * 8)
+    x_p = x if b_pad == b else jnp.pad(x, ((0, b_pad - b), (0, 0)))
+    rot = rope_rotation_matrix(rope[0], rope[1], cache_len, d)
+    lens = jnp.reshape(cache_len, (1,)).astype(jnp.int32)
+
+    attn_p, mlp_p = stacked["attn"], stacked["mlp"]
+    # norm scales ride as [L, 1, h]: a (1, 1, h) block keeps the last two
+    # dims legal under the TPU (8, 128) tiling rule (a (1, h) block of an
+    # [L, h] array has a size-1 sublane dim and is rejected by Mosaic)
+    operands = (
+        x_p, rot,
+        stacked["input_norm"]["scale"][:, None, :],
+        stacked["post_attn_norm"]["scale"][:, None, :],
+        attn_p["wq"], attn_p["wk"], attn_p["wv"], attn_p["wo"],
+        mlp_p["w_gate"], mlp_p["w_up"], mlp_p["w_down"],
+        k_cache, v_cache,
+    )
+
+    def fixed(shape):
+        return pl.BlockSpec(shape, lambda li, ki, lens: (0,) * len(shape))
+
+    def per_layer(shape):
+        return pl.BlockSpec(
+            (1,) + shape, lambda li, ki, lens: (li,) + (0,) * len(shape))
+
+    def cache_spec():
+        # clamp at the fill level: blocks past it are never fetched (the
+        # pipeline skips copies whose block index is unchanged); MLP
+        # ticks (ki >= nk) also clamp, adding no traffic
+        def idx(li, ki, lens):
+            last = jnp.maximum(lens[0] - 1, 0) // block_k
+            return (li, 0, 0, jnp.minimum(ki, last), 0)
+        return pl.BlockSpec((1, b, nkv, block_k, d), idx)
+
+    def mlp_col_spec():
+        def idx(li, ki, lens):
+            return (li, 0, jnp.clip(ki - nk, 0, nm - 1))
+        return pl.BlockSpec((1, h, f_chunk), idx)
+
+    def mlp_row_spec():
+        def idx(li, ki, lens):
+            return (li, jnp.clip(ki - nk, 0, nm - 1), 0)
+        return pl.BlockSpec((1, f_chunk, h), idx)
+
+    in_specs = [
+        fixed((b_pad, h)), fixed((d, d)),
+        per_layer((1, h)), per_layer((1, h)),
+        per_layer((h, nq * d)), per_layer((h, nkv * d)),
+        per_layer((h, nkv * d)), per_layer((nq * d, h)),
+        mlp_col_spec(), mlp_col_spec(), mlp_row_spec(),
+        cache_spec(), cache_spec(),
+    ]
+    out_specs = [
+        fixed((b_pad, h)),
+        per_layer((b, nkv, d)), per_layer((b, nkv, d)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b_pad, h), x.dtype),
+        jax.ShapeDtypeStruct((L, b, nkv, d), k_cache.dtype),
+        jax.ShapeDtypeStruct((L, b, nkv, d), v_cache.dtype),
+    ]
+    scratch = [
+        pltpu.VMEM((b_pad, h), jnp.float32),           # residual stream
+        pltpu.VMEM((g, b, nkv, d), jnp.float32),       # rotated q
+        pltpu.VMEM((b, nkv, d), jnp.float32),          # new-token k
+        pltpu.VMEM((b, nkv, d), jnp.float32),          # new-token v
+        pltpu.VMEM((b_pad, nq * d), jnp.float32),      # attention context
+        pltpu.VMEM((b_pad, h), jnp.float32),           # staged MLP input
+        pltpu.VMEM((g, b, nkv, 128), jnp.float32),     # online-softmax m
+        pltpu.VMEM((g, b, nkv, 128), jnp.float32),     # online-softmax l
+        pltpu.VMEM((g, b, nkv, d), jnp.float32),       # online-softmax acc
+    ]
+
+    hidden, k_rows, v_rows = pl.pallas_call(
+        functools.partial(_decode_step_kernel, nk, nm, block_k, b, nq,
+                          nkv, g, d, eps, scale, act),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(L, nk + nm),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch,
+        ),
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            # the whole-layer weight blocks are double-buffered by the
+            # pipeline (~2x ~26 MB at the bench geometry), far past the
+            # 16 MB default scoped-vmem limit; v5e has 128 MB physical
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(lens, *operands)
+    return hidden[:b], k_rows[:, :, :, None, :], v_rows[:, :, :, None, :]

@@ -205,10 +205,27 @@ def forward_cached(
     position_ids = (cache_len + jnp.arange(s, dtype=jnp.int32))[None, :]
     position_ids = jnp.broadcast_to(position_ids, (b, s))
     x = embed(cfg, params, tokens, position_ids)
-    side = AttnSideInputs(rope_cos=cos, rope_sin=sin,
-                          position_ids=position_ids, deterministic=True)
-    x, new_k, new_v = stack_forward_cached(
-        cfg, params["layers"], x, side, k_cache, v_cache, cache_len)
+
+    from ..kernels.decode_step import fused_decode_eligible
+
+    if fused_decode_eligible(cfg, params, k_cache, s, jax.default_backend()):
+        # single-token fast path: the whole stack in one Pallas kernel
+        # (kernels/decode_step.py) — the caller-visible contract (returned
+        # logits + updated caches) is identical to the composed path.
+        from ..kernels.decode_step import fused_decode_step
+        from ..ops.kv_quant import cache_update
+
+        hidden, k_rows, v_rows = fused_decode_step(
+            cfg, params["layers"], x[:, 0], k_cache, v_cache, cache_len,
+            (cos, sin))
+        x = hidden[:, None, :]
+        new_k = cache_update(k_cache, k_rows, cache_len)
+        new_v = cache_update(v_cache, v_rows, cache_len)
+    else:
+        side = AttnSideInputs(rope_cos=cos, rope_sin=sin,
+                              position_ids=position_ids, deterministic=True)
+        x, new_k, new_v = stack_forward_cached(
+            cfg, params["layers"], x, side, k_cache, v_cache, cache_len)
     x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps,
                    impl=cfg.norm_impl)
     logits = unembed(cfg, params, x)

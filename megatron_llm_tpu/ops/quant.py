@@ -69,11 +69,11 @@ def mm(x: jax.Array, w) -> jax.Array:
 # flag, fp32 master weights unchanged).  On TPU the native low-precision
 # MXU format is int8 (v5e: 2x the bf16 peak), so the analogue is W8A8:
 # dynamically quantize both operands per call, run the dot int8xint8->int32
-# on the MXU, apply the rank-1 scale epilogue.  The backward is
-# straight-through at full precision (dx = g @ w.T, dw = x.T @ g in the
-# compute dtype) — quantization noise perturbs the forward like TE's fp8
-# but gradients flow as if the matmul were exact, and the fp32 master-
-# weight update (training/optimizer.py) is untouched.
+# on the MXU, apply the rank-1 scale epilogue.  The backward evaluates the
+# dense matmul formulas (dx = g @ w.T, dw = x.T @ g) on the *dequantized
+# int8* operands — the same tensors the forward consumed, matching
+# TransformerEngine's fp8 wgrad/dgrad semantics (see _int8_mm_bwd) — and
+# the fp32 master-weight update (training/optimizer.py) is untouched.
 # ---------------------------------------------------------------------------
 
 

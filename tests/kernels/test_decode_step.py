@@ -1,0 +1,173 @@
+"""Fused whole-stack decode-step kernel vs the composed path (interpret).
+
+The fused kernel (kernels/decode_step.py) must reproduce, step for step,
+what stack_forward_cached computes for a single new token: same hidden
+output, same K/V rows appended to the cache.  These tests run the Pallas
+kernel in interpret mode on CPU over fp32 params so the comparison is
+tight; bf16/TPU behavior is covered by tests_tpu/test_tpu_integration.py.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import llama2_config
+from megatron_llm_tpu.kernels.decode_step import (
+    fused_decode_eligible,
+    fused_decode_step,
+    rope_rotation_matrix,
+)
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.models.transformer import (
+    AttnSideInputs,
+    rope_tables,
+    stack_forward_cached,
+)
+from megatron_llm_tpu.ops.kv_quant import cache_update
+from megatron_llm_tpu.ops.rope import apply_rope
+
+
+def _cfg(**kw):
+    base = dict(
+        hidden_size=256, num_layers=3, num_attention_heads=2,
+        num_kv_heads=2, ffn_hidden_size=512, vocab_size=128,
+        seq_length=256, max_position_embeddings=256,
+        params_dtype="float32", attention_impl="dot",
+    )
+    base.update(kw)
+    return llama2_config("7b", **base)
+
+
+def _composed_step(cfg, params, x_tok, k_cache, v_cache, cache_len, rope):
+    """stack_forward_cached on one token → (hidden, new_k, new_v)."""
+    b = x_tok.shape[0]
+    position_ids = jnp.broadcast_to(
+        (cache_len + jnp.arange(1, dtype=jnp.int32))[None, :], (b, 1))
+    side = AttnSideInputs(rope_cos=rope[0], rope_sin=rope[1],
+                          position_ids=position_ids, deterministic=True)
+    return stack_forward_cached(cfg, params["layers"], x_tok[:, None, :],
+                                side, k_cache, v_cache, cache_len)
+
+
+def _prefill_cache(cfg, params, b, max_len, fill, key):
+    """Build a cache with ``fill`` real rows via the composed prefill."""
+    rope = rope_tables(cfg)
+    k_cache, v_cache = model_lib.init_kv_cache(cfg, b, max_len)
+    toks = jax.random.randint(key, (b, fill), 0, cfg.vocab_size)
+    _, k_cache, v_cache = model_lib.forward_cached(
+        cfg, params, toks, k_cache, v_cache, jnp.int32(0), rope=rope)
+    return k_cache, v_cache, rope
+
+
+@pytest.mark.parametrize("heads,kv_heads,fill", [
+    (2, 2, 37),    # MHA, partial fill
+    (4, 2, 100),   # GQA group 2
+    (4, 1, 128),   # MQA, fill at a block boundary
+    (2, 2, 0),     # empty cache: token attends only to itself
+])
+def test_fused_matches_composed(heads, kv_heads, fill):
+    cfg = _cfg(num_attention_heads=heads, num_kv_heads=kv_heads)
+    b, max_len = 2, 256
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    if fill > 0:
+        k_cache, v_cache, rope = _prefill_cache(
+            cfg, params, b, max_len, fill, jax.random.key(1))
+    else:
+        k_cache, v_cache = model_lib.init_kv_cache(cfg, b, max_len)
+        rope = rope_tables(cfg)
+    x = jax.random.normal(jax.random.key(2), (b, cfg.hidden_size),
+                          jnp.float32)
+    cache_len = jnp.int32(fill)
+
+    want_h, want_k, want_v = _composed_step(
+        cfg, params, x, k_cache, v_cache, cache_len, rope)
+    got_h, k_rows, v_rows = fused_decode_step(
+        cfg, params["layers"], x, k_cache, v_cache, cache_len, rope,
+        interpret=True)
+    got_k = cache_update(k_cache, k_rows, cache_len)
+    got_v = cache_update(v_cache, v_rows, cache_len)
+
+    np.testing.assert_allclose(np.asarray(got_h), np.asarray(want_h[:, 0]),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(want_k),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_full_forward_cached_parity_when_forced():
+    """forward_cached with the fused path forced on (monkeypatched
+    eligibility) must produce the same logits + caches as with it off."""
+    cfg = _cfg()
+    b, max_len, fill = 2, 256, 50
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    k_cache, v_cache, rope = _prefill_cache(
+        cfg, params, b, max_len, fill, jax.random.key(1))
+    tok = jax.random.randint(jax.random.key(3), (b, 1), 0, cfg.vocab_size)
+
+    want_logits, want_k, want_v = model_lib.forward_cached(
+        cfg, params, tok, k_cache, v_cache, jnp.int32(fill), rope=rope)
+
+    import megatron_llm_tpu.kernels.decode_step as ds
+    orig_step = ds.fused_decode_step
+    mdl_eligible = ds.fused_decode_eligible
+    try:
+        # force-eligible + interpret on CPU; model.py imports these names
+        # function-locally, so patching the source module is sufficient
+        ds_patched = lambda *a, **kw: orig_step(*a, **{**kw,
+                                                       "interpret": True})
+        ds.fused_decode_eligible = lambda *a: True
+        ds.fused_decode_step = ds_patched
+        got_logits, got_k, got_v = model_lib.forward_cached(
+            cfg, params, tok, k_cache, v_cache, jnp.int32(fill), rope=rope)
+    finally:
+        ds.fused_decode_eligible = mdl_eligible
+        ds.fused_decode_step = orig_step
+
+    np.testing.assert_allclose(np.asarray(got_logits),
+                               np.asarray(want_logits), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(want_k),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_rope_rotation_matrix_matches_apply_rope():
+    d, pos = 128, 41
+    cos, sin = rope_tables(_cfg(hidden_size=128, num_attention_heads=1,
+                                num_kv_heads=1))
+    x = jax.random.normal(jax.random.key(0), (3, d), jnp.float32)
+    want = apply_rope(x[:, None, None, :], cos, sin,
+                      jnp.full((3, 1), pos, jnp.int32))[:, 0, 0]
+    r = rope_rotation_matrix(cos, sin, jnp.int32(pos), d)
+    got = x @ r
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_eligibility_arms():
+    cfg = _cfg()
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    k_cache, _ = model_lib.init_kv_cache(cfg, 2, 256)
+    ok = lambda c, p=params, kc=k_cache, s=1, plat="tpu": \
+        fused_decode_eligible(c, p, kc, s, plat)
+    assert ok(cfg)
+    assert not ok(cfg, plat="cpu")
+    assert not ok(cfg, s=2)
+    assert not ok(dataclasses.replace(cfg, fused_decode=False))
+    assert not ok(dataclasses.replace(cfg, norm_type="layernorm"))
+    assert not ok(dataclasses.replace(cfg, activation="gelu"))
+    assert not ok(dataclasses.replace(cfg, use_bias=True))
+    assert not ok(dataclasses.replace(cfg, num_experts=4))
+    assert not ok(dataclasses.replace(cfg, quantize_matmuls="int8"))
+    # non-128-divisible cache length
+    kc_odd, _ = model_lib.init_kv_cache(cfg, 2, 200)
+    assert not ok(cfg, kc=kc_odd)
+    # int8 cache dict form
+    from megatron_llm_tpu.ops.kv_quant import init_quantized_cache
+    kc_q = init_quantized_cache((cfg.num_layers, 2, cfg.kv_heads, 256,
+                                 cfg.head_dim))
+    assert not ok(cfg, kc=kc_q)
