@@ -1,4 +1,4 @@
-"""Benchmark: training throughput on one TPU chip.
+"""Benchmark: training + serving throughput on one TPU chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
@@ -11,26 +11,40 @@ Llama-architecture model sized to the chip and reports **MFU**, which is the
 hardware-normalized apples-to-apples number; vs_baseline = our MFU / 0.12.
 
 Besides the headline (seq 1024, the reference's finetune config), the JSON
-carries a seq-length MFU curve through 32k (BASELINE config 4's long-context
-regime, exercising the Pallas flash kernel fwd+bwd) and a KV-cache decode
-throughput row.  Sweep provenance (v5e, 2026-07): head_dim 128 beats 64 by
-+24% MFU (MXU lane width); mb=12 beats 8/16 by ~1%; the fused LM head and
-block_q/k ∈ {512, 2048} variants measured slower — defaults kept.
-Decode negative results (v5e, 2026-07-31, don't re-chase): per-step decode
-time is flat in cache max_len (no hidden O(max_len) copies) and scales with
-LAYER COUNT at fixed weight bytes (6-layer/h2048 is 25% faster per step
-than 24-layer/h1024 with MORE bytes) — the bound is the sequential per-op
-chain, ~100us/layer vs a 38us/layer weight-read floor.  Fusing sibling
-GEMVs (wqkv, gate|up concat) measured 1.01x: XLA's scheduler already
-overlaps independent siblings, and the wider bf16 matmul perturbs logits
-(different accumulation tiling, max|dlogit| 0.057).  Closing the gap needs
-shorter sequential chains (per-layer Pallas megakernels or speculative
-multi-token steps), not op-count reduction.
+carries: a seq-length MFU curve through 32k (BASELINE config 4's
+long-context regime), a 7B-width training row, decode rows (bf16 via the
+fused whole-stack Pallas decode kernel, int8, and 7B-width), prompt-lookup
+speculative decoding rows on repetitive/random prompt mixes, and prefill
+at both the decode point's 128-token prompts and an amortized 1024-token
+prompt with its own MFU.
+
+Process isolation (round 5): every point runs in a SUBPROCESS.  Round-5's
+first in-process run had the 32k row's HBM footprint leak into every
+subsequent point (ResourceExhausted on even the small decode jobs despite
+del + clear_caches — intermittent; round 4 ran the same sequence clean).
+A fresh backend per point makes the record insensitive to allocator state,
+and a hung point (degraded tunnel) is killed by the parent's timeout
+instead of sinking the whole record.
+
+Measurement notes (v5e, 2026-07, don't re-derive):
+- head_dim 128 beats 64 by +24% MFU (MXU lane width); mb=12 beats 8/16.
+- Per-DISPATCH latency through the axon tunnel is ~0.8-1.1 ms: decode
+  rates are only meaningful when the token loop runs on-device inside one
+  executable (lax.while_loop / fori_loop) — timing per-step dispatches
+  measures the tunnel, not the chip.
+- Decode was op-chain-bound (~100us/layer vs 38us/layer read floor); the
+  fused decode-step kernel (kernels/decode_step.py) removes the chain
+  (93us/layer measured in-loop, 2.4x end-to-end).  Sibling-GEMV fusion
+  measured 1.01x (XLA already overlaps independent matmuls) — dead end.
+- The decode rate subtracts a separately-timed prefill; at a 128-token
+  horizon the subtraction amplifies tunnel jitter ±40%, so the horizon is
+  512 tokens (prefill correction ~few %).
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -100,10 +114,10 @@ def _bench_model(seq: int, recompute: str):
 def _bench_model_7b_width(seq: int, num_layers: int,
                           recompute: str = "selective"):
     """Llama-2-7B *width* (hidden 4096, ffn 11008, 32 q-heads × d128) at
-    reduced depth so training state fits one chip; GQA (8 kv-heads) trims
-    the kv projections the way the 34B/70B presets do.  MFU at this width
-    is the number comparable to the BASELINE 7B configs — per-layer matmul
-    shapes are exactly the 7B ones, depth only repeats them."""
+    reduced depth so the state fits one chip; GQA (8 kv-heads) trims the
+    kv projections the way the 34B/70B presets do.  MFU / decode rates at
+    this width are the numbers comparable to the BASELINE 7B configs —
+    per-layer matmul shapes are exactly the 7B ones, depth repeats them."""
     from megatron_llm_tpu.config import llama2_config
 
     return llama2_config(
@@ -122,8 +136,8 @@ def _bench_model_7b_width(seq: int, num_layers: int,
 
 
 def _train_point(seq: int, mb: int, recompute: str, iters: int, peak: float,
-                 model=None):
-    """One training-throughput measurement → (tokens/sec, mfu, loss)."""
+                 wide_layers: int = 0):
+    """One training-throughput measurement → (tokens/sec, mfu, loss, n)."""
     import jax
     import jax.numpy as jnp
 
@@ -136,8 +150,10 @@ def _train_point(seq: int, mb: int, recompute: str, iters: int, peak: float,
     from megatron_llm_tpu.models import model as model_lib
     from megatron_llm_tpu.training.step import init_train_state, make_train_step
 
+    model = (_bench_model_7b_width(seq, wide_layers, recompute)
+             if wide_layers else _bench_model(seq, recompute))
     cfg = RuntimeConfig(
-        model=model if model is not None else _bench_model(seq, recompute),
+        model=model,
         parallel=ParallelConfig(),
         optimizer=OptimizerConfig(lr=1e-4, clip_grad=1.0),
         train=TrainConfig(train_iters=100, micro_batch_size=mb,
@@ -161,16 +177,13 @@ def _train_point(seq: int, mb: int, recompute: str, iters: int, peak: float,
 
     # warmup / compile — two steps: the first compiles, the second flushes
     # remaining lazy one-time work (allocator growth, executable warm-in)
-    # out of the timed window (~0.8% of a 20-iter headline otherwise)
     state, metrics = step(state, batch, key)
     float(metrics["loss"])
     state, metrics = step(state, batch, key)
     float(metrics["loss"])
 
     # Timing via an explicit host fetch of the last loss: the steps chain
-    # through the donated state, so the fetch transitively waits for all of
-    # them.  (block_until_ready proved unreliable for independent outputs
-    # over the axon-tunneled backend; a host read is unambiguous.)
+    # through the donated state, so the fetch transitively waits for all.
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, batch, key)
@@ -179,12 +192,6 @@ def _train_point(seq: int, mb: int, recompute: str, iters: int, peak: float,
 
     tokens_per_sec = iters * mb * seq / dt
     mfu = tokens_per_sec * _model_flops_per_token(cfg.model, seq) / peak
-    # Drop this point's state/executables before the next point compiles:
-    # carried-over HBM allocations made the 32k row intermittently spill
-    # (measured 0.63 isolated vs 0.17 contaminated in one process).
-    del state, batch, step
-    if seq >= 8192 or model is not None:  # big points: free HBM + caches
-        jax.clear_caches()
     return tokens_per_sec, mfu, loss, n_params
 
 
@@ -193,10 +200,10 @@ def _decode_roofline_tps(cfg, param_bytes: int, batch: int,
     """Bandwidth-bound decode tokens/s: each decode step must stream the
     weights once (shared across the batch; ``param_bytes`` = actual stored
     bytes, so int8 quantization moves the roofline) plus each sequence's
-    bf16 KV cache; tokens/s = batch / (bytes_per_step / HBM_BW).  Compute
-    and the int32 token traffic are negligible beside these two terms, so
-    the bound is tight for small batches (the reference publishes no
-    decode number; this roofline is the stated target per BASELINE.md)."""
+    KV cache; tokens/s = batch / (bytes_per_step / HBM_BW).  Compute and
+    the int32 token traffic are negligible beside these two terms, so the
+    bound is tight for small batches (the reference publishes no decode
+    number; this roofline is the stated target per BASELINE.md)."""
     kv_elt_bytes = (1 + 4 / cfg.head_dim
                     if cfg.kv_cache_quant == "int8" else 2)
     kv_bytes = int(batch * 2 * cfg.num_layers * cfg.kv_heads
@@ -204,29 +211,40 @@ def _decode_roofline_tps(cfg, param_bytes: int, batch: int,
     return batch / ((param_bytes + kv_bytes) / hbm_bw)
 
 
-def _decode_point(hbm_bw: float, quantize: bool = False):
-    """→ (decode tokens/sec, roofline tokens/sec, prefill tokens/sec) on
-    the bench model.  With ``quantize`` both the weights (ops/quant.py)
-    AND the KV cache (ops/kv_quant.py) are int8, and both roofline terms
-    shrink accordingly."""
+def _min_time(run, n=3):
+    """Best-of-n wall time: tunnel latency drifts wildly between runs, and
+    subtraction-based rates amplify single-shot jitter — minimums of
+    repeated samples keep the record off the noise tails."""
+    import jax
+
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.device_get(run())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _decode_point(hbm_bw: float, quantize: bool = False,
+                  wide_layers: int = 0):
+    """→ dict with decode tokens/sec, roofline tokens/sec, prefill
+    tokens/sec.  With ``quantize`` both the weights (ops/quant.py) AND the
+    KV cache (ops/kv_quant.py) are int8, and both roofline terms shrink.
+    With ``wide_layers`` the model is 7B-width at that depth (the fused
+    decode kernel bows out on VMEM fit; the composed path serves)."""
     import jax
     import jax.numpy as jnp
 
     from megatron_llm_tpu.models import model as model_lib
     from megatron_llm_tpu.generation.generation import generate_tokens
 
-    # gen_len 512 (not 128): the decode rate comes from subtracting a
-    # separately-timed prefill from the full-generate window, and with a
-    # short horizon the two terms are comparable — tunnel timing jitter
-    # on the prefill term then swings the decode estimate by ±40%
-    # (observed 2.6k-4.9k tok/s across clean runs at gen 128).  At 512
-    # steps the prefill correction is a few percent of the window, so its
-    # jitter moves the decode number by ~1%.
+    # gen 512 (not 128): the decode rate is derived by subtracting a
+    # separately-timed prefill from the full-generate window; at 512
+    # steps the prefill correction is a few percent (see module notes).
     b, prompt_len, gen_len = 8, 128, 512
-    # The kv-cache path has its own dispatcher (ops/attention.py:
-    # decode_attention): Pallas decode kernel on TPU, einsum fallback —
-    # cfg.attention_impl only affects the prefill, where flash is right.
-    cfg = _bench_model(prompt_len + gen_len, "selective")
+    cfg = (_bench_model_7b_width(prompt_len + gen_len, wide_layers)
+           if wide_layers else _bench_model(prompt_len + gen_len,
+                                            "selective"))
     if quantize:
         import dataclasses
 
@@ -244,20 +262,6 @@ def _decode_point(hbm_bw: float, quantize: bool = False):
     tokens = jnp.asarray(tokens)
     lengths = jnp.full((b,), prompt_len, jnp.int32)
 
-    def _min_time(run, n=3):
-        """Best-of-n wall time: tunnel latency drifts wildly between runs
-        (the same decode program measured 3.3k-4.9k tok/s across clean
-        full-bench runs), and the dt_full - dt_prefill subtraction below
-        AMPLIFIES single-shot jitter (a high prefill sample inflates
-        decode tps and vice versa) — minimums of repeated samples keep
-        the official record off the noise tails for ~20s of wall-clock."""
-        best = float("inf")
-        for _ in range(n):
-            t0 = time.perf_counter()
-            jax.device_get(run())
-            best = min(best, time.perf_counter() - t0)
-        return best
-
     out = generate_tokens(cfg, params, tokens, lengths,
                           use_eos_stop=False)  # warmup/compile
     jax.device_get(out.tokens)
@@ -265,10 +269,8 @@ def _decode_point(hbm_bw: float, quantize: bool = False):
         cfg, params, tokens, lengths, use_eos_stop=False).tokens)
 
     # The roofline models per-step decode streaming only, so subtract the
-    # prefill forward (the same [b, prompt_len] cached forward the generate
-    # loop runs before its first decode step) from the measured window —
-    # otherwise the reported fraction is systematically understated by the
-    # prefill's share of dt.
+    # prefill forward (the same [b, prompt_len] cached forward the
+    # generate loop runs before its first decode step).
     rope = model_lib.rope_tables(cfg)
 
     @jax.jit
@@ -288,15 +290,102 @@ def _decode_point(hbm_bw: float, quantize: bool = False):
                       for p in jax.tree.leaves(params))
     roof = _decode_roofline_tps(cfg, param_bytes, b,
                                 prompt_len + gen_len // 2, hbm_bw)
-    return tps, roof, prefill_tps
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    return {
+        "tokens_per_sec": round(tps, 1),
+        "roofline_tokens_per_sec": round(roof, 1),
+        "roofline_frac": round(tps / roof, 4),
+        "prefill_tokens_per_sec": round(prefill_tps, 1),
+        "model_params": n_params,
+    }
+
+
+def _pld_point():
+    """Prompt-lookup speculative decoding → dict of tokens/verify-forward,
+    effective tok/s and full-window speedup vs the plain greedy loop, on a
+    repetitive prompt mix (n-gram lookup can hit) and an incompressible
+    random mix (it can't — measures graceful degradation).  All greedy,
+    512-token horizon, same model/batch as the main decode point."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.generation.generation import generate_tokens
+    from megatron_llm_tpu.generation.speculative import generate_tokens_pld
+
+    b, prompt_len, gen_len = 8, 128, 512
+    cfg = _bench_model(prompt_len + gen_len, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(2)
+
+    def make_tokens(repetitive: bool):
+        tokens = np.zeros((b, prompt_len + gen_len), np.int32)
+        if repetitive:
+            motif = rng.integers(1, cfg.vocab_size, (b, 16))
+            tokens[:, :prompt_len] = np.tile(motif, (1, prompt_len // 16))
+        else:
+            tokens[:, :prompt_len] = rng.integers(1, cfg.vocab_size,
+                                                  (b, prompt_len))
+        return jnp.asarray(tokens), jnp.full((b,), prompt_len, jnp.int32)
+
+    result = {}
+    for name, repetitive in (("repetitive", True), ("random", False)):
+        tokens, lengths = make_tokens(repetitive)
+        out = generate_tokens_pld(cfg, params, tokens, lengths,
+                                  use_eos_stop=False)
+        steps = float(np.max(np.asarray(out.steps)))
+        dt_pld = _min_time(lambda: generate_tokens_pld(
+            cfg, params, tokens, lengths, use_eos_stop=False).tokens)
+        out2 = generate_tokens(cfg, params, tokens, lengths,
+                               use_eos_stop=False)
+        jax.device_get(out2.tokens)
+        dt_plain = _min_time(lambda: generate_tokens(
+            cfg, params, tokens, lengths, use_eos_stop=False).tokens)
+        result[f"pld_tokens_per_verify_{name}"] = round(gen_len / steps, 2)
+        result[f"pld_tokens_per_sec_{name}"] = round(b * gen_len / dt_pld, 1)
+        result[f"pld_speedup_{name}"] = round(dt_plain / dt_pld, 3)
+    return result
+
+
+def _prefill_point(peak: float):
+    """Amortized prefill: one cached forward over 1024-token prompts
+    (b=8) → tokens/sec + prefill MFU.  The decode point's 128-token
+    prompt prefill is latency-dominated through the tunnel; this is the
+    capability number (VERDICT r4 weak #4)."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.models import model as model_lib
+
+    b, prompt_len = 8, 1024
+    cfg = _bench_model(prompt_len + 128, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    rope = model_lib.rope_tables(cfg)
+    rng = np.random.default_rng(3)
+    toks = jnp.asarray(rng.integers(1, cfg.vocab_size, (b, prompt_len)),
+                       jnp.int32)
+
+    @jax.jit
+    def prefill(p, toks):
+        k, v = model_lib.init_kv_cache(cfg, b, prompt_len + 128)
+        logits, k, v = model_lib.forward_cached(
+            cfg, p, toks, k, v, jnp.int32(0), rope=rope)
+        return logits[:, -1]
+
+    jax.device_get(prefill(params, toks))  # compile
+    dt = _min_time(lambda: prefill(params, toks), n=5)
+    tps = b * prompt_len / dt
+    fwd_flops = _model_flops_per_token(cfg, prompt_len) / 3.0
+    return {
+        "prefill_long_tokens_per_sec": round(tps, 1),
+        "prefill_long_mfu": round(tps * fwd_flops / peak, 4),
+    }
 
 
 def _transient_error_types():
     """The error classes worth retrying: the axon-tunneled compile service
     occasionally throws a transient remote-compile XlaRuntimeError.
-    Deterministic bugs (NameError, TypeError, ...) must NOT be retried —
-    round 2's broad ``except Exception`` retried a NameError once and then
-    sank the whole benchmark, doubling the cost of diagnosing it."""
+    Deterministic bugs (NameError, TypeError, ...) must NOT be retried."""
     import jax
 
     types = [jax.errors.JaxRuntimeError]
@@ -309,10 +398,10 @@ def _transient_error_types():
     return tuple(types)
 
 
-def _retry(fn, *args):
+def _retry(fn, *args, **kw):
     """One retry, transient (XLA runtime / remote-compile) errors only."""
     try:
-        return fn(*args)
+        return fn(*args, **kw)
     except _transient_error_types() as e:
         print(f"# bench point failed ({type(e).__name__}); retrying once",
               flush=True)
@@ -320,38 +409,82 @@ def _retry(fn, *args):
 
         jax.clear_caches()
         time.sleep(5)
-        return fn(*args)
+        return fn(*args, **kw)
 
 
-def _point(label: str, fn, *args):
-    """Run one measurement, isolated: a failed point (even a deterministic
-    crash) yields None and the benchmark still emits its JSON — round 2
-    lost the already-measured train curve because a later decode point
-    crashed before the single end-of-run print."""
+# ---------------------------------------------------------------------------
+# Orchestration: one subprocess per point (see module docstring)
+# ---------------------------------------------------------------------------
+
+_CHILD_MARK = "##BENCH_POINT##"
+
+
+def _child_main(spec_json: str) -> None:
+    spec = json.loads(spec_json)
+    platform = spec["platform"]
+    peak = chip_peak_flops(platform)
+    hbm_bw = chip_hbm_bandwidth(platform)
+    kind = spec["kind"]
+    if kind == "train":
+        out = _retry(_train_point, spec["seq"], spec["mb"], spec["rc"],
+                     spec["iters"], peak, spec.get("wide_layers", 0))
+    elif kind == "decode":
+        out = _retry(_decode_point, hbm_bw, spec.get("quantize", False),
+                     spec.get("wide_layers", 0))
+    elif kind == "pld":
+        out = _retry(_pld_point)
+    elif kind == "prefill":
+        out = _retry(_prefill_point, peak)
+    else:  # pragma: no cover - parent and child ship together
+        raise ValueError(f"unknown point kind {kind!r}")
+    print(_CHILD_MARK + json.dumps(out), flush=True)
+
+
+def _point(label: str, spec: dict, timeout_s: int = 900):
+    """Run one measurement in a fresh subprocess → parsed result or None.
+
+    Isolation is the point: a crashed, hung, or HBM-leaking measurement
+    cannot take the rest of the record down with it (round 2 lost the
+    train curve to a late crash; round 5 lost decode rows to in-process
+    HBM contamination)."""
+    import os
+    import subprocess
+
     t0 = time.perf_counter()
     try:
-        out = _retry(fn, *args)
-    except Exception as e:  # noqa: BLE001 — isolation barrier, reported
-        print(f"# bench point {label} FAILED: {type(e).__name__}: {e}",
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--point",
+             json.dumps(spec)],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        print(f"# bench point {label} TIMED OUT after {timeout_s}s",
               flush=True)
         return None
-    print(f"# bench point {label} ok ({time.perf_counter() - t0:.0f}s)",
-          flush=True)
-    return out
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("#") and not line.startswith(_CHILD_MARK):
+            print(line, flush=True)
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-1:] or ["?"]
+        print(f"# bench point {label} FAILED (rc={proc.returncode}): "
+              f"{tail[0]}", flush=True)
+        return None
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith(_CHILD_MARK):
+            print(f"# bench point {label} ok "
+                  f"({time.perf_counter() - t0:.0f}s)", flush=True)
+            return json.loads(line[len(_CHILD_MARK):])
+    print(f"# bench point {label} produced no result line", flush=True)
+    return None
 
 
 def _detect_device(timeout_s: int = 240):
     """First device's kind, probed in a SUBPROCESS with a hard timeout.
 
     A degraded axon tunnel makes ``jax.devices()`` hang indefinitely
-    *inside a C call* (observed live: >25 min wedged, and SIGALRM never
-    fires because the Python handler can't run mid-C-call) — a benchmark
-    that hangs is worse for the driver than one that emits a structured
-    failure record quickly.  A killed subprocess bounds the wait no
-    matter where the backend blocks; on success the parent initializes
-    its own backend (now known reachable)."""
+    *inside a C call* — a benchmark that hangs is worse for the driver
+    than one that emits a structured failure record quickly."""
     import subprocess
-    import sys
 
     try:
         out = subprocess.run(
@@ -365,9 +498,6 @@ def _detect_device(timeout_s: int = 240):
     if out.returncode != 0:
         tail = (out.stderr or "").strip().splitlines()[-1:] or ["?"]
         raise RuntimeError(f"device probe failed: {tail[0]}")
-    # the child already printed the device kind; re-calling jax.devices()
-    # here would reintroduce the unbounded hang (a wedge can start between
-    # the probe and the call) and pay backend init twice
     kind = (out.stdout or "").strip().splitlines()[-1:]
     if not kind:
         raise RuntimeError("device probe printed nothing")
@@ -375,29 +505,32 @@ def _detect_device(timeout_s: int = 240):
 
 
 def main() -> None:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--point":
+        _child_main(sys.argv[2])
+        return
+
     try:
         platform = _detect_device()
     except (TimeoutError, RuntimeError, OSError) as e:
-        # no reachable device: emit a parseable record naming the cause
-        # instead of hanging or stack-tracing
         print(json.dumps({
             "metric": "mfu", "value": None, "unit": "fraction_of_peak",
             "vs_baseline": None,
             "error": f"{type(e).__name__}: {e}",
         }))
         raise SystemExit(1)
-    peak = chip_peak_flops(platform)
+
+    def train_spec(seq, mb, rc, iters, wide_layers=0):
+        return {"kind": "train", "platform": platform, "seq": seq,
+                "mb": mb, "rc": rc, "iters": iters,
+                "wide_layers": wide_layers}
 
     # Headline: seq 1024 (the reference's finetune config), measured
-    # single-chip sweet spot mb=12, selective recompute.  Fallback config
-    # (mb=8) only runs if the primary fails — a partial record with a real
-    # headline beats a stack trace.
-    headline = _point("train@1024", _train_point, 1024, 12, "selective",
-                      30, peak)
+    # single-chip sweet spot mb=12, selective recompute; mb=8 fallback.
+    headline = _point("train@1024", train_spec(1024, 12, "selective", 30))
     headline_config = "mb12"
     if headline is None:
-        headline = _point("train@1024/fallback", _train_point, 1024, 8,
-                          "selective", 10, peak)
+        headline = _point("train@1024/fallback",
+                          train_spec(1024, 8, "selective", 10))
         headline_config = "mb8-fallback"
 
     curve = []
@@ -412,24 +545,19 @@ def main() -> None:
                                (8192, 1, "selective", 10),
                                (16384, 1, "full", 5),
                                (32768, 1, "full", 5)):
-        p = _point(f"train@{seq}", _train_point, seq, mb, rc, iters, peak)
+        p = _point(f"train@{seq}", train_spec(seq, mb, rc, iters))
         if p is not None:
             c_tps, c_mfu, _, _ = p
             curve.append({"seq_length": seq, "mfu": round(c_mfu, 4),
                           "tokens_per_sec": round(c_tps, 1)})
 
-    # 7B-width point (BASELINE configs are all 7B–70B; the 374M proxy's
-    # matmuls are narrower than any of them).  Shallow depth to fit
-    # ~11-13 GB of train state in one chip's HBM.  Measured ladder on
-    # v5e (2026-07-31): L3/mb2/selective 0.556, L2/mb2/selective 0.535,
-    # L3/mb1/full 0.441 — mb ≥ 2 + selective remat is the lever; the
-    # full-remat L2 rung is the spill fallback.
-    wide = None
+    # 7B-width training point.  Measured ladder on v5e (2026-07-31):
+    # L3/mb2/selective 0.556, L2/mb2/selective 0.535, L3/mb1/full 0.441 —
+    # mb ≥ 2 + selective remat is the lever.
     for layers, mb, rc in ((3, 2, "selective"), (2, 2, "selective"),
                            (2, 1, "full")):
-        wide = _point(f"train@4096/7b-width-L{layers}", _train_point,
-                      4096, mb, rc, 5, peak,
-                      _bench_model_7b_width(4096, layers, rc))
+        wide = _point(f"train@4096/7b-width-L{layers}",
+                      train_spec(4096, mb, rc, 5, wide_layers=layers))
         if wide is not None:
             w_tps, w_mfu, _, w_params = wide
             curve.append({"seq_length": 4096, "mfu": round(w_mfu, 4),
@@ -438,9 +566,17 @@ def main() -> None:
                           "model_params": w_params})
             break
 
-    hbm_bw = chip_hbm_bandwidth(platform)
-    decode = _point("decode", _decode_point, hbm_bw)
-    decode_q = _point("decode/int8", _decode_point, hbm_bw, True)
+    decode = _point("decode", {"kind": "decode", "platform": platform})
+    decode_q = _point("decode/int8", {"kind": "decode",
+                                      "platform": platform,
+                                      "quantize": True})
+    decode_7b = _point("decode/7b-width-L8",
+                       {"kind": "decode", "platform": platform,
+                        "wide_layers": 8}, timeout_s=1200)
+    pld = _point("decode/pld", {"kind": "pld", "platform": platform},
+                 timeout_s=1200)
+    prefill_long = _point("prefill@1024", {"kind": "prefill",
+                                           "platform": platform})
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     record = {
@@ -451,20 +587,26 @@ def main() -> None:
         "seq_length": 1024,
         "device": platform,
         "mfu_vs_seq": curve,
-        "decode_tokens_per_sec": (None if decode is None
-                                  else round(decode[0], 1)),
-        "decode_roofline_tokens_per_sec": (None if decode is None
-                                           else round(decode[1], 1)),
-        "decode_roofline_frac": (None if decode is None
-                                 else round(decode[0] / decode[1], 4)),
-        "decode_tokens_per_sec_int8": (None if decode_q is None
-                                       else round(decode_q[0], 1)),
-        "decode_int8_roofline_frac": (None if decode_q is None
-                                      else round(decode_q[0] / decode_q[1],
-                                                 4)),
-        "prefill_tokens_per_sec": (None if decode is None
-                                   else round(decode[2], 1)),
     }
+    if decode is not None:
+        record.update({
+            "decode_tokens_per_sec": decode["tokens_per_sec"],
+            "decode_roofline_tokens_per_sec":
+                decode["roofline_tokens_per_sec"],
+            "decode_roofline_frac": decode["roofline_frac"],
+            "prefill_tokens_per_sec": decode["prefill_tokens_per_sec"],
+        })
+    if decode_q is not None:
+        record.update({
+            "decode_tokens_per_sec_int8": decode_q["tokens_per_sec"],
+            "decode_int8_roofline_frac": decode_q["roofline_frac"],
+        })
+    if decode_7b is not None:
+        record["decode_7b_width"] = decode_7b
+    if pld is not None:
+        record.update(pld)
+    if prefill_long is not None:
+        record.update(prefill_long)
     if headline is not None:
         record.update({
             "value": round(mfu, 4),

@@ -87,6 +87,31 @@ class GenerationResult:
     segments: Optional[list[list[str]]] = None
     logprobs: Optional[list[list[float]]] = None
     scores: Optional[list[float]] = None  # beam search only
+    # "pld" when speculative decoding served the request; "fallback:<why>"
+    # when it was requested but ineligible; None when not requested
+    speculative: Optional[str] = None
+
+
+def pld_eligible(speculative, top_k, top_p, return_logprobs,
+                 lengths) -> tuple[bool, str]:
+    """(ok, reason-if-not) for the prompt-lookup fast path.
+
+    PLD is greedy-exact, so any sampling mode or log-prob request rules
+    it out; prompts shorter than the lookup n-gram have no key to match.
+    Ragged prompt lengths ARE eligible (per-sample fill levels,
+    generation/speculative.py)."""
+    from .speculative import DEFAULT_NGRAM
+
+    if speculative != "pld":
+        return False, "not requested"
+    if top_k != 0 or top_p != 0.0:
+        return False, "sampling requested (PLD is greedy-exact only)"
+    if return_logprobs:
+        return False, "log-probs requested"
+    if min(int(l) for l in lengths) < DEFAULT_NGRAM:
+        return False, (f"a prompt is shorter than the lookup n-gram "
+                       f"({DEFAULT_NGRAM})")
+    return True, ""
 
 
 def generate_and_post_process(
@@ -110,9 +135,11 @@ def generate_and_post_process(
     (reference: api.py:19-67 / generate :70-144).
 
     ``speculative="pld"`` routes eligible requests (greedy sampling, no
-    log-probs, uniform prompt lengths) through prompt-lookup speculative
-    decoding (generation/speculative.py); ineligible requests silently
-    use the standard loop — the output contract is identical."""
+    log-probs; ragged prompt lengths are fine — acceptance is per-sample)
+    through prompt-lookup speculative decoding
+    (generation/speculative.py); ineligible requests use the standard
+    loop — the output contract is identical, and the fallback is logged
+    (and surfaced by the REST server) rather than silent."""
     import jax
 
     tokens, lengths = tokenize_prompts(
@@ -124,18 +151,15 @@ def generate_and_post_process(
         random_seed = int.from_bytes(os.urandom(4), "little")
     rng = jax.random.key(random_seed)
 
-    def _pld_min_prompt():
-        from .speculative import DEFAULT_NGRAM
+    pld_ok, pld_reason = pld_eligible(
+        speculative, top_k_sampling, top_p_sampling,
+        return_output_log_probs, lengths)
+    if speculative == "pld" and not pld_ok:
+        import logging
 
-        return DEFAULT_NGRAM
-
-    pld_ok = (
-        speculative == "pld"
-        and top_k_sampling == 0 and top_p_sampling == 0.0
-        and not return_output_log_probs
-        and len(set(int(l) for l in lengths)) == 1
-        and min(int(l) for l in lengths) >= _pld_min_prompt()
-    )
+        logging.getLogger(__name__).warning(
+            "speculative='pld' requested but the request is ineligible "
+            "(%s); using the standard decode loop", pld_reason)
     if pld_ok:
         from .speculative import generate_tokens_pld
 
@@ -164,8 +188,11 @@ def generate_and_post_process(
         lp = np.asarray(out.logprobs)
         logprobs = [lp[i, :max(int(n) - 1, 0)].tolist()
                     for i, n in enumerate(lens)]
+    spec_tag = None
+    if speculative == "pld":
+        spec_tag = "pld" if pld_ok else f"fallback:{pld_reason}"
     return GenerationResult(texts=texts, tokens=ids, segments=segments,
-                            logprobs=logprobs)
+                            logprobs=logprobs, speculative=spec_tag)
 
 
 def beam_search_and_post_process(

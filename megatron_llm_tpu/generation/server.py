@@ -39,9 +39,10 @@ class GenerationService:
         self.tokenizer = tokenizer
         self.max_batch_size = max_batch_size
         self.max_tokens_to_generate = max_tokens_to_generate
-        # "pld": greedy requests with uniform prompt lengths run
+        # "pld": greedy requests (ragged prompts included) run
         # prompt-lookup speculative decoding (generation/speculative.py);
-        # everything else silently uses the standard loop.
+        # ineligible requests use the standard loop, and the response's
+        # "speculative" field says which path served it.
         self.speculative = speculative
         self.lock = threading.Lock()  # one generation at a time (ref :21)
 
@@ -154,9 +155,14 @@ class GenerationService:
                     use_eod_token_for_early_termination=not no_early_term,
                     random_seed=random_seed,
                     speculative=self.speculative)
-                return 200, {"text": res.texts,
-                             "segments": res.segments,
-                             "logprobs": res.logprobs}
+                resp = {"text": res.texts,
+                        "segments": res.segments,
+                        "logprobs": res.logprobs}
+                if res.speculative is not None:
+                    # surface PLD-vs-fallback so clients can see when the
+                    # requested speculative path did not serve them
+                    resp["speculative"] = res.speculative
+                return 200, resp
             except ValueError as e:
                 return 400, str(e)
 

@@ -43,7 +43,8 @@ def _decode_kernel(scale: float, nk: int, block_k: int,
         preferred_element_type=jnp.float32,
     ) * scale                                          # [g_pad, block_k]
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_k
-    s = jnp.where(cols < len_ref[0], s, NEG_INF)
+    # lens is per-sample ([b]); program axis 0 is the batch
+    s = jnp.where(cols < len_ref[pl.program_id(0)], s, NEG_INF)
 
     m_prev = m_scr[:, :1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -90,7 +91,7 @@ def _decode_kernel_int8(scale: float, nk: int, block_k: int,
         preferred_element_type=jnp.float32,
     ) * ks[None, :] * scale                            # [g_pad, block_k]
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_k
-    s = jnp.where(cols < len_ref[0], s, NEG_INF)
+    s = jnp.where(cols < len_ref[pl.program_id(0)], s, NEG_INF)
 
     m_prev = m_scr[:, :1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -141,7 +142,10 @@ def _decode_call(kernel_fn, q, caches, cache_len, softmax_scale,
     if g_pad != group:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - group), (0, 0)))
 
-    lens = jnp.reshape(cache_len, (1,)).astype(jnp.int32)
+    # scalar fill → broadcast; [b] per-sample fills pass through (ragged
+    # speculative decoding) — the kernel indexes lens by the batch program
+    lens = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(cache_len, jnp.int32), (-1,)), (b,))
 
     grid = (b, kv_heads, nk)
     out = pl.pallas_call(

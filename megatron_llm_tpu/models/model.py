@@ -202,13 +202,21 @@ def forward_cached(
     else:
         cos, sin = rope
     b, s = tokens.shape
-    position_ids = (cache_len + jnp.arange(s, dtype=jnp.int32))[None, :]
-    position_ids = jnp.broadcast_to(position_ids, (b, s))
+    cache_len = jnp.asarray(cache_len, jnp.int32)
+    if cache_len.ndim == 1:
+        # per-sample fill levels (ragged speculative decoding): each
+        # sample's new tokens sit at its own positions
+        position_ids = cache_len[:, None] + jnp.arange(s, dtype=jnp.int32)
+    else:
+        position_ids = jnp.broadcast_to(
+            (cache_len + jnp.arange(s, dtype=jnp.int32))[None, :], (b, s))
     x = embed(cfg, params, tokens, position_ids)
 
     from ..kernels.decode_step import fused_decode_eligible
 
-    if fused_decode_eligible(cfg, params, k_cache, s, jax.default_backend()):
+    if (cache_len.ndim == 0
+            and fused_decode_eligible(cfg, params, k_cache, s,
+                                      jax.default_backend())):
         # single-token fast path: the whole stack in one Pallas kernel
         # (kernels/decode_step.py) — the caller-visible contract (returned
         # logits + updated caches) is identical to the composed path.

@@ -174,11 +174,28 @@ def make_causal_mask(seq_q: int, seq_k: int, dtype=jnp.float32) -> jax.Array:
     return jnp.where(keep, 0.0, -np.inf).astype(dtype)[None, None]
 
 
+def _decode_keep_mask(cache_len, s: int, max_len: int, group: int):
+    """[b or 1, group·s, max_len] keep-mask for decode attention.
+
+    ``cache_len`` is the absolute position of the new tokens' first row —
+    a scalar, or a [b] vector of per-sample fill levels (ragged
+    speculative decoding, generation/speculative.py)."""
+    cl = jnp.asarray(cache_len)
+    i = jnp.arange(s)
+    j = jnp.arange(max_len)
+    if cl.ndim == 0:
+        keep = j[None, :] <= (cl + i[:, None])          # [s, max_len]
+        return jnp.tile(keep, (group, 1))[None]
+    keep = j[None, None, :] <= (cl[:, None, None] + i[None, :, None])
+    return jnp.tile(keep, (1, group, 1))                # [b, g·s, max_len]
+
+
 def decode_attention(
     q: jax.Array,        # [b, s, n_heads, d] — the new tokens' queries
     k_cache,             # [b, kv_heads, max_len, d] head-major, updated —
     v_cache,             # or int8 {"q", "scale"} dicts (ops/kv_quant.py)
-    cache_len,           # scalar int32: absolute position of q's first token
+    cache_len,           # int32 scalar — or [b] per-sample fill levels —
+    #                      absolute position of q's first token
     *,
     softmax_scale: float | None = None,
 ) -> jax.Array:
@@ -229,11 +246,8 @@ def decode_attention(
             "bhqd,bhkd->bhqk", qg, k_cache["q"].astype(qg.dtype),
             preferred_element_type=jnp.float32)
         scores = scores * k_cache["scale"][:, :, None, :] * softmax_scale
-        i = jnp.arange(s)
-        j = jnp.arange(max_len)
-        keep = j[None, :] <= (cache_len + i[:, None])
-        keep = jnp.tile(keep, (group, 1))
-        scores = jnp.where(keep[None, None], scores, -jnp.inf)
+        keep = _decode_keep_mask(cache_len, s, max_len, group)
+        scores = jnp.where(keep[:, None], scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1)
         probs = (probs * v_cache["scale"][:, :, None, :]).astype(q.dtype)
         out = jnp.einsum("bhqk,bhkd->bhqd", probs,
@@ -265,11 +279,8 @@ def decode_attention(
                        (0, 2, 3, 1, 4)).reshape(b, kv_heads, group * s, d)
     scores = jnp.einsum("bhqd,bhkd->bhqk", qg, k_cache,
                         preferred_element_type=jnp.float32) * softmax_scale
-    i = jnp.arange(s)                       # query row offsets
-    j = jnp.arange(max_len)
-    keep = j[None, :] <= (cache_len + i[:, None])     # [s, max_len]
-    keep = jnp.tile(keep, (group, 1))                 # rows are (g, s) pairs
-    scores = jnp.where(keep[None, None], scores, -jnp.inf)
+    keep = _decode_keep_mask(cache_len, s, max_len, group)
+    scores = jnp.where(keep[:, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)  # [b, kv, g·s, d]
     out = jnp.transpose(out.reshape(b, kv_heads, group, s, d),

@@ -53,7 +53,16 @@ def cache_update(cache, rows, pos):
     """Write new-token ``rows`` [..., s, d] into ``cache`` at position
     ``pos`` along the -2 (sequence) axis.  Handles both plain arrays and
     quantized dicts — the single write point of the decode path
-    (models/transformer.py), so the representations can't drift."""
+    (models/transformer.py), so the representations can't drift.
+
+    ``pos`` may be a [batch] vector of per-sample fill levels (ragged
+    speculative decoding, generation/speculative.py): the write then
+    lands at each sample's own position via a vmap over the batch axis
+    (dims are [..., batch, kv, max_len, d], so batch = ndim-4)."""
+    if jnp.ndim(pos) == 1:
+        b_axis = rows.ndim - 4
+        return jax.vmap(cache_update, in_axes=(b_axis, b_axis, 0),
+                        out_axes=b_axis)(cache, rows, pos)
     nd = rows.ndim
     start = (0,) * (nd - 2) + (pos, 0)
     if is_quantized_cache(cache):

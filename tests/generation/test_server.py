@@ -113,8 +113,9 @@ def test_service_direct_multibatch():
 
 def test_service_speculative_greedy_matches_plain():
     """speculative="pld" must change only the wall-clock, not the output:
-    a greedy uniform-prompt request returns the same text as the plain
-    service, and non-greedy / ragged requests silently fall back."""
+    greedy requests (uniform OR ragged prompts) return the same text as
+    the plain service and are tagged "speculative": "pld"; non-greedy
+    requests fall back with a visible "fallback:<reason>" tag."""
     cfg = tiny_config(num_layers=1, vocab_size=256,
                       make_vocab_size_divisible_by=8)
     params = model_lib.init_params(jax.random.key(2), cfg)
@@ -128,18 +129,25 @@ def test_service_speculative_greedy_matches_plain():
     s2, o2 = spec.handle(dict(body))
     assert s1 == s2 == 200
     assert o1["text"] == o2["text"]
+    assert "speculative" not in o1
+    assert o2["speculative"] == "pld"
 
     # sampling request: must fall back to the standard loop (seeded →
-    # identical between the two services)
+    # identical between the two services), visibly tagged
     body = {"prompts": ["7 8 9 10"], "tokens_to_generate": 4,
             "top_k": 4, "random_seed": 3}
     s1, o1 = plain.handle(dict(body))
     s2, o2 = spec.handle(dict(body))
     assert s1 == s2 == 200
     assert o1["text"] == o2["text"]
+    assert o2["speculative"].startswith("fallback:")
 
-    # ragged prompts: eligibility check falls back, no error
+    # ragged prompts are served BY pld (per-sample acceptance) and still
+    # match the plain greedy loop exactly
     body = {"prompts": ["7 8 9", "10 11 12 13 14"],
             "tokens_to_generate": 4}
+    s1, o1 = plain.handle(dict(body))
     s2, o2 = spec.handle(dict(body))
-    assert s2 == 200 and len(o2["text"]) == 2
+    assert s1 == s2 == 200 and len(o2["text"]) == 2
+    assert o1["text"] == o2["text"]
+    assert o2["speculative"] == "pld"

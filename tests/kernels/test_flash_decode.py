@@ -40,3 +40,20 @@ def test_bf16_matches_fp32_reference():
                        jnp.int32(701), interpret=True)[:, None]
     np.testing.assert_allclose(np.asarray(got, np.float32),
                                np.asarray(want), rtol=0.05, atol=0.05)
+
+
+def test_per_sample_fill_levels_match_einsum():
+    """[b] per-sample cache fills (ragged speculative decoding): each
+    sample masks at its own level, matching the einsum path's vector
+    masking."""
+    b, heads, kv_heads, max_len, d = 3, 4, 2, 512, 128
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(b, 1, heads, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, kv_heads, max_len, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, kv_heads, max_len, d)), jnp.float32)
+    lens = jnp.asarray([17, 300, 511], jnp.int32)
+
+    want = decode_attention(q, k, v, lens)  # einsum path, vector mask
+    got = flash_decode(q[:, 0], k, v, lens + 1, interpret=True)[:, None]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

@@ -38,6 +38,38 @@ def test_cache_update_both_forms():
     assert float(jnp.abs(back[:, :, :3]).max()) == 0.0
 
 
+def test_cache_update_per_sample_positions():
+    """[b] vector fill levels: each sample's rows land at its own
+    position (ragged speculative decoding), for both cache forms and
+    both the per-layer (4-D) and stacked (5-D) ranks."""
+    g = np.random.default_rng(2)
+    pos = jnp.asarray([0, 5], jnp.int32)
+    # per-layer form [b, kv, max_len, d]
+    rows = jnp.asarray(g.normal(0, 1, (2, 4, 2, 64)), jnp.float32)
+    plain = jnp.zeros((2, 4, 16, 64), jnp.float32)
+    got = kv_quant.cache_update(plain, rows, pos)
+    np.testing.assert_array_equal(np.asarray(got[0, :, 0:2]),
+                                  np.asarray(rows[0]))
+    np.testing.assert_array_equal(np.asarray(got[1, :, 5:7]),
+                                  np.asarray(rows[1]))
+    assert float(jnp.abs(got[1, :, 0:5]).max()) == 0.0
+    # stacked form [L, b, kv, max_len, d]
+    rows5 = jnp.asarray(g.normal(0, 1, (3, 2, 4, 2, 64)), jnp.float32)
+    plain5 = jnp.zeros((3, 2, 4, 16, 64), jnp.float32)
+    got5 = kv_quant.cache_update(plain5, rows5, pos)
+    np.testing.assert_array_equal(np.asarray(got5[:, 0, :, 0:2]),
+                                  np.asarray(rows5[:, 0]))
+    np.testing.assert_array_equal(np.asarray(got5[:, 1, :, 5:7]),
+                                  np.asarray(rows5[:, 1]))
+    # quantized dict form
+    quant = kv_quant.init_quantized_cache((2, 4, 16, 64))
+    gotq = kv_quant.cache_update(quant, rows, pos)
+    back = kv_quant.dequantize_cache(gotq)
+    assert float(jnp.abs(back[0, :, 0:2] - rows[0]).max()) < 0.02
+    assert float(jnp.abs(back[1, :, 5:7] - rows[1]).max()) < 0.02
+    assert float(jnp.abs(back[1, :, 0:5]).max()) == 0.0
+
+
 def test_decode_attention_int8_matches_dequantized():
     """The scale-folded int8 einsum must equal attention over the
     explicitly dequantized cache (same math, different placement)."""
