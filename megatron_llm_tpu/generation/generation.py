@@ -54,7 +54,8 @@ def _generate_impl(cfg: ModelConfig, params, tokens, lengths, rng,
     # Prefill the common prompt prefix [0, min_prompt_len).
     logits, k_cache, v_cache = model_lib.forward_cached(
         cfg, params, tokens[:, :min_prompt_len], k_cache, v_cache,
-        jnp.int32(0), rope=rope)
+        jnp.int32(0), rope=rope, empty_cache=True,
+        last_logit_only=not return_logprobs)
     last_logits = logits[:, -1]
 
     logprob_buf = jnp.zeros((b, max_seq - 1), jnp.float32)
@@ -194,7 +195,7 @@ def _beam_search_impl(cfg: ModelConfig, params, prompt,  # [prompt_len]
     k_cache, v_cache = model_lib.init_kv_cache(cfg, k, max_seq)
     logits, k_cache, v_cache = model_lib.forward_cached(
         cfg, params, tokens[:, :prompt_len], k_cache, v_cache, jnp.int32(0),
-        rope=rope)
+        rope=rope, empty_cache=True, last_logit_only=True)
     last_logits = logits[:, -1]
 
     # Alive beams: running sum of log-probs.  At the first expansion only

@@ -137,9 +137,9 @@ def _pld_impl(cfg: ModelConfig, params, tokens, lengths, *,
     # order before the fill ever reaches them.
     logits, k_cache, v_cache = model_lib.forward_cached(
         cfg, params, tokens[:, :max_prompt_len], k_cache, v_cache,
-        jnp.int32(0), rope=rope)
-    last_logits = jnp.take_along_axis(
-        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+        jnp.int32(0), rope=rope, empty_cache=True,
+        logit_rows=lengths - 1)
+    last_logits = logits[:, 0]
 
     cur = lengths                              # [b] per-sample fill
     done = jnp.zeros((b,), jnp.bool_)

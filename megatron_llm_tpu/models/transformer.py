@@ -158,6 +158,11 @@ class AttnSideInputs:
     # can't express.  Forces the einsum attention path (a bias rules out the
     # flash kernel's implicit-mask layout).
     attn_bias: Optional[jax.Array] = None
+    # STATIC promise that the KV cache holds no valid rows yet (first
+    # prefill): cached attention then runs ordinary causal attention over
+    # the window (flash kernel) instead of contracting against the whole
+    # cache buffer (model.py:forward_cached(empty_cache=True)).
+    cache_is_empty: bool = False
 
 
 def seq_constrain(x: jax.Array, axes: tuple):
@@ -270,10 +275,26 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
         new_v = jnp.transpose(v, (0, 2, 1, 3))
         k_cache = cache_update(k_cache, new_k, cache_len)
         v_cache = cache_update(v_cache, new_v, cache_len)
-        ctx = decode_attention(
-            q, k_cache, v_cache, cache_len,
-            softmax_scale=softmax_scale,
-        )
+        if side.cache_is_empty and s > 1:
+            # prefill fast path: no prior rows to attend, so this is
+            # ordinary causal attention over the window — the flash
+            # kernel at O(s²) instead of the cached-score einsum at
+            # O(s·max_len) (which at s=1024, max_len=1152 materialized
+            # ~300 MB of scores per layer: measured 30.9k tok/s prefill
+            # vs ~4x that through this path on v5e)
+            ctx = attention(
+                q, k, v,
+                impl=cfg.attention_impl,
+                causal=True,
+                softmax_scale=softmax_scale,
+                block_q=cfg.flash_block_q,
+                block_k=cfg.flash_block_k,
+            )
+        else:
+            ctx = decode_attention(
+                q, k_cache, v_cache, cache_len,
+                softmax_scale=softmax_scale,
+            )
     else:
         ctx = attention(
             q, k, v,

@@ -170,3 +170,20 @@ def test_transient_error_retried(monkeypatch):
 
     assert bench._retry(flaky) == "ok"
     assert len(calls) == 2
+
+
+def test_unreachable_device_yields_structured_record(monkeypatch, capsys):
+    """A wedged accelerator tunnel must produce ONE parseable JSON error
+    record and exit 1 — not a stack trace (the round-3 driver failure)."""
+    def probe():
+        raise TimeoutError("device probe exceeded 240s")
+
+    monkeypatch.setattr(bench, "_detect_device", probe)
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 1
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if not l.startswith("#")]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["value"] is None and "TimeoutError" in rec["error"]
