@@ -434,6 +434,13 @@ def _retry(fn, *args, **kw):
 _CHILD_MARK = "##BENCH_POINT##"
 
 
+def _relay_progress(text: str) -> None:
+    """Forward a child's '#'-prefixed progress lines to our stdout."""
+    for line in text.splitlines():
+        if line.startswith("#") and not line.startswith(_CHILD_MARK):
+            print(line, flush=True)
+
+
 def _child_main(spec_json: str) -> None:
     spec = json.loads(spec_json)
     platform = spec["platform"]
@@ -478,15 +485,11 @@ def _point(label: str, spec: dict, timeout_s: int = 900):
         partial = e.stdout or b""
         if isinstance(partial, bytes):
             partial = partial.decode(errors="replace")
-        for line in partial.splitlines():
-            if line.startswith("#") and not line.startswith(_CHILD_MARK):
-                print(line, flush=True)
+        _relay_progress(partial)
         print(f"# bench point {label} TIMED OUT after {timeout_s}s",
               flush=True)
         return None
-    for line in (proc.stdout or "").splitlines():
-        if line.startswith("#") and not line.startswith(_CHILD_MARK):
-            print(line, flush=True)
+    _relay_progress(proc.stdout or "")
     if proc.returncode != 0:
         tail = (proc.stderr or "").strip().splitlines()[-1:] or ["?"]
         print(f"# bench point {label} FAILED (rc={proc.returncode}): "

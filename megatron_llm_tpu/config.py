@@ -155,10 +155,13 @@ class ModelConfig:
     # The TPU analogue of the reference's optional TransformerEngine FP8
     # (megatron/model/transformer.py:932-951, off by default there too).
     # Measured on v5e (2026-07-31): ~parity with bf16 at 7B-width
-    # (23.9k vs 23.6k tok/s, full remat — the cheaper replay matmuls
-    # offset the quantize overhead) but a net loss at 374M (0.477 vs
-    # 0.53 MFU); prefer it only where activation-memory pressure or
-    # future wider-matmul shapes favor the 2x int8 MXU peak.  Note the
+    # (23.9k vs 23.6k tok/s) and a net loss at 374M (0.477 vs 0.53 MFU).
+    # Round-5 decomposition (docs/perf_notes.md §2) shows parity is a
+    # measured CEILING of this design, not tuning debt: XLA's int8 MXU
+    # dot reaches 1.35x bf16 (not the 2x nameplate), dynamic
+    # quantization costs ~85% of a dot standalone, and the TE-style
+    # unquantized backward (2/3 of FLOPs) caps the step at <=1.13x.
+    # Prefer the flag only under activation-memory pressure.  Note the
     # int8 dots escape the "selective" remat policy as int32 saveables —
     # pair with recompute="full" at memory-tight shapes.
     # ops/quant.py:int8_training_matmul.

@@ -249,7 +249,7 @@ def forward_cached(
     else:
         side = AttnSideInputs(rope_cos=cos, rope_sin=sin,
                               position_ids=position_ids, deterministic=True,
-                              cache_is_empty=empty_cache and s > 1)
+                              cache_is_empty=empty_cache)
         x, new_k, new_v = stack_forward_cached(
             cfg, params["layers"], x, side, k_cache, v_cache, cache_len)
     x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps,

@@ -220,26 +220,36 @@ def test_int8_decode_speedup_and_parity():
         return b * gen_len / (time.perf_counter() - t0)
 
     # Tunnel latency drifts minute-to-minute (observed 1.7k-3.3k tok/s for
-    # the SAME bf16 program across runs) — interleave the two configs and
-    # take best-of-3 each, so drift hits both alike.
-    out_bf16 = warm(cfg, params)
-    out_int8 = warm(qcfg, qparams)  # int8 weights + int8 cache
-    bf16_trials, int8_trials = [], []
+    # the SAME bf16 program across runs) — interleave the configs and
+    # take best-of-3 each, so drift hits both alike.  The int8 byte-
+    # savings comparison is against the COMPOSED bf16 path (int8 has no
+    # fused decode-step kernel yet, so fused bf16 legitimately beats it —
+    # measured 0.70x at this horizon after round 5's kernel landed).
+    ccfg = dataclasses.replace(cfg, fused_decode=False).validate()
+    out_bf16 = warm(cfg, params)            # fused kernel path
+    out_comp = warm(ccfg, params)           # composed bf16 path
+    out_int8 = warm(qcfg, qparams)          # int8 weights + int8 cache
+    del out_comp
+    bf16_trials, comp_trials, int8_trials = [], [], []
     for _ in range(3):
         bf16_trials.append(timed(cfg, params))
+        comp_trials.append(timed(ccfg, params))
         int8_trials.append(timed(qcfg, qparams))
     tps_bf16 = max(bf16_trials)
+    tps_comp = max(comp_trials)
     tps_int8 = max(int8_trials)
-    print(f"decode tok/s: bf16={tps_bf16:.0f} int8={tps_int8:.0f} "
-          f"({tps_int8 / tps_bf16:.2f}x)")
-    # throughput: int8 must not CATASTROPHICALLY regress — e.g. the kernel
-    # silently falling back to a several-x-slower path.  Best-of-3 through
-    # the tunnel still jitters ~10-15% (bf16 itself measured 1.7k-3.3k
-    # tok/s across clean runs), so the gate is deliberately coarse;
-    # clean-run ratios span ~1.0x at this 256-token horizon to 1.7-1.8x at
-    # the bench's 512-token horizon where cache reads matter more
-    # (BENCH_SELF_r04.json).
-    assert tps_int8 >= 0.85 * tps_bf16, (tps_bf16, tps_int8)
+    print(f"decode tok/s: fused bf16={tps_bf16:.0f} "
+          f"composed bf16={tps_comp:.0f} int8={tps_int8:.0f} "
+          f"(int8/composed {tps_int8 / tps_comp:.2f}x)")
+    # int8 must not CATASTROPHICALLY regress vs the path it actually
+    # shares (composed) — e.g. the kernel silently falling back to a
+    # several-x-slower path.  Coarse gate: tunnel jitter is ~10-15%;
+    # clean-run ratios span ~1.0x at this 256-token horizon to 1.7-1.8x
+    # at the 512-token horizon where cache reads matter more.
+    assert tps_int8 >= 0.85 * tps_comp, (tps_comp, tps_int8)
+    # and the fused kernel must actually be engaged and winning: it
+    # measured 2.4x the composed path in-loop; 1.3x is the coarse floor
+    assert tps_bf16 >= 1.3 * tps_comp, (tps_bf16, tps_comp)
 
     # fidelity: compare the Pallas int8 decode KERNEL against the einsum
     # int8 path on the SAME quantized cache — deterministic, isolates
