@@ -397,6 +397,26 @@ def _prefill_point(peak: float):
     }
 
 
+def _serving_point():
+    """Continuous-batching serving throughput (megatron_llm_tpu/serving/):
+    24 concurrent requests over 8 KV slots → requests/s, aggregate decode
+    tokens/s, mean/p95 per-token latency, TTFT, and the max per-iteration
+    decode batch.  Unlike the one-shot decode row (a single fixed batch in
+    one jitted loop), this pays per-iteration host scheduling — the number
+    a real traffic mix gets from the engine the REST server now runs."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.serving.bench import run_serving_bench
+
+    prompt_len, gen_len = 128, 128
+    cfg = _bench_model(prompt_len + gen_len, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return run_serving_bench(cfg, params, num_requests=24,
+                             prompt_len=prompt_len, gen_len=gen_len,
+                             slots=8)
+
+
 def _transient_error_types():
     """The error classes worth retrying: the axon-tunneled compile service
     occasionally throws a transient remote-compile XlaRuntimeError.
@@ -457,6 +477,8 @@ def _child_main(spec_json: str) -> None:
         out = _retry(_pld_point, spec.get("wide_layers", 0))
     elif kind == "prefill":
         out = _retry(_prefill_point, peak)
+    elif kind == "serving":
+        out = _retry(_serving_point)
     else:  # pragma: no cover - parent and child ship together
         raise ValueError(f"unknown point kind {kind!r}")
     print(_CHILD_MARK + json.dumps(out), flush=True)
@@ -606,6 +628,8 @@ def main() -> None:
                      "wide_layers": 8}, timeout_s=1200)
     prefill_long = _point("prefill@1024", {"kind": "prefill",
                                            "platform": platform})
+    serving = _point("serving", {"kind": "serving", "platform": platform},
+                     timeout_s=1200)
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     record = {
@@ -638,6 +662,8 @@ def main() -> None:
         record["pld_7b_width"] = pld_7b
     if prefill_long is not None:
         record.update(prefill_long)
+    if serving is not None:
+        record["serving"] = serving
     if headline is not None:
         record.update({
             "value": round(mfu, 4),

@@ -5,15 +5,33 @@ Parity with the reference's Flask ``MegatronServer``
 with ``prompts`` plus sampling knobs, returns ``{"text", "segments",
 "logprobs"}`` (or beam-search results when ``beam_width`` is set), with the
 same field validation and error strings.  Flask is not available in this
-image, so the server is built on the stdlib ``http.server`` —
-a ``ThreadingHTTPServer`` with a request lock, which also replaces the
-reference's rank-0 ``send_do_generate`` fan-out (one SPMD process, no
-controller choreography).
+image, so the server is built on the stdlib ``http.server``
+(``ThreadingHTTPServer``) — one SPMD process, no rank-0
+``send_do_generate`` controller choreography.
+
+Generation requests no longer serialize behind a global lock: they submit
+to the continuous-batching engine (megatron_llm_tpu/serving/, see
+docs/serving.md), which interleaves concurrent requests at decode-iteration
+granularity over a slot-managed KV cache.  Consequences for the HTTP
+contract:
+
+- any number of prompts per request is accepted (the old hard
+  ``400 "Maximum number of prompts is N"`` is gone) — prompts beyond the
+  free slots simply queue and join the running batch as slots free up;
+- ``400`` remains only for a prompt whose length + ``tokens_to_generate``
+  exceeds the per-slot sequence budget;
+- when the bounded queue is full the server answers ``503`` with a
+  ``Retry-After`` hint instead of blocking the HTTP thread.
+
+Beam search and scoring (``tokens_to_generate=0``) keep the legacy
+one-shot path behind the lock — they run as dedicated jitted programs, not
+the slot decode loop.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -23,6 +41,7 @@ from ..tokenizer.tokenizer import Tokenizer
 from .api import (
     beam_search_and_post_process,
     generate_and_post_process,
+    pld_eligible,
     score_and_post_process,
 )
 
@@ -33,18 +52,54 @@ class GenerationService:
 
     def __init__(self, cfg: ModelConfig, params, tokenizer: Tokenizer,
                  max_batch_size: int = 8, max_tokens_to_generate: int = 1024,
-                 speculative: str | None = None):
+                 speculative: str | None = None,
+                 engine=None, queue_size: int = 32,
+                 engine_max_seq_len: int | None = None,
+                 retry_after_s: float = 1.0):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
+        # max_batch_size now sizes the engine's KV slots (max CONCURRENT
+        # decodes), not a per-request prompt-count cap
         self.max_batch_size = max_batch_size
         self.max_tokens_to_generate = max_tokens_to_generate
         # "pld": greedy requests (ragged prompts included) run
         # prompt-lookup speculative decoding (generation/speculative.py);
-        # ineligible requests use the standard loop, and the response's
-        # "speculative" field says which path served it.
+        # ineligible requests use the continuous-batching engine, and the
+        # response's "speculative" field says which path served it.
         self.speculative = speculative
-        self.lock = threading.Lock()  # one generation at a time (ref :21)
+        self.queue_size = queue_size
+        self.engine_max_seq_len = min(
+            engine_max_seq_len or cfg.max_position_embeddings,
+            cfg.max_position_embeddings)
+        self.retry_after_s = retry_after_s
+        # the lock now guards only the legacy one-shot paths (beam search,
+        # scoring, PLD); standard generation goes through the engine
+        self.lock = threading.Lock()
+        self._engine = engine
+        self._engine_init_lock = threading.Lock()
+
+    @property
+    def engine(self):
+        """The continuous-batching engine, created lazily so beam/score-only
+        services never allocate the slot cache."""
+        with self._engine_init_lock:
+            if self._engine is None:
+                from ..serving import EngineConfig, ServingEngine
+
+                self._engine = ServingEngine(
+                    self.cfg, self.params,
+                    EngineConfig(max_batch_size=self.max_batch_size,
+                                 max_seq_len=self.engine_max_seq_len,
+                                 max_queue_size=self.queue_size,
+                                 retry_after_s=self.retry_after_s))
+            return self._engine
+
+    def close(self) -> None:
+        with self._engine_init_lock:
+            if self._engine is not None:
+                self._engine.shutdown()
+                self._engine = None
 
     def handle(self, body: dict) -> tuple[int, dict | str]:
         """Returns (http_status, response_json_or_error_string).
@@ -64,8 +119,8 @@ class GenerationService:
             return 400, "prompts is not a list of strings"
         if len(prompts) == 0:
             return 400, "prompts is empty"
-        if len(prompts) > self.max_batch_size:
-            return 400, f"Maximum number of prompts is {self.max_batch_size}"
+        # No per-request prompt-count cap: prompts beyond the free KV slots
+        # queue in the engine and join the running batch as slots free up.
 
         tokens_to_generate = body.get("tokens_to_generate", 64)
         if not isinstance(tokens_to_generate, int) or \
@@ -126,9 +181,9 @@ class GenerationService:
         stop_token = body.get("stop_token", None)
         length_penalty = body.get("length_penalty", 1.0)
 
-        with self.lock:
-            try:
-                if beam_width is not None:
+        if beam_width is not None:
+            with self.lock:
+                try:
                     res = beam_search_and_post_process(
                         self.cfg, self.params, self.tokenizer, prompts[0],
                         tokens_to_generate=tokens_to_generate,
@@ -140,31 +195,120 @@ class GenerationService:
                     return 200, {"text": res.texts,
                                  "segments": res.segments,
                                  "scores": res.scores}
-                if tokens_to_generate == 0:
+                except ValueError as e:
+                    return 400, str(e)
+        if tokens_to_generate == 0:
+            with self.lock:
+                try:
                     res = score_and_post_process(
                         self.cfg, self.params, self.tokenizer, prompts)
                     return 200, {"text": res.texts,
                                  "logprobs": res.logprobs}
-                res = generate_and_post_process(
-                    self.cfg, self.params, self.tokenizer, prompts,
-                    tokens_to_generate=tokens_to_generate,
-                    return_output_log_probs=logprobs,
-                    return_segments=True,
-                    top_k_sampling=top_k, top_p_sampling=top_p,
-                    temperature=temperature, add_BOS=add_BOS,
-                    use_eod_token_for_early_termination=not no_early_term,
-                    random_seed=random_seed,
-                    speculative=self.speculative)
-                resp = {"text": res.texts,
-                        "segments": res.segments,
-                        "logprobs": res.logprobs}
-                if res.speculative is not None:
-                    # surface PLD-vs-fallback so clients can see when the
-                    # requested speculative path did not serve them
-                    resp["speculative"] = res.speculative
-                return 200, resp
-            except ValueError as e:
-                return 400, str(e)
+                except ValueError as e:
+                    return 400, str(e)
+        return self._handle_generate(
+            prompts, tokens_to_generate, logprobs=logprobs, top_k=top_k,
+            top_p=top_p, temperature=temperature, add_BOS=add_BOS,
+            use_eos_stop=not no_early_term, random_seed=random_seed)
+
+    def _handle_generate(self, prompts, tokens_to_generate, *, logprobs,
+                         top_k, top_p, temperature, add_BOS, use_eos_stop,
+                         random_seed):
+        """Standard generation through the continuous-batching engine.
+
+        Keeps the legacy batch contract: the shared buffer is
+        ``max(prompt_len) + tokens_to_generate``, so in a ragged batch the
+        shorter prompts may generate extra tokens (exactly what the
+        one-shot path produced).
+        """
+        # -- tokenize (parity: api.tokenize_prompts, per prompt) ----------
+        try:
+            ids = []
+            for p in prompts:
+                t = self.tokenizer.tokenize(p)
+                if add_BOS and self.tokenizer.bos is not None:
+                    t = [self.tokenizer.bos] + t
+                if len(t) == 0:
+                    raise ValueError(
+                        "a prompt tokenized to zero tokens (empty prompt "
+                        "with a BOS-less tokenizer?)")
+                ids.append(t)
+        except ValueError as e:
+            return 400, str(e)
+        lengths = [len(t) for t in ids]
+        total_budget = max(lengths) + tokens_to_generate
+        # 400 only for the sequence budget (satellite contract): the
+        # engine's per-slot cache width and the model's positions
+        budget = min(self.engine_max_seq_len,
+                     self.cfg.max_position_embeddings)
+        if total_budget > budget:
+            return 400, (f"prompt + tokens_to_generate = {total_budget} "
+                         f"exceeds the sequence budget = {budget}")
+
+        spec_tag = None
+        if self.speculative == "pld":
+            ok, reason = pld_eligible("pld", top_k, top_p, logprobs,
+                                      lengths)
+            if ok:
+                # PLD's multi-token verify loop is its own jitted program;
+                # eligible requests keep it (legacy one-shot path)
+                with self.lock:
+                    try:
+                        res = generate_and_post_process(
+                            self.cfg, self.params, self.tokenizer, prompts,
+                            tokens_to_generate=tokens_to_generate,
+                            return_output_log_probs=logprobs,
+                            return_segments=True,
+                            top_k_sampling=top_k, top_p_sampling=top_p,
+                            temperature=temperature, add_BOS=add_BOS,
+                            use_eod_token_for_early_termination=use_eos_stop,
+                            random_seed=random_seed,
+                            speculative="pld")
+                    except ValueError as e:
+                        return 400, str(e)
+                return 200, {"text": res.texts, "segments": res.segments,
+                             "logprobs": res.logprobs,
+                             "speculative": res.speculative}
+            spec_tag = f"fallback:{reason}"
+
+        # -- submit to the engine (all-or-nothing) ------------------------
+        from ..serving import QueueFull
+
+        specs = []
+        for i, t in enumerate(ids):
+            specs.append(dict(
+                prompt=t,
+                max_new_tokens=total_budget - len(t),
+                eos_id=self.tokenizer.eod,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=(None if random_seed < 0 else random_seed + i),
+                use_eos_stop=use_eos_stop, return_logprobs=logprobs))
+        try:
+            handles = self.engine.submit_many(specs)
+        except QueueFull as e:
+            return 503, {"message": str(e),
+                         "retry_after": int(math.ceil(e.retry_after_s))}
+        except ValueError as e:
+            return 400, str(e)
+        try:
+            results = [h.result() for h in handles]
+        except RuntimeError as e:
+            return 500, str(e)
+
+        texts, segments, lps = [], [], []
+        for r in results:
+            texts.append(self.tokenizer.detokenize(r.tokens))
+            segments.append(
+                [self.tokenizer.detokenize([t]) for t in r.tokens])
+            if logprobs:
+                lps.append(r.logprobs)
+        resp = {"text": texts, "segments": segments,
+                "logprobs": lps if logprobs else None}
+        if spec_tag is not None:
+            # surface PLD-vs-fallback so clients can see when the
+            # requested speculative path did not serve them
+            resp["speculative"] = spec_tag
+        return 200, resp
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -183,6 +327,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if status == 503 and isinstance(payload, dict) \
+                and "retry_after" in payload:
+            # bounded-queue backpressure: tell the client when to come back
+            self.send_header("Retry-After", str(payload["retry_after"]))
         self.end_headers()
         self.wfile.write(body)
 
@@ -233,3 +381,4 @@ class MegatronServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        self.service.close()

@@ -287,6 +287,36 @@ def init_kv_cache(cfg: ModelConfig, batch_size: int, max_len: int,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+def cache_slot_update(cache, slot_cache, slot):
+    """Write a single-sequence cache (batch axis 1 of size 1) into batch
+    slot ``slot`` of a larger cache of identical layout.
+
+    The serving engine (megatron_llm_tpu/serving/) prefills each admitted
+    request into its own ``[L, 1, kv_heads, max_len, d]`` cache, then
+    splices it into the long-lived ``[L, slots, ...]`` batch cache here —
+    the whole slot is replaced, so stale rows from the slot's previous
+    occupant can never leak into attention.  Handles both the plain-array
+    cache and the int8 ``{"q", "scale"}`` pytree (ops/kv_quant.py): every
+    leaf carries the batch on axis 1.
+    """
+    slot = jnp.asarray(slot, jnp.int32)
+
+    def upd(big, small):
+        start = (jnp.int32(0), slot) + (jnp.int32(0),) * (big.ndim - 2)
+        return jax.lax.dynamic_update_slice(
+            big, small.astype(big.dtype), start)
+
+    return jax.tree.map(upd, cache, slot_cache)
+
+
+def cache_slot_read(cache, slot):
+    """Extract batch slot ``slot`` as a batch-1 cache (inverse of
+    ``cache_slot_update``; used by slot-allocator tests)."""
+    slot = jnp.asarray(slot, jnp.int32)
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_slice_in_dim(a, slot, 1, axis=1), cache)
+
+
 def num_params(params: Params) -> int:
     return sum(p.size for p in jax.tree.leaves(params))
 

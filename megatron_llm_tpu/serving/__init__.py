@@ -1,0 +1,32 @@
+"""Continuous-batching serving engine (see docs/serving.md).
+
+- ``engine.py`` — iteration-level scheduler: admission, batched decode,
+  retirement, per-request streaming and cancellation.
+- ``slots.py`` — KV-slot allocator over one long-lived fixed-shape cache.
+- ``queue.py`` — bounded admission queue with backpressure (``QueueFull``).
+- ``metrics.py`` — serving counters / gauges / latency histograms.
+- ``bench.py`` — serving-throughput measurement (requests/s, token
+  latency), consumed by the repo-level ``bench.py``.
+"""
+
+from .engine import (
+    EngineConfig,
+    FinishedRequest,
+    RequestHandle,
+    ServingEngine,
+)
+from .metrics import LatencyHistogram, ServingMetrics
+from .queue import QueueFull, RequestQueue
+from .slots import SlotAllocator
+
+__all__ = [
+    "EngineConfig",
+    "FinishedRequest",
+    "LatencyHistogram",
+    "QueueFull",
+    "RequestHandle",
+    "RequestQueue",
+    "ServingEngine",
+    "ServingMetrics",
+    "SlotAllocator",
+]

@@ -1,0 +1,566 @@
+"""Continuous-batching serving engine: Orca-style iteration-level scheduling
+over a slot-managed KV cache.
+
+The REST server used to admit exactly one generation at a time behind a
+global lock, so decode throughput never aggregated across concurrent
+users.  This engine replaces that: a single scheduler thread owns a
+long-lived batch KV cache (``slots.py``) and interleaves, at iteration
+granularity,
+
+1. **admission** — while a KV slot is free and the bounded queue
+   (``queue.py``) has work, prefill the next request's prompt into its own
+   batch-1 cache (one jitted forward, prompt length padded up to
+   ``prefill_bucket`` so compilations stay bounded) and splice it into the
+   free slot;
+2. **one batched decode step** — a single jitted forward over ALL active
+   slots with the per-sample fill vector ``forward_cached`` already
+   supports (the ragged machinery built for prompt-lookup speculative
+   decoding), plus per-slot sampling: greedy mask, temperature, top-k
+   (dynamic rank mask), top-p, and a per-request RNG stream folded on the
+   request's own generated-token counter — so a request samples the same
+   trajectory regardless of which slot it lands in or who shares the
+   batch;
+3. **retirement** — requests leave the moment they hit EOS or their token
+   budget (or are cancelled); the slot returns to the free list with no
+   device work, because rows past a slot's fill level are already masked.
+
+Free slots still ride through the decode step (fixed shapes keep ONE
+compiled executable); their writes land at row fill=0 of a free slot and
+are fully overwritten by the next admission's whole-slot insert.
+
+The scheduler fetches each step's sampled tokens to the host — that sync
+is what makes iteration-level scheduling possible (join/leave decisions
+every token), and its ~1 ms dispatch latency on TPU is amortized across
+every active slot, which is exactly the aggregation the old lock threw
+away.  Per-request streaming callbacks fire from the scheduler thread.
+
+Greedy requests reproduce the one-shot ``generation.generate_tokens``
+trajectory token-for-token (tested bitwise on CPU fp32, the same
+equivalence bar the PLD path meets).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..generation.sampling import NEG_INF
+from ..models import model as model_lib
+from .metrics import ServingMetrics
+from .queue import QueueFull, RequestQueue  # noqa: F401  (re-exported)
+from .slots import SlotAllocator
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Tuning knobs (documented in docs/serving.md)."""
+    max_batch_size: int = 8       # KV slots = max concurrent requests
+    max_seq_len: int = 1024       # per-slot cache width (prompt + generation)
+    max_queue_size: int = 32      # bounded admission queue
+    prefill_bucket: int = 1       # pad prompt lengths up to a multiple of
+    #                               this before the prefill forward: larger
+    #                               buckets bound the number of compiled
+    #                               prefill shapes; 1 = exact lengths
+    retry_after_s: float = 1.0    # backpressure hint surfaced on QueueFull
+    idle_wait_s: float = 0.02     # scheduler sleep when idle / paused
+
+
+@dataclasses.dataclass
+class FinishedRequest:
+    tokens: List[int]             # prompt + generated (EOS included)
+    prompt_len: int
+    finish_reason: str            # "eos" | "length" | "cancelled" | "error"
+    logprobs: Optional[List[float]] = None  # [len-1] incl. prompt positions
+
+
+class _Request:
+    """Internal request record; the public face is ``RequestHandle``."""
+
+    _ids = iter(range(1, 1 << 62))
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int, *,
+                 eos_id: int = 2, temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 0.0, seed: Optional[int] = None,
+                 use_eos_stop: bool = True, return_logprobs: bool = False,
+                 on_token: Optional[Callable[[int], None]] = None):
+        self.id = next(self._ids)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = int(eos_id)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.greedy = top_k == 0 and top_p == 0.0
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
+        self.seed = int(seed) & 0xFFFFFFFF
+        self.use_eos_stop = bool(use_eos_stop)
+        self.return_logprobs = bool(return_logprobs)
+        self.on_token = on_token
+
+        self.generated: List[int] = []
+        self.logprobs: List[float] = []
+        self.cancel_flag = threading.Event()
+        self.done_event = threading.Event()
+        self.result: Optional[FinishedRequest] = None
+        self.submit_time = time.perf_counter()
+        self.first_token_time: Optional[float] = None
+
+
+class RequestHandle:
+    """Client-side view of a submitted request."""
+
+    def __init__(self, req: _Request, engine: "ServingEngine"):
+        self._req = req
+        self._engine = engine
+
+    @property
+    def request_id(self) -> int:
+        return self._req.id
+
+    def done(self) -> bool:
+        return self._req.done_event.is_set()
+
+    def cancel(self) -> None:
+        """Ask the scheduler to drop the request at the next iteration
+        boundary (or immediately if it is still queued)."""
+        self._engine._cancel(self._req)
+
+    def result(self, timeout: Optional[float] = None) -> FinishedRequest:
+        if not self._req.done_event.wait(timeout):
+            raise TimeoutError(
+                f"request {self._req.id} not finished within {timeout}s")
+        assert self._req.result is not None
+        if self._req.result.finish_reason == "error":
+            raise RuntimeError(
+                "serving engine scheduler failed: "
+                f"{self._engine._scheduler_error!r}")
+        return self._req.result
+
+
+# ---------------------------------------------------------------------------
+# Jitted steps
+# ---------------------------------------------------------------------------
+
+
+def _sample_slots(logits, seeds, counters, greedy, temps, top_ks, top_ps,
+                  vocab: int):
+    """Per-slot mixed-mode sampling over ``[S, V]`` logits.
+
+    Unlike ``sampling.sample_with_mode`` (static mode / static top_k for
+    the whole batch), every slot here carries its own knobs as traced
+    vectors, so one compiled decode step serves any mix of requests:
+    - greedy slots take the padded-vocab-masked argmax (identical to the
+      one-shot loop's greedy mode);
+    - top-k is a dynamic rank mask (rank-of-logit >= k_i -> -inf), the
+      vectorized equivalent of ``lax.top_k`` thresholding;
+    - top-p reuses the nucleus filter's traced-threshold core with a
+      per-slot p (p<=0 -> keep everything);
+    - randomness is a per-REQUEST stream: key(seed_i) folded on the
+      request's own generated-token counter, so a request's trajectory is
+      independent of slot placement and batch composition.
+    """
+    S, V = logits.shape
+    pad = jnp.arange(V) >= vocab
+    logits = jnp.where(pad[None, :], NEG_INF, logits)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    # dynamic per-slot top-k: rank 0 = largest
+    ranks = jnp.argsort(jnp.argsort(-scaled, axis=-1), axis=-1)
+    kmask = (top_ks[:, None] > 0) & (ranks >= top_ks[:, None])
+    scaled = jnp.where(kmask, NEG_INF, scaled)
+    # per-slot top-p (inline nucleus filter with a [S, 1] threshold)
+    p_eff = jnp.where(top_ps > 0.0, top_ps, 1.0)[:, None]
+    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    remove_sorted = (cum - sorted_probs) > p_eff
+    kept = jnp.where(remove_sorted, jnp.inf, sorted_logits)
+    threshold = jnp.min(kept, axis=-1, keepdims=True)
+    scaled = jnp.where(scaled < threshold, NEG_INF, scaled)
+
+    keys = jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.key(s), c))(seeds,
+                                                               counters)
+    sampled = jax.vmap(
+        lambda row, key: jax.random.categorical(key, row))(scaled, keys)
+    tok = jnp.where(greedy, greedy_tok, sampled.astype(jnp.int32))
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    tok_lp = jnp.take_along_axis(lp, tok[:, None], axis=-1)[:, 0]
+    return tok, tok_lp
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_seq_len", "want_logprobs"))
+def _prefill_impl(cfg: ModelConfig, params, tokens, length, *,
+                  max_seq_len: int, want_logprobs: bool):
+    """Prefill one request (batch 1, possibly bucket-padded prompt) into a
+    fresh batch-1 cache.  Rows past ``length`` hold pad-token K/V, but the
+    slot's fill level masks them and committed tokens overwrite them in
+    order before the fill ever reaches them (the PLD ragged-prefill
+    argument, generation/speculative.py)."""
+    rope = model_lib.rope_tables(cfg)
+    k, v = model_lib.init_kv_cache(cfg, 1, max_seq_len)
+    if want_logprobs:
+        logits, k, v = model_lib.forward_cached(
+            cfg, params, tokens, k, v, jnp.int32(0), rope=rope,
+            empty_cache=True)
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            lp[:, :-1], tokens[:, 1:, None], axis=-1)[..., 0]  # [1, L-1]
+        last = jnp.take_along_axis(
+            logits, (length - 1)[:, None, None], axis=1)[:, 0]
+        return last, picked, k, v
+    logits, k, v = model_lib.forward_cached(
+        cfg, params, tokens, k, v, jnp.int32(0), rope=rope,
+        empty_cache=True, logit_rows=length - 1)
+    return logits[:, 0], None, k, v
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _first_token_impl(cfg: ModelConfig, last_logits, seeds, counters,
+                      greedy, temps, top_ks, top_ps):
+    return _sample_slots(last_logits, seeds, counters, greedy, temps,
+                         top_ks, top_ps, cfg.vocab_size)
+
+
+def _decode_impl(cfg: ModelConfig, params, k_cache, v_cache, pending,
+                 fills, seeds, counters, greedy, temps, top_ks, top_ps):
+    """One batched decode step over every slot: feed each slot's pending
+    token at its own fill position, append its K/V row, sample the next
+    token per slot.  Free slots ride along (fixed shapes = one compiled
+    executable); their row-0 writes are masked and replaced at the next
+    admission."""
+    rope = model_lib.rope_tables(cfg)
+    logits, k_cache, v_cache = model_lib.forward_cached(
+        cfg, params, pending[:, None], k_cache, v_cache, fills, rope=rope)
+    tok, tok_lp = _sample_slots(logits[:, 0], seeds, counters, greedy,
+                                temps, top_ks, top_ps, cfg.vocab_size)
+    return tok, tok_lp, k_cache, v_cache
+
+
+_decode_donated = functools.partial(
+    jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3))(_decode_impl)
+_decode_plain = functools.partial(
+    jax.jit, static_argnames=("cfg",))(_decode_impl)
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+class _SlotState:
+    """Host-side per-slot bookkeeping (device state lives in SlotAllocator)."""
+
+    def __init__(self, req: _Request, fill: int, pending: int):
+        self.req = req
+        self.fill = fill          # cache rows committed for this slot
+        self.pending = pending    # sampled token not yet fed to the model
+
+
+class ServingEngine:
+    """Continuous-batching engine over a fixed set of KV slots.
+
+    ``submit`` / ``submit_many`` are thread-safe and non-blocking (they
+    raise ``QueueFull`` under backpressure); all device work happens on
+    the single scheduler thread.
+    """
+
+    def __init__(self, cfg: ModelConfig, params,
+                 engine_config: Optional[EngineConfig] = None,
+                 metrics: Optional[ServingMetrics] = None):
+        self.cfg = cfg
+        self.params = params
+        self.config = engine_config or EngineConfig()
+        assert self.config.max_seq_len <= cfg.max_position_embeddings, (
+            f"max_seq_len {self.config.max_seq_len} exceeds the model's "
+            f"max_position_embeddings {cfg.max_position_embeddings}")
+        self.metrics = metrics or ServingMetrics(self.config.max_batch_size)
+        self.metrics.num_slots = self.config.max_batch_size
+        self.queue = RequestQueue(self.config.max_queue_size,
+                                  self.config.retry_after_s)
+        self.slots: Optional[SlotAllocator] = None  # allocated on start
+        self._active: dict[int, _SlotState] = {}    # slot -> state
+        self._decode = (_decode_plain if jax.default_backend() == "cpu"
+                        else _decode_donated)
+        self._thread: Optional[threading.Thread] = None
+        self._admitting: Optional[_Request] = None  # popped, not yet slotted
+        self._scheduler_error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._started = threading.Event()
+        self._lock = threading.Lock()  # guards start/shutdown
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServingEngine":
+        with self._lock:
+            if self._thread is None:
+                self.slots = SlotAllocator(self.cfg,
+                                           self.config.max_batch_size,
+                                           self.config.max_seq_len)
+                self._thread = threading.Thread(
+                    target=self._loop, name="serving-engine", daemon=True)
+                self._thread.start()
+                self._started.set()
+        return self
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            if self._thread is None:
+                return
+            self._stop.set()
+            self.queue.notify()
+            self._thread.join(timeout)
+            self._thread = None
+
+    def pause(self) -> None:
+        """Stop admitting and decoding (requests keep queueing) — used for
+        drains and by tests that need deterministic queue pressure."""
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    # -- submission (any thread) ------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+               eos_id: int = 2, temperature: float = 1.0, top_k: int = 0,
+               top_p: float = 0.0, seed: Optional[int] = None,
+               use_eos_stop: bool = True, return_logprobs: bool = False,
+               on_token: Optional[Callable[[int], None]] = None
+               ) -> RequestHandle:
+        return self.submit_many([dict(
+            prompt=prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            use_eos_stop=use_eos_stop, return_logprobs=return_logprobs,
+            on_token=on_token)])[0]
+
+    def submit_many(self, specs: Sequence[dict]) -> List[RequestHandle]:
+        """Validate + enqueue a batch of requests all-or-nothing.
+
+        Raises ``ValueError`` for a request that can never fit (admission
+        control: the per-slot sequence budget) and ``QueueFull`` under
+        backpressure."""
+        self.start()
+        reqs = []
+        for spec in specs:
+            req = _Request(**spec)
+            if len(req.prompt) < 1:
+                self.metrics.inc("rejected_invalid")
+                raise ValueError("empty prompt")
+            if req.max_new_tokens < 1:
+                self.metrics.inc("rejected_invalid")
+                raise ValueError("max_new_tokens must be >= 1")
+            if len(req.prompt) + req.max_new_tokens > self.config.max_seq_len:
+                self.metrics.inc("rejected_invalid")
+                raise ValueError(
+                    f"prompt ({len(req.prompt)} tokens) + max_new_tokens "
+                    f"({req.max_new_tokens}) exceeds the per-slot sequence "
+                    f"budget ({self.config.max_seq_len})")
+            reqs.append(req)
+        try:
+            self.queue.put_many(reqs)
+        except QueueFull:
+            self.metrics.inc("rejected_queue_full", by=len(reqs))
+            raise
+        self.metrics.inc("submitted", by=len(reqs))
+        self.metrics.set_gauges(queue_depth=len(self.queue))
+        return [RequestHandle(r, self) for r in reqs]
+
+    def _cancel(self, req: _Request) -> None:
+        req.cancel_flag.set()
+        if self.queue.remove(req):  # still queued: finish it right here
+            self._finish(req, "cancelled")
+            self.metrics.set_gauges(queue_depth=len(self.queue))
+
+    # -- scheduler loop (engine thread only) -------------------------------
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if self._paused.is_set():
+                    time.sleep(self.config.idle_wait_s)
+                    continue
+                self._drain_cancellations()
+                self._admit()
+                if not self._active:
+                    self.queue.wait_for_work(self.config.idle_wait_s)
+                    continue
+                self._decode_iteration()
+        except Exception as e:  # noqa: BLE001 — a dead scheduler must not
+            # leave submitters blocked on result() forever: fail every
+            # in-flight and queued request loudly, then stop.
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "serving engine scheduler died: %s", e)
+            self._scheduler_error = e
+            if self._admitting is not None:  # popped but not yet slotted
+                self._finish(self._admitting, "error")
+                self._admitting = None
+            for slot in list(self._active):
+                st = self._active.pop(slot)
+                self._finish(st.req, "error")
+            while True:
+                req = self.queue.pop()
+                if req is None:
+                    break
+                self._finish(req, "error")
+            self._stop.set()
+
+    def _drain_cancellations(self) -> None:
+        for slot in [s for s, st in self._active.items()
+                     if st.req.cancel_flag.is_set()]:
+            self._retire(slot, "cancelled")
+
+    def _admit(self) -> None:
+        assert self.slots is not None
+        while self.slots.free_slots:
+            req = self.queue.pop()
+            if req is None:
+                break
+            self.metrics.set_gauges(queue_depth=len(self.queue))
+            if req.cancel_flag.is_set():
+                self._finish(req, "cancelled")
+                continue
+            # between pop and slot the request is in neither the queue nor
+            # _active; remember it so a prefill crash still fails it loudly
+            self._admitting = req
+            self._prefill_into_slot(req)
+            self._admitting = None
+        self.metrics.set_gauges(slots_active=self.slots.active_slots,
+                                queue_depth=len(self.queue))
+
+    def _prefill_into_slot(self, req: _Request) -> None:
+        slot = self.slots.alloc()
+        assert slot is not None
+        t = self.metrics.timers("serving-prefill", 2)
+        t.start()
+        plen = len(req.prompt)
+        bucket = max(1, self.config.prefill_bucket)
+        padded = -(-plen // bucket) * bucket
+        padded = min(padded, self.config.max_seq_len)
+        tokens = np.zeros((1, padded), np.int32)
+        tokens[0, :plen] = req.prompt
+        last_logits, picked, k_small, v_small = _prefill_impl(
+            self.cfg, self.params, jnp.asarray(tokens),
+            jnp.asarray([plen], jnp.int32),
+            max_seq_len=self.config.max_seq_len,
+            want_logprobs=req.return_logprobs)
+        self.slots.insert(slot, k_small, v_small)
+        if req.return_logprobs:
+            req.logprobs.extend(
+                np.asarray(picked)[0, :plen - 1].tolist())
+
+        # first generated token: same per-request sampling rule as decode
+        tok, tok_lp = _first_token_impl(
+            self.cfg, last_logits,
+            jnp.asarray([req.seed], jnp.uint32),
+            jnp.asarray([0], jnp.int32),
+            jnp.asarray([req.greedy]),
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32))
+        first = int(np.asarray(tok)[0])
+        t.stop()
+        self.metrics.inc("admitted")
+        self.metrics.inc("prefills")
+
+        self._active[slot] = _SlotState(req, fill=plen, pending=first)
+        self._commit_token(slot, first, float(np.asarray(tok_lp)[0]))
+
+    def _decode_iteration(self) -> None:
+        assert self.slots is not None
+        S = self.config.max_batch_size
+        pending = np.zeros((S,), np.int32)
+        fills = np.zeros((S,), np.int32)
+        seeds = np.zeros((S,), np.uint32)
+        counters = np.zeros((S,), np.int32)
+        greedy = np.ones((S,), bool)
+        temps = np.ones((S,), np.float32)
+        top_ks = np.zeros((S,), np.int32)
+        top_ps = np.zeros((S,), np.float32)
+        for slot, st in self._active.items():
+            pending[slot] = st.pending
+            fills[slot] = st.fill
+            seeds[slot] = st.req.seed
+            counters[slot] = len(st.req.generated)
+            greedy[slot] = st.req.greedy
+            temps[slot] = st.req.temperature
+            top_ks[slot] = st.req.top_k
+            top_ps[slot] = st.req.top_p
+
+        t = self.metrics.timers("serving-decode", 2)
+        t.start()
+        t0 = time.perf_counter()
+        tok, tok_lp, k_cache, v_cache = self._decode(
+            self.cfg, self.params, self.slots.k_cache, self.slots.v_cache,
+            jnp.asarray(pending), jnp.asarray(fills), jnp.asarray(seeds),
+            jnp.asarray(counters), jnp.asarray(greedy), jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps))
+        self.slots.set_caches(k_cache, v_cache)
+        tok = np.asarray(tok)          # host sync: the scheduling point
+        tok_lp = np.asarray(tok_lp)
+        dt = time.perf_counter() - t0
+        t.stop()
+
+        n_active = len(self._active)
+        self.metrics.observe_decode_iteration(n_active, dt)
+        for slot in list(self._active):
+            st = self._active[slot]
+            st.fill += 1              # pending token's K/V row committed
+            st.pending = int(tok[slot])
+            self._commit_token(slot, st.pending, float(tok_lp[slot]))
+        self.metrics.set_gauges(slots_active=self.slots.active_slots)
+
+    def _commit_token(self, slot: int, token: int, logprob: float) -> None:
+        """Append a sampled token to the slot's request, stream it, and
+        retire the slot on EOS / budget."""
+        st = self._active[slot]
+        req = st.req
+        req.generated.append(token)
+        if req.return_logprobs:
+            req.logprobs.append(logprob)
+        if req.first_token_time is None:
+            req.first_token_time = time.perf_counter()
+            self.metrics.observe_ttft(req.first_token_time - req.submit_time)
+        if req.on_token is not None:
+            try:
+                req.on_token(token)
+            except Exception:  # noqa: BLE001 — a client callback must not
+                pass           # take the scheduler down
+        if req.use_eos_stop and token == req.eos_id:
+            self._retire(slot, "eos")
+        elif len(req.generated) >= req.max_new_tokens:
+            self._retire(slot, "length")
+
+    def _retire(self, slot: int, reason: str) -> None:
+        st = self._active.pop(slot)
+        self.slots.release(slot)
+        self._finish(st.req, reason)
+        self.metrics.set_gauges(slots_active=self.slots.active_slots)
+
+    def _finish(self, req: _Request, reason: str) -> None:
+        req.result = FinishedRequest(
+            tokens=req.prompt + req.generated,
+            prompt_len=len(req.prompt),
+            finish_reason=reason,
+            logprobs=list(req.logprobs) if req.return_logprobs else None)
+        if reason == "cancelled":
+            self.metrics.inc("cancelled")
+        elif reason != "error":
+            self.metrics.inc("completed")
+            self.metrics.observe_e2e(time.perf_counter() - req.submit_time)
+        req.done_event.set()

@@ -1,0 +1,155 @@
+"""Serving counters, gauges, and latency histograms.
+
+The engine records scheduler-level observability through this object:
+request lifecycle counters (submitted/admitted/completed/rejected/
+cancelled), slot-occupancy gauges, decode-iteration stats (including the
+max per-iteration batch — the direct evidence that requests actually
+shared a decode step), and latency histograms (time-to-first-token,
+per-token, end-to-end).  Engine phase timing reuses the repo's hierarchical
+timers (utils/timers.py), and ``write`` exports everything to the same
+tensorboard-style writer interface the training metrics use, so the
+``tests/test_metrics.py``-style fake-writer assertions work unchanged.
+
+Everything is host-side and lock-guarded: the writers are the scheduler
+thread and HTTP threads, the readers are tests / monitoring pollers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from ..utils.timers import Timers
+
+
+class LatencyHistogram:
+    """Bounded reservoir of latency samples with mean / percentile readout.
+
+    Keeps the most recent ``max_samples`` observations — serving wants
+    *recent* tail latency, and an unbounded list would grow forever on a
+    long-lived engine."""
+
+    def __init__(self, max_samples: int = 4096):
+        self.max_samples = max_samples
+        self._samples: list[float] = []
+        self._count = 0
+        self._total = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self._count += 1
+        self._total += seconds
+        self._samples.append(seconds)
+        if len(self._samples) > self.max_samples:
+            del self._samples[: len(self._samples) - self.max_samples]
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def mean(self) -> float:
+        return self._total / self._count if self._count else 0.0
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 100], nearest-rank over the retained window."""
+        if not self._samples:
+            return 0.0
+        xs = sorted(self._samples)
+        idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+        return xs[idx]
+
+    def snapshot(self) -> dict:
+        return {"count": self._count, "mean_s": self.mean(),
+                "p50_s": self.percentile(50), "p95_s": self.percentile(95),
+                "p99_s": self.percentile(99)}
+
+
+_COUNTERS = (
+    "submitted", "admitted", "completed", "cancelled",
+    "rejected_queue_full", "rejected_invalid",
+    "prefills", "decode_iterations", "decode_tokens",
+)
+
+
+class ServingMetrics:
+    """Thread-safe serving counter/gauge/histogram registry."""
+
+    def __init__(self, num_slots: int = 0):
+        self._lock = threading.Lock()
+        self.counters = {name: 0 for name in _COUNTERS}
+        self.num_slots = num_slots
+        self.slots_active = 0
+        self.queue_depth = 0
+        # largest number of requests that shared one decode iteration —
+        # >= 2 is the proof of true continuous batching (not serialized)
+        self.max_decode_batch = 0
+        self.ttft = LatencyHistogram()
+        self.per_token = LatencyHistogram()
+        self.e2e = LatencyHistogram()
+        self.timers = Timers(log_level=2)
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += by
+
+    def set_gauges(self, *, slots_active: Optional[int] = None,
+                   queue_depth: Optional[int] = None) -> None:
+        with self._lock:
+            if slots_active is not None:
+                self.slots_active = slots_active
+            if queue_depth is not None:
+                self.queue_depth = queue_depth
+
+    def observe_decode_iteration(self, batch: int, seconds: float) -> None:
+        """One scheduler decode step over ``batch`` active slots."""
+        with self._lock:
+            self.counters["decode_iterations"] += 1
+            self.counters["decode_tokens"] += batch
+            self.max_decode_batch = max(self.max_decode_batch, batch)
+            for _ in range(batch):
+                self.per_token.observe(seconds)
+
+    def observe_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self.ttft.observe(seconds)
+
+    def observe_e2e(self, seconds: float) -> None:
+        with self._lock:
+            self.e2e.observe(seconds)
+
+    def snapshot(self) -> dict:
+        """Point-in-time dict of every counter, gauge, and histogram."""
+        with self._lock:
+            out = dict(self.counters)
+            out.update({
+                "running": self.slots_active,
+                "queued": self.queue_depth,
+                "slots_total": self.num_slots,
+                "slot_occupancy": (self.slots_active / self.num_slots
+                                   if self.num_slots else 0.0),
+                "max_decode_batch": self.max_decode_batch,
+                "ttft": self.ttft.snapshot(),
+                "per_token_latency": self.per_token.snapshot(),
+                "e2e_latency": self.e2e.snapshot(),
+            })
+            return out
+
+    def write(self, writer, iteration: int,
+              names: Optional[Sequence[str]] = None) -> None:
+        """Export scalars to a tensorboard-style writer (``add_scalar``),
+        mirroring utils/timers.py:Timers.write."""
+        snap = self.snapshot()
+        for name in (names or _COUNTERS):
+            writer.add_scalar(f"serving/{name}", snap[name], iteration)
+        writer.add_scalar("serving/running", snap["running"], iteration)
+        writer.add_scalar("serving/queued", snap["queued"], iteration)
+        writer.add_scalar("serving/slot_occupancy", snap["slot_occupancy"],
+                          iteration)
+        writer.add_scalar("serving/max_decode_batch",
+                          snap["max_decode_batch"], iteration)
+        for hist, key in ((self.ttft, "ttft"),
+                          (self.per_token, "per_token_latency"),
+                          (self.e2e, "e2e_latency")):
+            writer.add_scalar(f"serving/{key}_mean_s", hist.mean(), iteration)
+            writer.add_scalar(f"serving/{key}_p95_s", hist.percentile(95),
+                              iteration)
+        self.timers.write(writer, iteration)

@@ -1,0 +1,104 @@
+"""Bounded admission queue for the continuous-batching engine.
+
+The HTTP server used to serialize generations behind a global lock and
+reject any batch larger than ``max_batch_size`` outright
+(generation/server.py).  Under continuous batching, requests instead wait
+here until the scheduler has a free KV slot — but the wait must be
+*bounded*: an unbounded queue turns overload into unbounded latency and an
+HTTP thread pile-up.  When the queue is full, ``submit`` raises
+``QueueFull`` carrying a ``retry_after_s`` hint, which the REST layer maps
+to ``503`` + ``Retry-After`` instead of blocking the client.
+
+Multi-prompt HTTP requests reserve space all-or-nothing (``put_many``):
+either every prompt of the request is admitted, or none is — a partially
+admitted batch would force the server to hold the connection for the
+stragglers anyway, so partial admission buys nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+
+class QueueFull(Exception):
+    """The bounded request queue cannot take the submission right now.
+
+    ``retry_after_s`` is the backpressure hint the REST layer surfaces as
+    a ``Retry-After`` header."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class RequestQueue:
+    """Thread-safe bounded FIFO of pending requests.
+
+    Producers are HTTP threads (``put`` / ``put_many``); the single
+    consumer is the scheduler loop (``pop`` / ``wait_for_work``).
+    """
+
+    def __init__(self, max_size: int = 32, retry_after_s: float = 1.0):
+        assert max_size >= 1
+        self.max_size = max_size
+        self.retry_after_s = retry_after_s
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    @property
+    def free_space(self) -> int:
+        with self._cond:
+            return self.max_size - len(self._q)
+
+    def put(self, req) -> None:
+        self.put_many([req])
+
+    def put_many(self, reqs) -> None:
+        """Admit all of ``reqs`` or raise ``QueueFull`` (all-or-nothing)."""
+        reqs = list(reqs)
+        if len(reqs) > self.max_size:
+            raise QueueFull(
+                f"request batch of {len(reqs)} exceeds the queue capacity "
+                f"({self.max_size})", self.retry_after_s)
+        with self._cond:
+            if len(self._q) + len(reqs) > self.max_size:
+                raise QueueFull(
+                    f"request queue full ({len(self._q)}/{self.max_size})",
+                    self.retry_after_s)
+            self._q.extend(reqs)
+            self._cond.notify_all()
+
+    def pop(self) -> Optional[object]:
+        """Next pending request, or None when the queue is empty."""
+        with self._cond:
+            if self._q:
+                return self._q.popleft()
+            return None
+
+    def remove(self, req) -> bool:
+        """Drop a still-queued request (cancellation before admission)."""
+        with self._cond:
+            try:
+                self._q.remove(req)
+                return True
+            except ValueError:
+                return False
+
+    def wait_for_work(self, timeout: Optional[float] = None) -> bool:
+        """Block until the queue is non-empty (or timeout); True if work."""
+        with self._cond:
+            if self._q:
+                return True
+            self._cond.wait(timeout)
+            return bool(self._q)
+
+    def notify(self) -> None:
+        """Wake the consumer (used by shutdown)."""
+        with self._cond:
+            self._cond.notify_all()

@@ -1,0 +1,86 @@
+"""KV-slot allocator: a long-lived fixed-shape batch cache, one slot per
+concurrent request.
+
+vLLM pages its cache per-block; on TPU the jitted decode step wants ONE
+fixed-shape ``[L, slots, kv_heads, max_len, d]`` pytree so the compiled
+executable never changes shape as requests come and go.  A "slot" is a
+batch row of that cache: admission writes a request's prompt K/V into a
+free row (``models/model.py:cache_slot_update`` — the whole row is
+replaced, so the previous occupant can never leak), decode advances the
+row's fill level, and retirement just returns the row to the free list —
+no device work at all, because rows past a slot's fill level are masked by
+the per-sample fill vector the decode attention already honors
+(ops/kv_quant.py:cache_update, generation/speculative.py precedent).
+
+Donation: the insert splices a fresh prefill cache into the big cache
+functionally; on TPU the old buffer is donated so the update is in-place
+(two full-cache copies per admission otherwise).  XLA:CPU does not
+implement donation and warns, so donation is keyed off the backend.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+from ..config import ModelConfig
+from ..models import model as model_lib
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _insert_donated(k_big, v_big, k_small, v_small, slot):
+    return (model_lib.cache_slot_update(k_big, k_small, slot),
+            model_lib.cache_slot_update(v_big, v_small, slot))
+
+
+@jax.jit
+def _insert_plain(k_big, v_big, k_small, v_small, slot):
+    return (model_lib.cache_slot_update(k_big, k_small, slot),
+            model_lib.cache_slot_update(v_big, v_small, slot))
+
+
+class SlotAllocator:
+    """Owns the batch KV cache and its free list.
+
+    Only the scheduler thread touches this object — no locking here.
+    """
+
+    def __init__(self, cfg: ModelConfig, num_slots: int, max_seq_len: int):
+        assert num_slots >= 1 and max_seq_len >= 2
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_seq_len = max_seq_len
+        self.k_cache, self.v_cache = model_lib.init_kv_cache(
+            cfg, num_slots, max_seq_len)
+        self._free = list(range(num_slots - 1, -1, -1))  # pop() -> slot 0 first
+        self._insert = (_insert_plain if jax.default_backend() == "cpu"
+                        else _insert_donated)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        """Claim a free slot index, or None when all slots are occupied."""
+        return self._free.pop() if self._free else None
+
+    def release(self, slot: int) -> None:
+        assert 0 <= slot < self.num_slots and slot not in self._free
+        self._free.append(slot)
+
+    def insert(self, slot: int, k_small, v_small) -> None:
+        """Splice a batch-1 prefill cache into ``slot`` of the batch cache."""
+        self.k_cache, self.v_cache = self._insert(
+            self.k_cache, self.v_cache, k_small, v_small, slot)
+
+    def set_caches(self, k_cache, v_cache) -> None:
+        """Adopt the caches returned by a decode step (the step consumes and
+        re-emits them; on TPU they are donated through)."""
+        self.k_cache = k_cache
+        self.v_cache = v_cache

@@ -23,8 +23,19 @@ def main(argv=None) -> int:
     ap.add_argument("--tokenizer_model", default=None)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=5000)
-    ap.add_argument("--max_batch_size", type=int, default=8)
+    ap.add_argument("--max_batch_size", type=int, default=8,
+                    help="KV slots = max CONCURRENT decodes in the "
+                         "continuous-batching engine (docs/serving.md); "
+                         "prompts beyond this queue, they are not rejected")
     ap.add_argument("--max_tokens_to_generate", type=int, default=1024)
+    ap.add_argument("--queue_size", type=int, default=32,
+                    help="bounded admission queue depth; beyond it requests "
+                         "get 503 + Retry-After instead of unbounded latency")
+    ap.add_argument("--max_seq_len", type=int, default=None,
+                    help="per-slot cache width (prompt + generation); "
+                         "default: the model's max_position_embeddings")
+    ap.add_argument("--retry_after_s", type=float, default=1.0,
+                    help="Retry-After hint returned with 503 backpressure")
     ap.add_argument("--quantize", default=None, choices=["int8"],
                     help="weight-only int8 (halves decode HBM traffic; "
                          "ops/quant.py)")
@@ -88,7 +99,10 @@ def main(argv=None) -> int:
         lm.cfg, params, tokenizer,
         max_batch_size=args.max_batch_size,
         max_tokens_to_generate=args.max_tokens_to_generate,
-        speculative=args.speculative)
+        speculative=args.speculative,
+        queue_size=args.queue_size,
+        engine_max_seq_len=args.max_seq_len,
+        retry_after_s=args.retry_after_s)
     print(f"serving on {args.host}:{args.port}")
     if mesh_ctx is not None:
         with mesh_ctx:

@@ -1,0 +1,70 @@
+"""Bounded admission queue: FIFO order, all-or-nothing batch reservation,
+backpressure (QueueFull + retry hint), cancellation removal, consumer
+wakeup."""
+
+import threading
+
+import pytest
+
+from megatron_llm_tpu.serving import QueueFull, RequestQueue
+
+
+def test_fifo_order():
+    q = RequestQueue(max_size=4)
+    a, b, c = object(), object(), object()
+    q.put(a)
+    q.put_many([b, c])
+    assert len(q) == 3 and q.free_space == 1
+    assert q.pop() is a and q.pop() is b and q.pop() is c
+    assert q.pop() is None
+    assert q.free_space == 4
+
+
+def test_bounded_put_raises_queue_full():
+    q = RequestQueue(max_size=2, retry_after_s=5.0)
+    q.put(object())
+    q.put(object())
+    with pytest.raises(QueueFull) as ei:
+        q.put(object())
+    assert ei.value.retry_after_s == 5.0
+    assert len(q) == 2
+
+
+def test_put_many_all_or_nothing():
+    q = RequestQueue(max_size=3)
+    q.put_many([object(), object()])
+    with pytest.raises(QueueFull):
+        q.put_many([object(), object()])  # only 1 free: admit neither
+    assert len(q) == 2
+    q.pop()
+    q.put_many([object(), object()])  # 2 free now
+    assert len(q) == 3
+
+
+def test_put_many_larger_than_capacity():
+    q = RequestQueue(max_size=3)
+    with pytest.raises(QueueFull, match="exceeds the queue capacity"):
+        q.put_many([object()] * 4)  # can never fit, even empty
+    assert len(q) == 0
+
+
+def test_remove_queued_request():
+    q = RequestQueue(max_size=4)
+    a, b = object(), object()
+    q.put_many([a, b])
+    assert q.remove(a) is True
+    assert q.remove(a) is False  # already gone
+    assert q.pop() is b
+
+
+def test_wait_for_work():
+    q = RequestQueue(max_size=4)
+    assert q.wait_for_work(timeout=0.01) is False
+    item = object()
+    t = threading.Timer(0.05, q.put, args=(item,))
+    t.start()
+    try:
+        assert q.wait_for_work(timeout=30) is True
+    finally:
+        t.cancel()
+    assert q.pop() is item

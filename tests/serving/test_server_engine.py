@@ -1,0 +1,153 @@
+"""Engine-backed REST service contract: prompt batches beyond the slot
+count are queued and served (no more hard 400), queue saturation maps to
+503 + Retry-After, and the sequence-budget 400 survives."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.generation.server import (
+    GenerationService,
+    MegatronServer,
+)
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.tokenizer.tokenizer import NullTokenizer
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(num_layers=1, vocab_size=256,
+                      make_vocab_size_divisible_by=8)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def test_more_prompts_than_slots_is_served(model):
+    """Six prompts through two KV slots: the old server rejected this with
+    400; the engine queues and serves all of them."""
+    cfg, params = model
+    svc = GenerationService(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=2, queue_size=16)
+    try:
+        prompts = [f"{10 + i} {20 + i} {30 + i}" for i in range(6)]
+        status, out = svc.handle({"prompts": prompts,
+                                  "tokens_to_generate": 3,
+                                  "no_early_termination": True})
+        assert status == 200
+        assert len(out["text"]) == 6
+        # legacy ragged-batch contract: budget = max prompt len + ttg, so
+        # these equal-length prompts each return 3 + 3 tokens
+        assert all(len(t.split()) == 6 for t in out["text"])
+        snap = svc.engine.metrics.snapshot()
+        assert snap["completed"] == 6
+        assert snap["max_decode_batch"] <= 2  # only two slots exist
+    finally:
+        svc.close()
+
+
+def test_engine_and_legacy_path_agree(model):
+    """A 4-slot (batched) and a 1-slot (serialized) service must return
+    identical text for the same greedy and seeded-sampling requests —
+    batch composition must never change results."""
+    cfg, params = model
+    tok = NullTokenizer(vocab_size=cfg.vocab_size)
+    a = GenerationService(cfg, params, tok, max_batch_size=4)
+    b = GenerationService(cfg, params, tok, max_batch_size=1)  # serialized
+    try:
+        for body in ({"prompts": ["7 8 9 10", "11 12 13"],
+                      "tokens_to_generate": 6,
+                      "no_early_termination": True},
+                     {"prompts": ["7 8 9 10"], "tokens_to_generate": 4,
+                      "top_k": 4, "random_seed": 3}):
+            s1, o1 = a.handle(dict(body))
+            s2, o2 = b.handle(dict(body))
+            assert s1 == s2 == 200
+            assert o1["text"] == o2["text"]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_queue_full_maps_to_503(model):
+    cfg, params = model
+    svc = GenerationService(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=1, queue_size=2,
+                            retry_after_s=7.0)
+    try:
+        engine = svc.engine
+        engine.pause()  # deterministic pressure: nothing drains
+        engine.submit([5], max_new_tokens=2)  # fill the queue directly
+        engine.submit([6], max_new_tokens=2)
+        status, payload = svc.handle({"prompts": ["7 8"],
+                                      "tokens_to_generate": 2})
+        assert status == 503
+        assert payload["retry_after"] == 7
+        assert "queue" in payload["message"]
+    finally:
+        svc.close()
+
+
+def test_oversized_batch_maps_to_503(model):
+    """A batch that can NEVER fit the bounded queue is backpressure (503,
+    try smaller/again later), not a validation error."""
+    cfg, params = model
+    svc = GenerationService(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=1, queue_size=2)
+    try:
+        status, payload = svc.handle(
+            {"prompts": ["1", "2", "3"], "tokens_to_generate": 2})
+        assert status == 503
+        assert "retry_after" in payload
+    finally:
+        svc.close()
+
+
+def test_sequence_budget_is_still_400(model):
+    cfg, params = model
+    svc = GenerationService(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            engine_max_seq_len=16)
+    try:
+        status, msg = svc.handle({"prompts": ["1 2 3 4 5 6 7 8"],
+                                  "tokens_to_generate": 12})  # 8 + 12 > 16
+        assert status == 400
+        assert "sequence budget" in msg
+        # within budget works
+        status, out = svc.handle({"prompts": ["1 2 3 4"],
+                                  "tokens_to_generate": 4})
+        assert status == 200 and len(out["text"]) == 1
+    finally:
+        svc.close()
+
+
+def test_http_503_carries_retry_after_header(model):
+    cfg, params = model
+    server = MegatronServer(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=1, queue_size=1,
+                            retry_after_s=9.0)
+    server.run("127.0.0.1", 0, block=False)
+    try:
+        engine = server.service.engine
+        engine.pause()
+        engine.submit([5], max_new_tokens=2)  # saturate the queue
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/api",
+            data=json.dumps({"prompts": ["7 8"],
+                             "tokens_to_generate": 2}).encode(),
+            method="PUT", headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 503
+        assert ei.value.headers["Retry-After"] == "9"
+        body = json.loads(ei.value.read())
+        assert body["retry_after"] == 9
+    finally:
+        server.shutdown()

@@ -1,0 +1,70 @@
+"""Serving metrics registry: counters, gauges, latency histograms, and the
+tensorboard-style export (same fake-writer idiom as the training metrics
+tests)."""
+
+from megatron_llm_tpu.serving import LatencyHistogram, ServingMetrics
+
+
+class FakeWriter:
+    def __init__(self):
+        self.scalars = {}
+
+    def add_scalar(self, name, value, iteration):
+        self.scalars[name] = (value, iteration)
+
+
+def test_histogram_stats():
+    h = LatencyHistogram(max_samples=4)
+    for x in (1.0, 2.0, 3.0, 4.0):
+        h.observe(x)
+    assert h.count == 4
+    assert h.mean() == 2.5
+    assert h.percentile(0) == 1.0
+    assert h.percentile(100) == 4.0
+    # the sample window is bounded; the running mean is not
+    h.observe(5.0)
+    assert h.count == 5 and h.mean() == 3.0
+    assert h.percentile(0) == 2.0  # 1.0 evicted from the window
+
+
+def test_empty_histogram():
+    h = LatencyHistogram()
+    assert h.count == 0 and h.mean() == 0.0 and h.percentile(95) == 0.0
+    assert h.snapshot() == {"count": 0, "mean_s": 0.0, "p50_s": 0.0,
+                            "p95_s": 0.0, "p99_s": 0.0}
+
+
+def test_counters_gauges_and_decode_stats():
+    m = ServingMetrics(num_slots=4)
+    m.inc("submitted", by=3)
+    m.inc("completed")
+    m.set_gauges(slots_active=2, queue_depth=5)
+    m.observe_decode_iteration(3, 0.01)
+    m.observe_decode_iteration(2, 0.01)
+    snap = m.snapshot()
+    assert snap["submitted"] == 3 and snap["completed"] == 1
+    assert snap["running"] == 2 and snap["queued"] == 5
+    assert snap["slots_total"] == 4 and snap["slot_occupancy"] == 0.5
+    assert snap["decode_iterations"] == 2
+    assert snap["decode_tokens"] == 5  # 3 + 2 slots served
+    assert snap["max_decode_batch"] == 3
+    assert snap["per_token_latency"]["count"] == 5
+
+
+def test_write_exports_serving_scalars():
+    m = ServingMetrics(num_slots=2)
+    m.inc("submitted")
+    m.inc("rejected_queue_full", by=2)
+    m.observe_ttft(0.5)
+    m.observe_decode_iteration(2, 0.1)
+    w = FakeWriter()
+    m.write(w, iteration=7)
+    assert w.scalars["serving/submitted"] == (1, 7)
+    assert w.scalars["serving/rejected_queue_full"] == (2, 7)
+    assert w.scalars["serving/max_decode_batch"] == (2, 7)
+    assert w.scalars["serving/ttft_mean_s"] == (0.5, 7)
+    assert w.scalars["serving/slot_occupancy"] == (0.0, 7)
+    for key in ("serving/running", "serving/queued",
+                "serving/per_token_latency_p95_s",
+                "serving/e2e_latency_mean_s"):
+        assert key in w.scalars

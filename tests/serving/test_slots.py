@@ -1,0 +1,91 @@
+"""KV-slot surgery (models/model.py cache_slot_update/read) and the
+SlotAllocator free-list discipline."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.serving import SlotAllocator
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_config(num_layers=2, vocab_size=64,
+                       make_vocab_size_divisible_by=8)
+
+
+def test_cache_slot_update_roundtrip(cfg):
+    """Writing a batch-1 cache into slot 2 of a 4-slot cache must replace
+    exactly that row and leave the others untouched."""
+    k_big, v_big = model_lib.init_kv_cache(cfg, 4, 16)
+    k_small, v_small = model_lib.init_kv_cache(cfg, 1, 16)
+    rng = np.random.default_rng(0)
+    randomize = lambda a: jnp.asarray(  # noqa: E731
+        rng.standard_normal(a.shape), a.dtype)
+    k_small = jax.tree.map(randomize, k_small)
+    v_small = jax.tree.map(randomize, v_small)
+
+    k_big = model_lib.cache_slot_update(k_big, k_small, 2)
+    v_big = model_lib.cache_slot_update(v_big, v_small, 2)
+    for big, small in ((k_big, k_small), (v_big, v_small)):
+        got = model_lib.cache_slot_read(big, 2)
+        jax.tree.map(lambda g, s: np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(s)), got, small)
+        for other in (0, 1, 3):  # zero-initialized rows stay zero
+            jax.tree.map(lambda r: np.testing.assert_array_equal(
+                np.asarray(r), 0), model_lib.cache_slot_read(big, other))
+
+
+def test_cache_slot_update_pytree_aware():
+    """Quantized caches are ``{"q", "scale"}`` pytrees per leaf
+    (ops/kv_quant.py layout): the slot splice must update every leaf, with
+    batch on axis 1."""
+    big = {"q": jnp.zeros((2, 4, 8), jnp.int8),
+           "scale": jnp.zeros((2, 4, 8), jnp.float32)}
+    small = {"q": jnp.ones((2, 1, 8), jnp.int8),
+             "scale": jnp.full((2, 1, 8), 0.5, jnp.float32)}
+    out = model_lib.cache_slot_update(big, small, 3)
+    np.testing.assert_array_equal(np.asarray(out["q"])[:, 3], 1)
+    np.testing.assert_array_equal(np.asarray(out["scale"])[:, 3], 0.5)
+    np.testing.assert_array_equal(np.asarray(out["q"])[:, :3], 0)
+    np.testing.assert_array_equal(np.asarray(out["scale"])[:, :3], 0.0)
+    got = model_lib.cache_slot_read(out, 3)
+    np.testing.assert_array_equal(np.asarray(got["q"]),
+                                  np.asarray(small["q"]))
+
+
+def test_slot_allocator_free_list(cfg):
+    alloc = SlotAllocator(cfg, 3, 8)
+    assert alloc.free_slots == 3 and alloc.active_slots == 0
+    taken = [alloc.alloc() for _ in range(3)]
+    assert sorted(taken) == [0, 1, 2]
+    assert alloc.alloc() is None  # exhausted
+    assert alloc.active_slots == 3
+    alloc.release(taken[1])
+    assert alloc.free_slots == 1
+    assert alloc.alloc() == taken[1]  # recycled
+    with pytest.raises(AssertionError):
+        alloc.release(7)  # out of range
+    alloc.release(taken[0])
+    with pytest.raises(AssertionError):
+        alloc.release(taken[0])  # double release
+
+
+def test_slot_allocator_insert_roundtrip(cfg):
+    alloc = SlotAllocator(cfg, 2, 8)
+    k1, v1 = model_lib.init_kv_cache(cfg, 1, 8)
+    k1 = jax.tree.map(lambda a: jnp.full_like(a, 2.0), k1)
+    v1 = jax.tree.map(lambda a: jnp.full_like(a, 3.0), v1)
+    alloc.insert(1, k1, v1)
+    jax.tree.map(lambda g, s: np.testing.assert_array_equal(
+        np.asarray(g), np.asarray(s)),
+        model_lib.cache_slot_read(alloc.k_cache, 1), k1)
+    jax.tree.map(lambda g, s: np.testing.assert_array_equal(
+        np.asarray(g), np.asarray(s)),
+        model_lib.cache_slot_read(alloc.v_cache, 1), v1)
+    # slot 0 untouched
+    jax.tree.map(lambda r: np.testing.assert_array_equal(np.asarray(r), 0),
+                 model_lib.cache_slot_read(alloc.k_cache, 0))

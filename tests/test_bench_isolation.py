@@ -20,7 +20,8 @@ import pytest
 import bench
 
 
-def _stub_point(train=None, decode=None, pld=None, prefill=None):
+def _stub_point(train=None, decode=None, pld=None, prefill=None,
+                serving=None):
     """A fake bench._point dispatching on the spec kind."""
     def point(label, spec, timeout_s=900):
         kind = spec["kind"]
@@ -33,6 +34,8 @@ def _stub_point(train=None, decode=None, pld=None, prefill=None):
                 return pld(spec) if pld else None
             if kind == "prefill":
                 return prefill(spec) if prefill else None
+            if kind == "serving":
+                return serving(spec) if serving else None
         except Exception as e:  # noqa: BLE001 — mirrors subprocess crash
             print(f"# bench point {label} FAILED: {type(e).__name__}: {e}")
             return None
@@ -67,8 +70,13 @@ def test_all_points_ok(monkeypatch):
         monkeypatch, train=_ok_train, decode=_ok_decode,
         pld=lambda s: {"pld_tokens_per_verify_repetitive": 4.0},
         prefill=lambda s: {"prefill_long_tokens_per_sec": 30000.0,
-                           "prefill_long_mfu": 0.3})
+                           "prefill_long_mfu": 0.3},
+        serving=lambda s: {"serving_requests_per_sec": 2.5,
+                           "serving_token_latency_ms_p95": 11.0,
+                           "serving_max_decode_batch": 8})
     assert rec["metric"] == "mfu" and rec["value"] == 0.5
+    assert rec["serving"]["serving_requests_per_sec"] == 2.5
+    assert rec["serving"]["serving_max_decode_batch"] == 8
     assert rec["decode_tokens_per_sec"] == 2000.0
     assert rec["decode_roofline_frac"] == round(2000.0 / 7000.0, 4)
     assert rec["decode_tokens_per_sec_int8"] == 3000.0
@@ -90,6 +98,7 @@ def test_decode_crash_keeps_headline(monkeypatch):
     assert rec["value"] == 0.5 and rec["vs_baseline"] is not None
     assert "decode_tokens_per_sec" not in rec
     assert "decode_7b_width" not in rec
+    assert "serving" not in rec  # serving point absent → key omitted
     assert len(rec["mfu_vs_seq"]) == 6
 
 
