@@ -15,24 +15,48 @@ checkpoints.  What is kept, by design (SURVEY.md §5):
   different mesh/PartitionSpec layout just works — the offline
   ``tools/checkpoint_util.py`` TP×PP resharding tool is obsolete by design
 
-Layout: <root>/iter_0000010/{state/ (orbax), config.json}
+Crash safety (docs/robustness.md): a save is invisible until it is
+complete.  The checkpoint is written into a ``iter_*.tmp`` staging
+directory and committed with one atomic ``os.replace``; the tracker is
+advanced *last*, itself via tmp + ``os.replace``.  A kill at any point
+therefore leaves either the previous on-disk state or the new one — never
+a tracker pointing at a torn directory.  On load, the tracker's target is
+verified complete; a torn/missing target falls back (loudly) to the
+newest complete checkpoint.  Orbax/tensorstore I/O runs under bounded
+exponential-backoff retries, old iterations are garbage-collected to a
+``keep`` budget, and every failure path is exercised by chaos-injection
+tests (tests/resilience/).
+
+Layout: <root>/iter_0000010/{state/ (orbax), config.json, meta.json}
         <root>/latest_checkpointed_iteration.txt
 """
 
 from __future__ import annotations
 
 import json
+import logging
+import os
+import shutil
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from . import metrics as metrics_lib
 from .config import RuntimeConfig
+from .resilience import atomic_write_text, chaos, with_retries
+
+logger = logging.getLogger(__name__)
 
 TRACKER_FILENAME = "latest_checkpointed_iteration.txt"
 RELEASE = "release"
+STAGING_SUFFIX = ".tmp"
+# orbax writes these inside the state/params dir; at least one must exist
+# for the checkpoint to count as complete (a torn pre-atomic-commit dir —
+# e.g. from an older version of this module — has the dir but no markers)
+_ORBAX_MARKERS = ("_CHECKPOINT_METADATA", "_METADATA", "manifest.ocdbt")
 
 
 def checkpoint_dir(root: str, iteration: int | str) -> Path:
@@ -44,17 +68,63 @@ def checkpoint_dir(root: str, iteration: int | str) -> Path:
 
 
 def read_tracker(root: str) -> Optional[int | str]:
+    """The tracker's target, or None when absent/unparseable.  Garbage
+    content (a torn write from a pre-atomic version, bitrot) is treated
+    as no-tracker so load can fall back to a directory scan instead of
+    crashing the resume."""
     tracker = Path(root) / TRACKER_FILENAME
     if not tracker.exists():
         return None
     content = tracker.read_text().strip()
     if content == RELEASE:
         return RELEASE
-    return int(content)
+    try:
+        return int(content)
+    except ValueError:
+        logger.warning("unparseable tracker %s (content %r); ignoring it",
+                       tracker, content[:64])
+        return None
 
 
 def write_tracker(root: str, iteration: int | str) -> None:
-    (Path(root) / TRACKER_FILENAME).write_text(str(iteration))
+    """Advance the tracker atomically (tmp + ``os.replace``): readers see
+    the old target or the new one, never a torn file."""
+    Path(root).mkdir(parents=True, exist_ok=True)
+    chaos().point("tracker-write")
+    atomic_write_text(Path(root) / TRACKER_FILENAME, str(iteration),
+                      site="tracker-replace")
+
+
+def is_complete(root: str, iteration: int | str) -> bool:
+    """True iff the checkpoint's orbax payload finished writing."""
+    sub = "params" if iteration == RELEASE else "state"
+    payload = checkpoint_dir(root, iteration) / sub
+    return payload.is_dir() and any(
+        (payload / m).exists() for m in _ORBAX_MARKERS)
+
+
+def list_iterations(root: str) -> List[int]:
+    """All on-disk iteration numbers (complete or not), ascending.
+    Staging dirs (``iter_*.tmp``) are not checkpoints and are skipped."""
+    out = []
+    for p in Path(root).glob("iter_*"):
+        if p.name.endswith(STAGING_SUFFIX) or not p.is_dir():
+            continue
+        try:
+            out.append(int(p.name[len("iter_"):]))
+        except ValueError:
+            continue
+    return sorted(out)
+
+
+def latest_complete_iteration(root: str) -> Optional[int]:
+    """Newest iteration whose orbax payload is complete, or None."""
+    if not Path(root).is_dir():
+        return None
+    for it in reversed(list_iterations(root)):
+        if is_complete(root, it):
+            return it
+    return None
 
 
 def save_checkpoint(
@@ -63,22 +133,74 @@ def save_checkpoint(
     cfg: Optional[RuntimeConfig] = None,
     iteration: Optional[int | str] = None,
     meta: Optional[dict] = None,
+    *,
+    retries: int = 3,
+    keep: int = 0,
 ) -> Path:
     """Write state + config (+ host-side metadata like consumed_samples,
     which lives outside the device state to avoid int32 limits) and advance
-    the tracker (reference save_checkpoint, checkpointing.py:243-333)."""
+    the tracker (reference save_checkpoint, checkpointing.py:243-333).
+
+    Crash-safe: everything lands in ``iter_*.tmp`` first, one
+    ``os.replace`` commits it, and the tracker moves last — a kill at any
+    point leaves the previous complete checkpoint loadable.  Orbax I/O is
+    retried ``retries`` times with exponential backoff; with ``keep > 0``
+    older complete iterations beyond the newest ``keep`` are deleted.
+    """
     if iteration is None:
         iteration = int(jax.device_get(state.iteration))
-    path = checkpoint_dir(root, iteration)
-    path.mkdir(parents=True, exist_ok=True)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save((path / "state").absolute(), state, force=True)
-    if cfg is not None:
-        (path / "config.json").write_text(cfg.to_json())
-    if meta is not None:
-        (path / "meta.json").write_text(json.dumps(meta))
+    chaos().point("ckpt-begin")
+    final = checkpoint_dir(root, iteration)
+    staging = final.with_name(final.name + STAGING_SUFFIX)
+    if staging.exists():  # stale leftover from a previous crash
+        shutil.rmtree(staging)
+    staging.mkdir(parents=True)
+    chaos().point("ckpt-staging")
+    try:
+        def save_state():
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save((staging / "state").absolute(), state, force=True)
+
+        with_retries(save_state, site="ckpt-state-save", attempts=retries)
+        if cfg is not None:
+            (staging / "config.json").write_text(cfg.to_json())
+        if meta is not None:
+            (staging / "meta.json").write_text(json.dumps(meta))
+        chaos().point("ckpt-pre-commit")
+        if final.exists():  # re-saving the same iteration (force semantics)
+            shutil.rmtree(final)
+        os.replace(staging, final)  # the atomic commit
+    except Exception:
+        # a *failed* save (I/O gave up) must not litter the root; a
+        # SimulatedCrash/kill tears through this like a real crash would
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+    chaos().point("ckpt-pre-tracker")
     write_tracker(root, iteration)
-    return path
+    metrics_lib.RESILIENCE_EVENTS.inc("checkpoint_saves")
+    if keep > 0:
+        _gc_old_checkpoints(root, iteration, keep)
+    return final
+
+
+def _gc_old_checkpoints(root: str, current: int | str, keep: int) -> None:
+    """Bounded retention: drop complete iterations beyond the newest
+    ``keep`` (never the tracker's target, never ``release``), plus any
+    stale staging dirs other than the current iteration's."""
+    target = read_tracker(root)
+    survivors = set()
+    complete = [it for it in list_iterations(root) if is_complete(root, it)]
+    survivors.update(complete[-keep:])
+    if isinstance(target, int):
+        survivors.add(target)
+    for it in complete:
+        if it not in survivors:
+            shutil.rmtree(checkpoint_dir(root, it), ignore_errors=True)
+            metrics_lib.RESILIENCE_EVENTS.inc("checkpoint_gc_deleted")
+    for p in Path(root).glob(f"iter_*{STAGING_SUFFIX}"):
+        if p != checkpoint_dir(root, current).with_name(
+                checkpoint_dir(root, current).name + STAGING_SUFFIX):
+            shutil.rmtree(p, ignore_errors=True)
 
 
 def load_meta(root: str, iteration: Optional[int | str] = None) -> dict:
@@ -92,22 +214,55 @@ def load_meta(root: str, iteration: Optional[int | str] = None) -> dict:
     return json.loads(meta_file.read_text())
 
 
+def _resolve_load_target(root: str) -> int | str:
+    """Tracker target if complete; else the newest complete iteration
+    (with a loud warning — this is the torn-checkpoint recovery path);
+    else a complete ``release``; else FileNotFoundError."""
+    target = read_tracker(root)
+    if target is not None and is_complete(root, target):
+        return target
+    fallback = latest_complete_iteration(root)
+    if fallback is None and is_complete(root, RELEASE):
+        fallback = RELEASE
+    if fallback is None:
+        if target is None:
+            raise FileNotFoundError(
+                f"no {TRACKER_FILENAME} under {root} and no complete "
+                "checkpoint found; nothing to load")
+        raise FileNotFoundError(
+            f"tracker under {root} points at {target!r} which is torn or "
+            "missing, and no complete checkpoint exists to fall back to")
+    if target is not None:
+        logger.warning(
+            "tracker under %s points at %r which is incomplete (interrupted "
+            "save?); falling back to newest complete checkpoint %r",
+            root, target, fallback)
+    else:
+        logger.warning(
+            "no usable tracker under %s; recovered newest complete "
+            "checkpoint %r by directory scan", root, fallback)
+    metrics_lib.RESILIENCE_EVENTS.inc("checkpoint_fallbacks")
+    return fallback
+
+
 def load_checkpoint(
     root: str,
     template: Any,
     iteration: Optional[int | str] = None,
+    *,
+    retries: int = 3,
 ) -> tuple[Any, int | str]:
     """Restore state shaped/sharded like ``template`` (abstract arrays with
     shardings welcome) — resharding on load is implicit.
 
     Reference load_checkpoint (checkpointing.py:562-678): reads the tracker
-    to find the newest iteration unless one is pinned.
+    to find the newest iteration unless one is pinned.  An unpinned load
+    whose tracker target is torn/missing falls back to the newest
+    *complete* checkpoint (counted + warned); a pinned iteration is an
+    explicit user request and still fails hard when incomplete.
     """
     if iteration is None:
-        iteration = read_tracker(root)
-        if iteration is None:
-            raise FileNotFoundError(
-                f"no {TRACKER_FILENAME} under {root}; nothing to load")
+        iteration = _resolve_load_target(root)
     path = checkpoint_dir(root, iteration)
     if iteration == RELEASE:
         # 'release' checkpoints are params-only (conversion output): restore
@@ -116,14 +271,19 @@ def load_checkpoint(
         # (checkpointing.py:414-473).
         params = load_release_params(root, template.params)
         return template._replace(params=params), iteration
-    if not (path / "state").exists():
+    if not is_complete(root, iteration):
         raise FileNotFoundError(
-            f"checkpoint {path} has no state/ directory — the save was "
-            "interrupted or the directory was lost; refusing to fall back "
-            "silently (pin iteration='release' to load base weights)")
+            f"checkpoint {path} has no complete state/ payload — the save "
+            "was interrupted or the directory was lost; refusing to fall "
+            "back silently from a pinned iteration (pin "
+            "iteration='release' to load base weights)")
     abstract = jax.tree.map(_as_abstract, template)
-    with ocp.StandardCheckpointer() as ckptr:
-        state = ckptr.restore((path / "state").absolute(), abstract)
+
+    def restore():
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore((path / "state").absolute(), abstract)
+
+    state = with_retries(restore, site="ckpt-restore", attempts=retries)
     return state, iteration
 
 
@@ -153,15 +313,26 @@ def load_config_from_checkpoint(
 def save_release_params(root: str, params: Any,
                         cfg: Optional[RuntimeConfig] = None) -> Path:
     """Write a params-only 'release' checkpoint (the output of weight
-    conversion; reference hf_to_megatron.py writes tracker='release')."""
-    path = checkpoint_dir(root, RELEASE)
-    path.mkdir(parents=True, exist_ok=True)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save((path / "params").absolute(), params, force=True)
-    if cfg is not None:
-        (path / "config.json").write_text(cfg.to_json())
+    conversion; reference hf_to_megatron.py writes tracker='release').
+    Same staged-commit discipline as ``save_checkpoint``."""
+    final = checkpoint_dir(root, RELEASE)
+    staging = final.with_name(final.name + STAGING_SUFFIX)
+    if staging.exists():
+        shutil.rmtree(staging)
+    staging.mkdir(parents=True)
+    try:
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save((staging / "params").absolute(), params, force=True)
+        if cfg is not None:
+            (staging / "config.json").write_text(cfg.to_json())
+        if final.exists():
+            shutil.rmtree(final)
+        os.replace(staging, final)
+    except Exception:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
     write_tracker(root, RELEASE)
-    return path
+    return final
 
 
 def load_release_params(root: str, template: Any) -> Any:
@@ -183,18 +354,24 @@ def load_params_for_inference(root: str, model_cfg: Any,
     template = jax.eval_shape(
         lambda: model_lib.init_params(jax.random.key(0), model_cfg))
     if iteration is None:
-        iteration = read_tracker(root)
-        if iteration is None:
-            raise FileNotFoundError(f"no {TRACKER_FILENAME} under {root}")
+        iteration = _resolve_load_target(root)
     if iteration == RELEASE:
         return load_release_params(root, template)
     path = checkpoint_dir(root, iteration)
     # Partial restore of just the params subtree — optimizer state (fp32
     # master weights + Adam moments, ~4-5× the param bytes) is never read.
     abstract = jax.tree.map(_as_abstract, template)
+    item = {"params": abstract}
+    # ``transforms={}`` + explicit restore_args is the stable spelling of a
+    # partial restore (keys absent from `item` are skipped entirely) across
+    # the orbax versions we support; newer releases also accept
+    # ``partial_restore=True`` but older ones reject the kwarg.
+    restore_args = jax.tree.map(
+        lambda s: ocp.ArrayRestoreArgs(restore_type=np.ndarray, dtype=s.dtype),
+        item)
     with ocp.PyTreeCheckpointer() as ckptr:
         restored = ckptr.restore(
             (path / "state").absolute(),
-            args=ocp.args.PyTreeRestore(item={"params": abstract},
-                                        partial_restore=True))
+            args=ocp.args.PyTreeRestore(item=item, transforms={},
+                                        restore_args=restore_args))
     return restored["params"]

@@ -411,6 +411,21 @@ class TrainConfig:
     save: Optional[str] = None
     load: Optional[str] = None
     save_interval: int = 1000
+    # retention: keep only the newest N complete checkpoints (0 = keep all)
+    keep_latest_checkpoints: int = 0
+    # bounded exponential-backoff retries around orbax/tensorstore I/O
+    checkpoint_retries: int = 3
+    # anomaly defense (resilience/anomaly.py): a step whose loss is
+    # non-finite — or exceeds the accepted-loss EWMA by z_threshold
+    # deviations (0 = spike detection off) — is skipped bitwise; after
+    # anomaly_rollback_after consecutive data anomalies (0 = never) the
+    # driver reloads the last checkpoint and skips past the poisoned data
+    # window, giving up after anomaly_max_rollbacks.
+    anomaly_z_threshold: float = 0.0
+    anomaly_ewma_alpha: float = 0.02
+    anomaly_warmup_steps: int = 20
+    anomaly_rollback_after: int = 0
+    anomaly_max_rollbacks: int = 10
     # logging
     log_interval: int = 10
     tensorboard_dir: Optional[str] = None

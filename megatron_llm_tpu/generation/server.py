@@ -21,7 +21,10 @@ contract:
 - ``400`` remains only for a prompt whose length + ``tokens_to_generate``
   exceeds the per-slot sequence budget;
 - when the bounded queue is full the server answers ``503`` with a
-  ``Retry-After`` hint instead of blocking the HTTP thread.
+  ``Retry-After`` hint instead of blocking the HTTP thread;
+- on SIGTERM the server drains gracefully: in-flight generations run to
+  completion (bounded by a drain timeout) while new submissions get
+  ``503``, then the listener stops (docs/serving.md, robustness).
 
 Beam search and scoring (``tokens_to_generate=0``) keep the legacy
 one-shot path behind the lock — they run as dedicated jitted programs, not
@@ -55,7 +58,8 @@ class GenerationService:
                  speculative: str | None = None,
                  engine=None, queue_size: int = 32,
                  engine_max_seq_len: int | None = None,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0,
+                 request_deadline_s: float | None = None):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -73,11 +77,16 @@ class GenerationService:
             engine_max_seq_len or cfg.max_position_embeddings,
             cfg.max_position_embeddings)
         self.retry_after_s = retry_after_s
+        # wall-clock budget per generation request (docs/serving.md,
+        # robustness): expired requests finish with reason "timeout"
+        # instead of holding a KV slot or queue position forever
+        self.request_deadline_s = request_deadline_s
         # the lock now guards only the legacy one-shot paths (beam search,
         # scoring, PLD); standard generation goes through the engine
         self.lock = threading.Lock()
         self._engine = engine
         self._engine_init_lock = threading.Lock()
+        self._draining = False
 
     @property
     def engine(self):
@@ -92,8 +101,22 @@ class GenerationService:
                     EngineConfig(max_batch_size=self.max_batch_size,
                                  max_seq_len=self.engine_max_seq_len,
                                  max_queue_size=self.queue_size,
-                                 retry_after_s=self.retry_after_s))
+                                 retry_after_s=self.retry_after_s,
+                                 default_deadline_s=self.request_deadline_s))
             return self._engine
+
+    def drain(self, timeout: float | None = 30.0) -> bool:
+        """Stop accepting generation requests and wait for the in-flight
+        ones to complete.  True once idle (trivially so if the engine was
+        never created), False if the timeout expired first."""
+        with self._engine_init_lock:
+            # sticky: the lazy `engine` property must not resurrect a
+            # fresh, accepting engine after the drained one is closed
+            self._draining = True
+            engine = self._engine
+        if engine is None:
+            return True
+        return engine.drain(timeout)
 
     def close(self) -> None:
         with self._engine_init_lock:
@@ -274,6 +297,11 @@ class GenerationService:
         # -- submit to the engine (all-or-nothing) ------------------------
         from ..serving import QueueFull
 
+        if self._draining:
+            return 503, {"message": "server is draining (shutting down); "
+                                    "not accepting generation requests",
+                         "retry_after": int(math.ceil(self.retry_after_s))}
+
         specs = []
         for i, t in enumerate(ids):
             specs.append(dict(
@@ -358,11 +386,16 @@ class MegatronServer:
                  **service_kw):
         self.service = GenerationService(cfg, params, tokenizer, **service_kw)
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._prev_sigterm = None
 
     def run(self, host: str = "0.0.0.0", port: int = 5000,
-            block: bool = True):
+            block: bool = True, graceful_sigterm: bool = True,
+            drain_timeout_s: float = 30.0):
         handler = type("Handler", (_Handler,), {"service": self.service})
         self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._drain_timeout_s = drain_timeout_s
+        if graceful_sigterm:
+            self._install_sigterm_handler()
         if block:
             self._httpd.serve_forever()
         else:
@@ -376,7 +409,41 @@ class MegatronServer:
         assert self._httpd is not None
         return self._httpd.server_address[1]
 
+    def _install_sigterm_handler(self) -> None:
+        import signal
+
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                               self._on_sigterm)
+        except ValueError:
+            # signal.signal is only legal on the main thread (tests and
+            # embedders start the server elsewhere) — drain on request only
+            self._prev_sigterm = None
+
+    def _on_sigterm(self, signum, frame) -> None:
+        # The handler may run on the thread blocked in serve_forever();
+        # httpd.shutdown() would deadlock there, so drain on a worker.
+        threading.Thread(target=self.graceful_shutdown,
+                         name="sigterm-drain", daemon=True).start()
+
+    def graceful_shutdown(self, drain_timeout_s: float | None = None) -> bool:
+        """Drain in-flight generations (new submissions get 503), then stop
+        the HTTP listener.  Returns whether the drain completed in time."""
+        if drain_timeout_s is None:
+            drain_timeout_s = getattr(self, "_drain_timeout_s", 30.0)
+        drained = self.service.drain(drain_timeout_s)
+        self.shutdown()
+        return drained
+
     def shutdown(self):
+        if self._prev_sigterm is not None:
+            import signal
+
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._prev_sigterm = None
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -10,10 +10,54 @@ the jitted eval step.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+
+
+class EventCounters:
+    """Thread-safe named event counters for host-side resilience events.
+
+    Unlike the registry metrics below (pure jnp inside the jitted eval
+    step), these count *host* events — checkpoint saves/retries/fallbacks,
+    anomaly skips, rollbacks — written by the training driver, the
+    checkpointing layer, and the retry helper, and read by tests and the
+    tensorboard export (``write``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + by
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+    def write(self, writer, iteration: int,
+              prefix: str = "resilience") -> None:
+        """Export to a tensorboard-style writer (``add_scalar``)."""
+        for name, value in sorted(self.snapshot().items()):
+            writer.add_scalar(f"{prefix}/{name}", value, iteration)
+
+
+# Process-global resilience event stream: checkpoint_saves, io_retries,
+# io_giveups, checkpoint_fallbacks, checkpoint_gc_deleted, anomalies,
+# rollbacks, ... (producers name events freely; docs/robustness.md lists
+# the ones the training stack emits).
+RESILIENCE_EVENTS = EventCounters()
 
 
 class MetricInput:

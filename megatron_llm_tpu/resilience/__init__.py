@@ -1,0 +1,22 @@
+"""Fault tolerance for long preemptible runs.
+
+Three cooperating pieces (docs/robustness.md):
+
+- ``io``      — atomic filesystem commits (tmp + ``os.replace``) and
+                retry-with-exponential-backoff around checkpoint I/O.
+- ``anomaly`` — in-graph EWMA loss-spike / NaN defense carried inside the
+                TrainState so skip decisions survive donation and
+                checkpointing.
+- ``chaos``   — the deterministic fault-injection harness the recovery
+                tests drive; inert (dict lookups on a disarmed global)
+                in production.
+"""
+
+from .anomaly import (  # noqa: F401
+    GuardState,
+    guard_spec,
+    guard_update,
+    init_guard_state,
+)
+from .chaos import Chaos, SimulatedCrash, chaos, poison_nan  # noqa: F401
+from .io import atomic_write_text, with_retries  # noqa: F401

@@ -1,0 +1,101 @@
+"""In-graph anomaly defense: NaN/inf and EWMA-z-score loss-spike gating.
+
+The train step donates its input state (``donate_argnums=(0,)``), so by
+the time the host sees a bad loss the pre-step params no longer exist —
+skip decisions must therefore be made *inside* the jitted step.  The
+guard is a tiny scalar state carried in the TrainState (so it is
+checkpointed and resumes with the run):
+
+- ``ewma`` / ``emvar`` — exponentially-weighted mean and variance of the
+  loss over **accepted** steps only (an anomalous loss must not drag the
+  baseline toward itself);
+- ``steps``           — accepted steps observed (warmup gate: the
+  variance estimate is meaningless for the first few steps);
+- ``run``             — consecutive *data* anomalies (NaN loss or spike).
+  fp16 loss-scale overflows (``found_inf`` with a finite loss) skip the
+  update but neither count toward nor reset the run: they are a routine
+  scaler search, not poisoned data.
+
+A step is **anomalous** (params/optimizer bitwise preserved) when the
+grads are non-finite, the loss is non-finite, or — past warmup, with
+``z_threshold > 0`` — the loss exceeds the EWMA baseline by
+``z * max(std, 0.02*|ewma| + 1e-3)``; the relative floor keeps a
+near-constant loss (vanishing variance) from flagging noise.  The
+training driver escalates ``run >= K`` to a rollback
+(reference skipped-iteration semantics: optimizer/optimizer.py:418-432,
+widened from found_inf-only to data anomalies).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class GuardState(NamedTuple):
+    ewma: jax.Array   # f32: EWMA of the loss over accepted steps
+    emvar: jax.Array  # f32: EWMA of squared deviation from the mean
+    steps: jax.Array  # i32: accepted (non-anomalous) steps observed
+    run: jax.Array    # i32: consecutive data-anomalous steps
+
+
+def init_guard_state() -> GuardState:
+    return GuardState(
+        ewma=jnp.zeros((), jnp.float32),
+        emvar=jnp.zeros((), jnp.float32),
+        steps=jnp.zeros((), jnp.int32),
+        run=jnp.zeros((), jnp.int32),
+    )
+
+
+def guard_spec() -> GuardState:
+    """Replicated PartitionSpecs for the guard scalars (TrainState spec
+    construction sites)."""
+    return GuardState(ewma=P(), emvar=P(), steps=P(), run=P())
+
+
+def guard_update(guard: GuardState, loss: jax.Array, found_inf: jax.Array,
+                 *, z_threshold: float, alpha: float, warmup_steps: int):
+    """One in-graph guard step → ``(new_guard, anomalous, data_anomaly)``.
+
+    ``anomalous`` gates the whole optimizer update (like ``found_inf``
+    alone used to); ``data_anomaly`` is what the run counter and the
+    driver's rollback escalation track.
+    """
+    loss = loss.astype(jnp.float32)
+    bad_loss = ~jnp.isfinite(loss)
+    if z_threshold > 0:
+        warm = guard.steps >= warmup_steps
+        std = jnp.sqrt(jnp.maximum(guard.emvar, 0.0))
+        floor = 0.02 * jnp.abs(guard.ewma) + 1e-3
+        spike = (warm & ~bad_loss
+                 & ((loss - guard.ewma)
+                    > z_threshold * jnp.maximum(std, floor)))
+    else:
+        spike = jnp.zeros((), bool)
+    data_anomaly = bad_loss | spike
+    anomalous = data_anomaly | found_inf
+    accepted = ~anomalous
+
+    first = guard.steps == 0
+    safe_loss = jnp.where(bad_loss, 0.0, loss)  # keep NaN out of the stats
+    delta = safe_loss - guard.ewma
+    new_ewma = jnp.where(
+        accepted, jnp.where(first, safe_loss, guard.ewma + alpha * delta),
+        guard.ewma)
+    new_emvar = jnp.where(
+        accepted & ~first,
+        (1.0 - alpha) * (guard.emvar + alpha * delta * delta),
+        guard.emvar)
+    new_guard = GuardState(
+        ewma=new_ewma,
+        emvar=new_emvar,
+        steps=guard.steps + accepted.astype(jnp.int32),
+        # a scaler-overflow skip holds the run; an accepted step resets it
+        run=jnp.where(data_anomaly, guard.run + 1,
+                      jnp.where(accepted, 0, guard.run)),
+    )
+    return new_guard, anomalous, data_anomaly

@@ -1,0 +1,138 @@
+"""Deterministic fault-injection harness.
+
+Recovery code that is never executed is recovery code that does not work,
+so the resilience layer is instrumented with named **chaos points** —
+``chaos().point("ckpt-pre-commit")`` &c. — that are inert no-ops until a
+test arms the process-global controller:
+
+- ``fail_io(site, times=n)``   — the next ``n`` I/O attempts at ``site``
+  raise ``OSError`` (exercises the retry/backoff path);
+- ``crash_at(site)``           — raise ``SimulatedCrash`` at the point
+  (a ``BaseException``: recovery code's ``except Exception`` cleanup
+  cannot swallow it, just like a real kill);
+- ``kill_at(site)``            — ``os.kill(os.getpid(), SIGKILL)`` at the
+  point, for subprocess tests that need a *real* untrappable death;
+- ``poison_batches(iters)``    — the training driver NaN-poisons the
+  batches of those 1-based iterations (exercises skip/rollback).
+
+Every armed controller lives in one process; tests reset it between
+cases (``tests/resilience/conftest.py``).  The hooks cost two dict
+lookups when disarmed, so the instrumentation stays in production code.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+
+class SimulatedCrash(BaseException):
+    """A chaos-injected hard crash.  Deliberately NOT an ``Exception``:
+    retry loops and cleanup handlers catch ``Exception``/``OSError`` and a
+    simulated kill must tear through them the way SIGKILL would."""
+
+    def __init__(self, site: str):
+        super().__init__(f"chaos: simulated crash at {site!r}")
+        self.site = site
+
+
+class Chaos:
+    """Process-global fault-injection controller (see module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._io_failures: dict[str, list] = {}   # site -> [remaining, exc]
+        self._crashes: set[str] = set()
+        self._kills: dict[str, int] = {}          # site -> signal number
+        self._poisoned_iters: set[int] = set()
+        self.events: list[tuple[str, str]] = []   # (kind, site) fired log
+
+    # -- arming (test side) -------------------------------------------------
+
+    def reset(self) -> None:
+        with self._lock:
+            self._io_failures.clear()
+            self._crashes.clear()
+            self._kills.clear()
+            self._poisoned_iters.clear()
+            self.events.clear()
+
+    def fail_io(self, site: str, times: int = 1,
+                exc: Optional[Callable[[], BaseException]] = None) -> None:
+        """Make the next ``times`` I/O attempts at ``site`` raise."""
+        if exc is None:
+            def exc(site=site):
+                return OSError(f"chaos: injected I/O failure at {site!r}")
+        with self._lock:
+            self._io_failures[site] = [int(times), exc]
+
+    def crash_at(self, site: str) -> None:
+        with self._lock:
+            self._crashes.add(site)
+
+    def kill_at(self, site: str, sig: int = signal.SIGKILL) -> None:
+        with self._lock:
+            self._kills[site] = int(sig)
+
+    def poison_batches(self, iterations: Iterable[int]) -> None:
+        """NaN-poison the batches of these 1-based training iterations."""
+        with self._lock:
+            self._poisoned_iters.update(int(i) for i in iterations)
+
+    # -- hooks (instrumented-code side; inert unless armed) -----------------
+
+    def point(self, site: str) -> None:
+        """A named crash/kill site inside instrumented code."""
+        with self._lock:
+            sig = self._kills.pop(site, None)
+            crash = site in self._crashes
+            if crash:
+                self._crashes.discard(site)
+            if sig is not None or crash:
+                self.events.append(("kill" if sig is not None else "crash",
+                                    site))
+        if sig is not None:
+            os.kill(os.getpid(), sig)
+        if crash:
+            raise SimulatedCrash(site)
+
+    def io_attempt(self, site: str) -> None:
+        """An I/O attempt at ``site``; raises while a failure is armed."""
+        with self._lock:
+            armed = self._io_failures.get(site)
+            if armed is None or armed[0] <= 0:
+                return
+            armed[0] -= 1
+            self.events.append(("fail_io", site))
+            exc = armed[1]
+        raise exc()
+
+    def corrupt_batch(self, batch: dict, iteration: int) -> dict:
+        """Return ``batch`` NaN-poisoned iff ``iteration`` is armed."""
+        with self._lock:
+            poisoned = iteration in self._poisoned_iters
+            if poisoned:
+                self.events.append(("poison", f"iter-{iteration}"))
+        return poison_nan(batch) if poisoned else batch
+
+
+def poison_nan(batch: dict) -> dict:
+    """A corrupted-data batch: NaN loss weights propagate to a NaN loss
+    and NaN grads, exactly how a poisoned corpus shard presents to the
+    step (the gather itself never traps on TPU/XLA)."""
+    batch = dict(batch)
+    mask = np.asarray(batch["loss_mask"], np.float32)
+    batch["loss_mask"] = np.full_like(mask, np.nan)
+    return batch
+
+
+_GLOBAL = Chaos()
+
+
+def chaos() -> Chaos:
+    """The process-global controller the instrumented code consults."""
+    return _GLOBAL

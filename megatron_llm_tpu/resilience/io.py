@@ -1,0 +1,67 @@
+"""Atomic filesystem commits + bounded retry around checkpoint I/O.
+
+The crash-safety contract of checkpointing.py rests on two primitives:
+
+- ``atomic_write_text``: tmp file + fsync + ``os.replace`` — a reader can
+  observe the old content or the new content, never a torn write
+  (rename(2) is atomic within a filesystem, which also holds for the
+  fuse/gcsfuse mounts TPU pods use for checkpoint roots);
+- ``with_retries``: exponential backoff around orbax/tensorstore calls,
+  because object-store I/O fails transiently at pod scale and a 3-day run
+  must not die on one 503.
+
+Both consult the chaos controller so the failure paths are testable.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+from .chaos import chaos
+
+T = TypeVar("T")
+
+
+def atomic_write_text(path: str | Path, text: str,
+                      site: str = "atomic-replace") -> None:
+    """Write ``text`` to ``path`` so a crash never leaves a torn file."""
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    chaos().point(site)  # crash window: tmp written, target untouched
+    os.replace(tmp, path)
+
+
+def with_retries(fn: Callable[[], T], *, site: str, attempts: int = 3,
+                 base_delay_s: float = 0.05, max_delay_s: float = 2.0,
+                 retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+                 sleep: Callable[[float], None] = time.sleep,
+                 on_retry: Optional[Callable[[int, BaseException], None]]
+                 = None) -> T:
+    """Run ``fn`` with exponential backoff on ``retry_on`` failures.
+
+    ``site`` names the operation for chaos injection and event counting.
+    The last failure propagates once ``attempts`` are exhausted.
+    """
+    from .. import metrics as metrics_lib
+
+    assert attempts >= 1
+    for attempt in range(attempts):
+        try:
+            chaos().io_attempt(site)
+            return fn()
+        except retry_on as e:
+            if attempt + 1 >= attempts:
+                metrics_lib.RESILIENCE_EVENTS.inc("io_giveups")
+                raise
+            metrics_lib.RESILIENCE_EVENTS.inc("io_retries")
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(min(base_delay_s * (2 ** attempt), max_delay_s))
+    raise AssertionError("unreachable")

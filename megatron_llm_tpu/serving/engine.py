@@ -72,13 +72,20 @@ class EngineConfig:
     #                               prefill shapes; 1 = exact lengths
     retry_after_s: float = 1.0    # backpressure hint surfaced on QueueFull
     idle_wait_s: float = 0.02     # scheduler sleep when idle / paused
+    default_deadline_s: Optional[float] = None  # per-request wall-clock
+    #                               budget (submit -> finish) applied when a
+    #                               request doesn't set its own; None = no
+    #                               deadline.  Expired requests finish with
+    #                               reason "timeout" instead of occupying a
+    #                               slot / queue position forever.
 
 
 @dataclasses.dataclass
 class FinishedRequest:
     tokens: List[int]             # prompt + generated (EOS included)
     prompt_len: int
-    finish_reason: str            # "eos" | "length" | "cancelled" | "error"
+    finish_reason: str            # "eos" | "length" | "cancelled" |
+    #                               "timeout" | "error"
     logprobs: Optional[List[float]] = None  # [len-1] incl. prompt positions
 
 
@@ -91,7 +98,8 @@ class _Request:
                  eos_id: int = 2, temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 0.0, seed: Optional[int] = None,
                  use_eos_stop: bool = True, return_logprobs: bool = False,
-                 on_token: Optional[Callable[[int], None]] = None):
+                 on_token: Optional[Callable[[int], None]] = None,
+                 deadline_s: Optional[float] = None):
         self.id = next(self._ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -114,6 +122,10 @@ class _Request:
         self.result: Optional[FinishedRequest] = None
         self.submit_time = time.perf_counter()
         self.first_token_time: Optional[float] = None
+        # Absolute wall-clock deadline (perf_counter domain); None = never.
+        self.deadline: Optional[float] = (
+            None if deadline_s is None
+            else self.submit_time + float(deadline_s))
 
 
 class RequestHandle:
@@ -299,6 +311,7 @@ class ServingEngine:
         self._scheduler_error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._paused = threading.Event()
+        self._draining = threading.Event()
         self._started = threading.Event()
         self._lock = threading.Lock()  # guards start/shutdown
 
@@ -333,19 +346,41 @@ class ServingEngine:
     def resume(self) -> None:
         self._paused.clear()
 
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: stop admitting new requests (submissions are
+        rejected with ``QueueFull``), let everything in flight finish, and
+        return True once the engine is idle (False on timeout).
+
+        Used by the HTTP server's SIGTERM handler so a rolling restart
+        never drops partially-generated responses."""
+        self._draining.set()
+        self.queue.notify()
+        if self._thread is None:  # never started: trivially drained
+            return True
+        deadline = (None if timeout is None
+                    else time.perf_counter() + float(timeout))
+        while True:
+            idle = (not self._active and self._admitting is None
+                    and len(self.queue) == 0)
+            if idle or self._stop.is_set():
+                return idle
+            if deadline is not None and time.perf_counter() >= deadline:
+                return False
+            time.sleep(self.config.idle_wait_s)
+
     # -- submission (any thread) ------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
                eos_id: int = 2, temperature: float = 1.0, top_k: int = 0,
                top_p: float = 0.0, seed: Optional[int] = None,
                use_eos_stop: bool = True, return_logprobs: bool = False,
-               on_token: Optional[Callable[[int], None]] = None
-               ) -> RequestHandle:
+               on_token: Optional[Callable[[int], None]] = None,
+               deadline_s: Optional[float] = None) -> RequestHandle:
         return self.submit_many([dict(
             prompt=prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
             temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
             use_eos_stop=use_eos_stop, return_logprobs=return_logprobs,
-            on_token=on_token)])[0]
+            on_token=on_token, deadline_s=deadline_s)])[0]
 
     def submit_many(self, specs: Sequence[dict]) -> List[RequestHandle]:
         """Validate + enqueue a batch of requests all-or-nothing.
@@ -354,8 +389,16 @@ class ServingEngine:
         control: the per-slot sequence budget) and ``QueueFull`` under
         backpressure."""
         self.start()
+        if self._draining.is_set():
+            self.metrics.inc("rejected_draining", by=len(specs))
+            raise QueueFull(
+                "engine is draining (shutting down); not accepting requests",
+                retry_after_s=self.config.retry_after_s)
         reqs = []
         for spec in specs:
+            spec = dict(spec)
+            if spec.get("deadline_s") is None:
+                spec["deadline_s"] = self.config.default_deadline_s
             req = _Request(**spec)
             if len(req.prompt) < 1:
                 self.metrics.inc("rejected_invalid")
@@ -390,10 +433,13 @@ class ServingEngine:
     def _loop(self) -> None:
         try:
             while not self._stop.is_set():
+                # Cancellations and deadline expiry run even while paused:
+                # a paused engine must not hold expired requests hostage.
+                self._drain_cancellations()
+                self._expire_deadlines()
                 if self._paused.is_set():
                     time.sleep(self.config.idle_wait_s)
                     continue
-                self._drain_cancellations()
                 self._admit()
                 if not self._active:
                     self.queue.wait_for_work(self.config.idle_wait_s)
@@ -424,6 +470,22 @@ class ServingEngine:
         for slot in [s for s, st in self._active.items()
                      if st.req.cancel_flag.is_set()]:
             self._retire(slot, "cancelled")
+
+    def _expire_deadlines(self) -> None:
+        """Retire every request past its wall-clock deadline — active slots
+        finish with whatever tokens they produced so far, queued requests
+        expire without ever occupying a slot."""
+        now = time.perf_counter()
+
+        def expired(req: _Request) -> bool:
+            return req.deadline is not None and now >= req.deadline
+
+        for slot in [s for s, st in self._active.items()
+                     if expired(st.req)]:
+            self._retire(slot, "timeout")
+        for req in self.queue.remove_if(expired):
+            self._finish(req, "timeout")
+        self.metrics.set_gauges(queue_depth=len(self.queue))
 
     def _admit(self) -> None:
         assert self.slots is not None
@@ -560,6 +622,8 @@ class ServingEngine:
             logprobs=list(req.logprobs) if req.return_logprobs else None)
         if reason == "cancelled":
             self.metrics.inc("cancelled")
+        elif reason == "timeout":
+            self.metrics.inc("timeouts")
         elif reason != "error":
             self.metrics.inc("completed")
             self.metrics.observe_e2e(time.perf_counter() - req.submit_time)

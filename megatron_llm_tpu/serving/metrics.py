@@ -64,8 +64,8 @@ class LatencyHistogram:
 
 
 _COUNTERS = (
-    "submitted", "admitted", "completed", "cancelled",
-    "rejected_queue_full", "rejected_invalid",
+    "submitted", "admitted", "completed", "cancelled", "timeouts",
+    "rejected_queue_full", "rejected_invalid", "rejected_draining",
     "prefills", "decode_iterations", "decode_tokens",
 )
 

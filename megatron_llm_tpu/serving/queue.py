@@ -90,6 +90,20 @@ class RequestQueue:
             except ValueError:
                 return False
 
+    def remove_if(self, pred) -> list:
+        """Drop and return every queued request matching ``pred`` (used by
+        the scheduler's deadline sweep, which must expire requests that
+        never reached a slot)."""
+        with self._cond:
+            kept, removed = deque(), []
+            for req in self._q:
+                if pred(req):
+                    removed.append(req)
+                else:
+                    kept.append(req)
+            self._q = kept
+            return removed
+
     def wait_for_work(self, timeout: Optional[float] = None) -> bool:
         """Block until the queue is non-empty (or timeout); True if work."""
         with self._cond:

@@ -36,6 +36,14 @@ def main(argv=None) -> int:
                          "default: the model's max_position_embeddings")
     ap.add_argument("--retry_after_s", type=float, default=1.0,
                     help="Retry-After hint returned with 503 backpressure")
+    ap.add_argument("--request_deadline_s", type=float, default=None,
+                    help="per-request wall-clock budget: requests still "
+                         "queued or decoding past this finish with reason "
+                         "'timeout' instead of holding a KV slot forever "
+                         "(docs/serving.md, robustness); default: none")
+    ap.add_argument("--drain_timeout_s", type=float, default=30.0,
+                    help="on SIGTERM, how long to let in-flight requests "
+                         "finish before the listener stops")
     ap.add_argument("--quantize", default=None, choices=["int8"],
                     help="weight-only int8 (halves decode HBM traffic; "
                          "ops/quant.py)")
@@ -102,13 +110,16 @@ def main(argv=None) -> int:
         speculative=args.speculative,
         queue_size=args.queue_size,
         engine_max_seq_len=args.max_seq_len,
-        retry_after_s=args.retry_after_s)
+        retry_after_s=args.retry_after_s,
+        request_deadline_s=args.request_deadline_s)
     print(f"serving on {args.host}:{args.port}")
     if mesh_ctx is not None:
         with mesh_ctx:
-            server.run(args.host, args.port)
+            server.run(args.host, args.port,
+                       drain_timeout_s=args.drain_timeout_s)
     else:
-        server.run(args.host, args.port)
+        server.run(args.host, args.port,
+                   drain_timeout_s=args.drain_timeout_s)
     return 0
 
 

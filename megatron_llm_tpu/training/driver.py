@@ -39,6 +39,7 @@ from ..models import sharding as shard_lib
 from ..models.transformer import rope_tables
 from ..parallel import mesh as mesh_lib
 from ..parallel.cross_entropy import cross_entropy, masked_mean_loss
+from ..resilience import chaos, guard_spec
 from ..utils.timers import Timers
 from ..utils.writers import NullWriter, build_writer
 from . import optimizer as opt_lib
@@ -181,7 +182,8 @@ def _shard_train_state(cfg: RuntimeConfig, mesh, params: PyTree,
     state = init_train_state(cfg, params)
     ospecs = opt_lib.opt_state_specs(pspecs, params, cfg.parallel, state.opt)
     state_spec = TrainState(
-        params=pspecs, opt=ospecs, iteration=P(), skipped=P())
+        params=pspecs, opt=ospecs, iteration=P(), skipped=P(),
+        guard=guard_spec())
     state_sharding = jax.tree.map(
         lambda s: NamedSharding(mesh, s), state_spec,
         is_leaf=lambda x: isinstance(x, P))
@@ -219,6 +221,7 @@ def _dedupe_buffers(state: TrainState) -> TrainState:
         ),
         iteration=cp(state.iteration),
         skipped=cp(state.skipped),
+        guard=cp(state.guard),
     )
 
 
@@ -375,6 +378,7 @@ class _LogState:
         self.total_loss = 0.0
         self.count = 0
         self.skipped_total = 0
+        self.anomaly_total = 0
         self.tokens = 0
         self.t_start = time.perf_counter()
 
@@ -389,8 +393,15 @@ def training_log(cfg: RuntimeConfig, log: _LogState, metrics: dict,
                  iteration: int, consumed_samples: int, writer,
                  timers: Timers) -> None:
     loss = float(metrics["loss"])
-    log.total_loss += loss
-    log.count += 1
+    anomalous = bool(int(metrics.get("anomaly", 0)))
+    if anomalous:
+        # an anomalous step's loss (possibly NaN) must not poison the
+        # logged window average; the event is counted instead
+        log.anomaly_total += 1
+        metrics_lib.RESILIENCE_EVENTS.inc("anomalies")
+    else:
+        log.total_loss += loss
+        log.count += 1
     log.skipped_total += int(metrics["skipped"])
 
     if (not cfg.train.log_interval
@@ -418,6 +429,7 @@ def training_log(cfg: RuntimeConfig, log: _LogState, metrics: dict,
         f" loss scale: {loss_scale:.1f} |"
         f" grad norm: {grad_norm:.3f} |"
         f" number of skipped iterations: {log.skipped_total:3d} |"
+        f" number of anomalous iterations: {log.anomaly_total:3d} |"
     )
     if "moe_dropped_frac" in metrics:
         line += (
@@ -441,6 +453,9 @@ def training_log(cfg: RuntimeConfig, log: _LogState, metrics: dict,
         writer.add_scalar("train/tokens_per_sec", tokens_per_sec, iteration)
         writer.add_scalar("train/consumed_samples", consumed_samples,
                           iteration)
+        writer.add_scalar("train/anomalous_iterations", log.anomaly_total,
+                          iteration)
+        metrics_lib.RESILIENCE_EVENTS.write(writer, iteration)
         timers.write(writer, iteration, reset=False)
     timers.log(normalizer=max(log.count, 1),
                printer=print if jax.process_index() == 0 else None)
@@ -561,8 +576,11 @@ def pretrain(
     consumed_samples = 0
     if cfg.train.load:
         try:
-            state, tag = checkpointing.load_checkpoint(cfg.train.load, state)
-            meta = checkpointing.load_meta(cfg.train.load)
+            state, tag = checkpointing.load_checkpoint(
+                cfg.train.load, state, retries=cfg.train.checkpoint_retries)
+            # meta must come from the iteration actually loaded — under
+            # torn-tracker fallback that can differ from the tracker target
+            meta = checkpointing.load_meta(cfg.train.load, tag)
             if tag != checkpointing.RELEASE:
                 iteration = int(tag)
                 consumed_samples = int(meta.get("consumed_samples", 0))
@@ -645,6 +663,16 @@ def pretrain(
         if profiling and done_it >= cfg.train.profile_step_end:
             _close_profiler("window complete")
 
+    # Anomaly rollback needs a checkpoint to roll back TO; anchor the run
+    # with an initial save when none exists yet.
+    rollbacks = 0
+    if (cfg.train.anomaly_rollback_after and cfg.train.save
+            and checkpointing.latest_complete_iteration(cfg.train.save)
+            is None):
+        print_rank_0(" anomaly rollback enabled with no checkpoint on "
+                     "disk; writing the initial rollback anchor")
+        _save(cfg, state, iteration, consumed_samples, timers)
+
     print_rank_0(f" training starts at iteration {iteration} / "
                  f"{cfg.train.train_iters}")
     with DistSignalHandler() as sig, art.mesh:
@@ -683,6 +711,9 @@ def pretrain(
             except StopIteration:
                 train_iter = make_train_iter(consumed_samples, current_gbs)
                 batch = next(train_iter)
+            # chaos hook (inert unless a test armed poison_batches): NaN
+            # batches exercise the skip/rollback defenses end-to-end
+            batch = chaos().corrupt_batch(batch, iteration + 1)
             dev_batch = _put_batch(batch, art.batch_sharding)
             timers("batch-generator").stop()
 
@@ -703,6 +734,24 @@ def pretrain(
             log.tokens += current_gbs * cfg.train.seq_length
             training_log(cfg, log, step_metrics, iteration, consumed_samples,
                          writer, timers)
+
+            # --- anomaly rollback (resilience/anomaly.py) ---
+            # K consecutive data anomalies: the poisoned window is wider
+            # than per-step skips can absorb — restore the last complete
+            # checkpoint and keep consumed_samples where it is, so the
+            # resumed iterations read *past* the poisoned data.
+            k_roll = cfg.train.anomaly_rollback_after
+            if k_roll and int(step_metrics.get("anomaly_run", 0)) >= k_roll:
+                state, iteration = rollback_to_last_checkpoint(
+                    cfg, state, rollbacks + 1)
+                rollbacks += 1
+                print_rank_0(
+                    f" ANOMALY ROLLBACK #{rollbacks}: {k_roll} consecutive "
+                    f"anomalous iterations; restored iteration {iteration} "
+                    f"and skipping the poisoned data window "
+                    f"(consumed_samples stays at {consumed_samples})")
+                log.reset_window()
+                continue
 
             # --- eval hook ---
             if (valid_dataset is not None and eval_step is not None
@@ -783,9 +832,32 @@ def _save(cfg: RuntimeConfig, state, iteration: int, consumed_samples: int,
     timers("save-checkpoint", log_level=0).start()
     path = checkpointing.save_checkpoint(
         cfg.train.save, state, cfg, iteration,
-        meta={"consumed_samples": consumed_samples})
+        meta={"consumed_samples": consumed_samples},
+        retries=cfg.train.checkpoint_retries,
+        keep=cfg.train.keep_latest_checkpoints)
     timers("save-checkpoint").stop()
     print_rank_0(f" saved checkpoint to {path}")
+
+
+def rollback_to_last_checkpoint(cfg: RuntimeConfig, state, attempt: int = 1):
+    """Restore the newest complete checkpoint over ``state`` →
+    ``(restored_state, iteration)``.  ``attempt`` is the 1-based rollback
+    count this run; exceeding ``anomaly_max_rollbacks`` aborts instead of
+    thrashing forever on data that never recovers."""
+    if attempt > cfg.train.anomaly_max_rollbacks:
+        raise RuntimeError(
+            f"giving up after {cfg.train.anomaly_max_rollbacks} anomaly "
+            "rollbacks — the loss anomaly persists beyond skip-ahead "
+            "recovery (bad data shard? diverged run?)")
+    root = cfg.train.save or cfg.train.load
+    if not root:
+        raise RuntimeError(
+            "anomaly_rollback_after is set but neither train.save nor "
+            "train.load provides a checkpoint root to roll back to")
+    state, tag = checkpointing.load_checkpoint(
+        root, state, retries=cfg.train.checkpoint_retries)
+    metrics_lib.RESILIENCE_EVENTS.inc("rollbacks")
+    return state, (0 if tag == checkpointing.RELEASE else int(tag))
 
 
 # ---------------------------------------------------------------------------

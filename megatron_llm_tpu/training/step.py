@@ -26,6 +26,12 @@ from ..config import RuntimeConfig
 from ..models import model as model_lib
 from ..models.transformer import rope_tables
 from ..parallel.cross_entropy import cross_entropy, masked_mean_loss
+from ..resilience.anomaly import (
+    GuardState,
+    guard_spec,  # noqa: F401  (re-exported for spec-construction sites)
+    guard_update,
+    init_guard_state,
+)
 from . import optimizer as opt_lib
 from . import schedule
 
@@ -36,7 +42,12 @@ class TrainState(NamedTuple):
     params: PyTree
     opt: opt_lib.OptState
     iteration: jax.Array  # i32: completed train steps (incl. skipped)
-    skipped: jax.Array  # i32: iterations skipped due to non-finite grads
+    skipped: jax.Array  # i32: iterations skipped (non-finite grads/loss,
+    #                     loss spikes — any anomalous step)
+    guard: GuardState  # anomaly-defense scalars (resilience/anomaly.py):
+    #                    loss EWMA/variance + consecutive-anomaly run,
+    #                    carried in-state so skip decisions survive
+    #                    donation and checkpointing
     # NOTE: consumed_samples (the resumable-sampling counter) is NOT part of
     # the device state: it can exceed int32 on long pretraining runs, so the
     # training driver keeps it as a python int (like the reference's
@@ -51,6 +62,7 @@ def init_train_state(cfg: RuntimeConfig, params: PyTree) -> TrainState:
                                    use_fp16_scaler=use_scaler),
         iteration=jnp.zeros((), jnp.int32),
         skipped=jnp.zeros((), jnp.int32),
+        guard=init_guard_state(),
     )
 
 
@@ -294,6 +306,15 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
     grad_norm = opt_lib.global_grad_norm(grads)
     found_inf = ~jnp.isfinite(grad_norm)
 
+    # Anomaly defense (resilience/anomaly.py): widen the skip condition
+    # from non-finite grads to non-finite loss and EWMA loss spikes, and
+    # track the consecutive-data-anomaly run the driver's rollback watches.
+    guard_new, anomalous, data_anomaly = guard_update(
+        state.guard, loss, found_inf,
+        z_threshold=cfg.train.anomaly_z_threshold,
+        alpha=cfg.train.anomaly_ewma_alpha,
+        warmup_steps=cfg.train.anomaly_warmup_steps)
+
     if cfg.optimizer.clip_grad > 0:
         grads, _ = opt_lib.clip_by_global_norm(
             grads, cfg.optimizer.clip_grad, norm=grad_norm)
@@ -308,19 +329,22 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
     new_params, new_opt = opt_lib.optimizer_step(
         cfg.optimizer, state.params, grads, state.opt, lr, wd)
 
-    # Skipped-iteration semantics on non-finite grads
-    # (reference: optimizer/optimizer.py:418-432): keep params & moments.
+    # Skipped-iteration semantics on any anomalous step — non-finite grads
+    # (reference: optimizer/optimizer.py:418-432), non-finite loss, or an
+    # EWMA loss spike: keep params & moments bitwise.
     def pick(new, old):
         return jax.tree.map(
-            lambda n, o: jnp.where(found_inf, o, n), new, old)
+            lambda n, o: jnp.where(anomalous, o, n), new, old)
 
     new_params = pick(new_params, state.params)
     new_opt = opt_lib.OptState(
-        step=jnp.where(found_inf, state.opt.step, new_opt.step),
+        step=jnp.where(anomalous, state.opt.step, new_opt.step),
         mu=pick(new_opt.mu, state.opt.mu),
         nu=pick(new_opt.nu, state.opt.nu),
         master=(pick(new_opt.master, state.opt.master)
                 if state.opt.master is not None else None),
+        # the loss scaler reacts to overflow only — a data anomaly says
+        # nothing about the fp16 dynamic range
         scaler=(opt_lib.scaler_update(scaler, found_inf, cfg.optimizer)
                 if scaler is not None else None),
     )
@@ -329,14 +353,17 @@ def train_step(cfg: RuntimeConfig, state: TrainState, batch: dict,
         params=new_params,
         opt=new_opt,
         iteration=it + 1,
-        skipped=state.skipped + found_inf.astype(jnp.int32),
+        skipped=state.skipped + anomalous.astype(jnp.int32),
+        guard=guard_new,
     )
     metrics = {
         "loss": loss,
         "grad_norm": grad_norm,
         "lr": lr,
         "weight_decay": wd,
-        "skipped": found_inf.astype(jnp.int32),
+        "skipped": anomalous.astype(jnp.int32),
+        "anomaly": data_anomaly.astype(jnp.int32),
+        "anomaly_run": guard_new.run,
         "loss_scale": loss_scale,
     }
     if moe_stats is not None:

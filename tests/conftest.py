@@ -87,6 +87,12 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "tpu: requires real TPU hardware")
     config.addinivalue_line(
         "markers",
+        "chaos: fault-injection tests (resilience/chaos.py) — simulated "
+        "I/O failures, crashes mid-save, poisoned batches; CPU-fast and "
+        "part of the default tier-1 run",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: >13s single-test compile cost on the 1-core CI host; "
         "`-m 'not slow'` is the fast inner-loop tier, the full suite "
         "(default) is required before any snapshot/commit of substance",

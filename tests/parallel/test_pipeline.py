@@ -322,6 +322,7 @@ def test_full_train_step_dp_sharded_batch_argument():
     pipeline shard_map now; this compiles + executes the whole step the
     way the training driver invokes it."""
     from megatron_llm_tpu.training.step import (TrainState,
+                                                guard_spec,
                                                 init_train_state,
                                                 make_train_step)
     from megatron_llm_tpu.training import optimizer as opt_lib
@@ -348,7 +349,7 @@ def test_full_train_step_dp_sharded_batch_argument():
         state = init_train_state(rt, params)
         ospecs = opt_lib.opt_state_specs(pspecs, params, par, state.opt)
         state_spec = TrainState(params=pspecs, opt=ospecs, iteration=P(),
-                                skipped=P())
+                                skipped=P(), guard=guard_spec())
         state_sharding = jax.tree.map(
             lambda s: NamedSharding(mesh, s), state_spec,
             is_leaf=lambda x: isinstance(x, P))

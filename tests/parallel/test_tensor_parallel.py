@@ -29,6 +29,7 @@ from megatron_llm_tpu.training import optimizer as opt_lib
 from megatron_llm_tpu.training.step import (
     TrainState,
     compute_loss,
+    guard_spec,
     init_train_state,
     make_train_step,
 )
@@ -166,7 +167,8 @@ def test_zero1_state_equivalence(tp):
         state = init_train_state(runtime, params)
         ospecs = opt_lib.opt_state_specs(pspecs, params, parallel, state.opt)
         state_spec = TrainState(params=pspecs, opt=ospecs,
-                                iteration=P(), skipped=P())
+                                iteration=P(), skipped=P(),
+                                guard=guard_spec())
         state_sharding = jax.tree.map(
             lambda s: NamedSharding(mesh, s), state_spec,
             is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,17 @@
+"""Fault-injection test bootstrap: the chaos controller and the resilience
+event counters are process-global, so every test starts and ends disarmed
+— a leaked armed fault would fail an unrelated test far from the cause."""
+
+import pytest
+
+from megatron_llm_tpu import metrics as metrics_lib
+from megatron_llm_tpu.resilience import chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_chaos():
+    chaos().reset()
+    metrics_lib.RESILIENCE_EVENTS.reset()
+    yield
+    chaos().reset()
+    metrics_lib.RESILIENCE_EVENTS.reset()

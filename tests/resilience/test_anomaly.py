@@ -1,0 +1,191 @@
+"""In-graph anomaly defense: NaN-batch skip and EWMA loss-spike skip.
+
+The train step donates its state buffers, so by the time the host sees a
+bad loss the pre-step params are gone — the skip decision therefore lives
+*inside* the compiled step (resilience/anomaly.py), and these tests prove
+it bitwise: an anomalous step must change nothing but the iteration
+counter and the guard/skip bookkeeping.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import (
+    OptimizerConfig,
+    RuntimeConfig,
+    TrainConfig,
+    tiny_config,
+)
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.resilience import poison_nan
+from megatron_llm_tpu.training.step import (
+    compute_loss,
+    init_train_state,
+    make_train_step,
+)
+
+pytestmark = pytest.mark.chaos
+
+SHAPE = (2, 2, 16)  # [accum, micro, seq]
+
+
+def _cfg(**train_overrides):
+    train = dict(train_iters=50, micro_batch_size=2, global_batch_size=4,
+                 seq_length=16)
+    train.update(train_overrides)
+    return RuntimeConfig(
+        model=tiny_config(seq_length=16, max_position_embeddings=16),
+        optimizer=OptimizerConfig(lr=1e-3, lr_warmup_iters=2),
+        train=TrainConfig(**train),
+    ).validate()
+
+
+def _batch(seed=0, vocab=256):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, vocab, SHAPE)
+    return {
+        "tokens": jnp.asarray(toks, jnp.int32),
+        "labels": jnp.asarray(np.roll(toks, -1, -1), jnp.int32),
+        "loss_mask": jnp.ones(SHAPE, jnp.float32),
+    }
+
+
+def _fresh_state(cfg, seed=0):
+    params = model_lib.init_params(jax.random.key(seed), cfg.model)
+    return init_train_state(cfg, params)
+
+
+def _snapshot(state):
+    """Host copies of everything an anomalous step must preserve bitwise
+    (taken BEFORE the step — donation invalidates the device buffers)."""
+    return jax.device_get({"params": state.params, "mu": state.opt.mu,
+                           "nu": state.opt.nu, "step": state.opt.step})
+
+
+def _assert_bitwise(snapshot, state):
+    after = jax.device_get({"params": state.params, "mu": state.opt.mu,
+                            "nu": state.opt.nu, "step": state.opt.step})
+    for name in ("params", "mu", "nu"):
+        for a, b in zip(jax.tree.leaves(snapshot[name]),
+                        jax.tree.leaves(after[name])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(after["step"]) == int(snapshot["step"])
+
+
+def test_nan_batch_skips_step_bitwise():
+    cfg = _cfg()
+    step = make_train_step(cfg)
+    state = _fresh_state(cfg)
+    state, m = step(state, _batch(0), None)
+    assert int(m["skipped"]) == 0 and int(m["anomaly"]) == 0
+
+    snap = _snapshot(state)
+    state, m = step(state, poison_nan(_batch(1)), None)
+    assert int(m["skipped"]) == 1
+    assert int(m["anomaly"]) == 1
+    assert int(m["anomaly_run"]) == 1
+    assert not np.isfinite(float(m["loss"]))
+    assert int(state.iteration) == 2      # time advances...
+    assert int(state.skipped) == 1
+    _assert_bitwise(snap, state)          # ...the model does not
+
+    # a clean step afterwards updates params and resets the anomaly run
+    state, m = step(state, _batch(2), None)
+    assert int(m["skipped"]) == 0
+    assert int(m["anomaly_run"]) == 0
+    after = jax.device_get(jax.tree.leaves(state.params)[0])
+    assert not np.array_equal(np.asarray(after),
+                              np.asarray(jax.tree.leaves(snap["params"])[0]))
+
+
+def _boost_loss_fn(cfg, p, mb, rng, deterministic):
+    """compute_loss plus a per-microbatch constant from the batch — lets a
+    test inject an exact, finite loss spike (a deterministic finite spike
+    is not constructible from token data at a random init)."""
+    return (compute_loss(cfg, p, mb, rng, deterministic)
+            + jnp.sum(mb["boost"]))
+
+
+def _boosted(seed, boost_total):
+    b = _batch(seed)
+    per_elem = boost_total / (SHAPE[1] * SHAPE[2])
+    b["boost"] = jnp.full(SHAPE, per_elem, jnp.float32)
+    return b
+
+
+def test_loss_spike_skips_step_bitwise():
+    cfg = _cfg(anomaly_z_threshold=4.0, anomaly_warmup_steps=3,
+               anomaly_ewma_alpha=0.2)
+    step = make_train_step(cfg, loss_fn=_boost_loss_fn)
+    state = _fresh_state(cfg)
+    losses = []
+    for i in range(4):  # clean warmup: fills the EWMA stats
+        state, m = step(state, _boosted(i, 0.0), None)
+        losses.append(float(m["loss"]))
+        assert int(m["anomaly"]) == 0, f"warmup step {i} flagged"
+    assert int(state.guard.steps) == 4
+
+    snap = _snapshot(state)
+    state, m = step(state, _boosted(9, 50.0), None)  # +50 over a ~5.5 EWMA
+    assert np.isfinite(float(m["loss"]))  # finite — this is a SPIKE skip
+    assert float(m["loss"]) > max(losses) + 40
+    assert int(m["anomaly"]) == 1
+    assert int(m["skipped"]) == 1
+    assert int(m["anomaly_run"]) == 1
+    _assert_bitwise(snap, state)
+
+    # EWMA stats did not absorb the spike: an identical clean step is
+    # accepted right after
+    state, m = step(state, _boosted(4, 0.0), None)
+    assert int(m["anomaly"]) == 0
+    assert int(m["anomaly_run"]) == 0
+
+
+def test_no_spike_flagging_during_warmup():
+    cfg = _cfg(anomaly_z_threshold=4.0, anomaly_warmup_steps=3,
+               anomaly_ewma_alpha=0.2)
+    step = make_train_step(cfg, loss_fn=_boost_loss_fn)
+    state = _fresh_state(cfg)
+    state, m = step(state, _boosted(0, 0.0), None)
+    assert int(m["anomaly"]) == 0
+    # a huge but finite jump at step 2 — before warmup completes — must
+    # not be flagged (the EWMA has no trustworthy baseline yet)
+    snap_w = np.asarray(
+        jax.device_get(jax.tree.leaves(state.params)[0]))
+    state, m = step(state, _boosted(1, 50.0), None)
+    assert int(m["anomaly"]) == 0
+    assert int(m["skipped"]) == 0
+    after_w = np.asarray(jax.device_get(jax.tree.leaves(state.params)[0]))
+    assert not np.array_equal(snap_w, after_w)  # the step was applied
+
+
+def test_spike_detection_disabled_by_default():
+    cfg = _cfg()  # anomaly_z_threshold defaults to 0.0 == off
+    assert cfg.train.anomaly_z_threshold == 0.0
+    step = make_train_step(cfg, loss_fn=_boost_loss_fn)
+    state = _fresh_state(cfg)
+    for i in range(25):  # far past any warmup
+        state, m = step(state, _boosted(i, 0.0), None)
+    state, m = step(state, _boosted(30, 50.0), None)
+    assert int(m["anomaly"]) == 0
+    assert int(m["skipped"]) == 0
+
+
+def test_nan_anomaly_does_not_poison_ewma():
+    """A NaN loss must not corrupt the spike baseline: after a NaN skip,
+    normal losses keep being accepted."""
+    cfg = _cfg(anomaly_z_threshold=4.0, anomaly_warmup_steps=2,
+               anomaly_ewma_alpha=0.2)
+    step = make_train_step(cfg)
+    state = _fresh_state(cfg)
+    for i in range(3):
+        state, m = step(state, _batch(i), None)
+    state, m = step(state, poison_nan(_batch(7)), None)
+    assert int(m["anomaly"]) == 1
+    for i in range(3, 6):
+        state, m = step(state, _batch(i), None)
+        assert int(m["anomaly"]) == 0, "EWMA poisoned by the NaN step"
+        assert np.isfinite(float(m["loss"]))

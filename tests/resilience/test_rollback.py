@@ -1,0 +1,167 @@
+"""Rollback-after-K-anomalies: driver integration and unit behavior.
+
+The scenario: a poisoned stretch of the corpus NaNs every loss for longer
+than per-step skips should tolerate.  After K consecutive data anomalies
+the driver restores the last complete checkpoint but keeps
+consumed_samples where it is — the replayed iterations therefore read
+*past* the poisoned window and the run completes clean.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from megatron_llm_tpu import checkpointing as ckpt
+from megatron_llm_tpu import metrics as metrics_lib
+from megatron_llm_tpu.config import (
+    OptimizerConfig,
+    RuntimeConfig,
+    TrainConfig,
+    tiny_config,
+)
+from megatron_llm_tpu.resilience import poison_nan
+from megatron_llm_tpu.training.driver import (
+    pretrain,
+    rollback_to_last_checkpoint,
+)
+
+pytestmark = pytest.mark.chaos
+
+SEQ = 16
+GBS = 4  # accum=2 x micro=2 x dp=1
+
+
+def _cfg(tmp_path, **train_overrides):
+    train = dict(train_iters=8, micro_batch_size=2, global_batch_size=GBS,
+                 seq_length=SEQ, save=str(tmp_path / "ckpt"),
+                 save_interval=3, log_interval=1)
+    train.update(train_overrides)
+    return RuntimeConfig(
+        model=tiny_config(num_layers=1, hidden_size=32,
+                          num_attention_heads=2, num_kv_heads=2,
+                          ffn_hidden_size=64, vocab_size=128,
+                          seq_length=SEQ, max_position_embeddings=SEQ),
+        optimizer=OptimizerConfig(lr=1e-3, lr_warmup_iters=2),
+        train=TrainConfig(**train),
+    ).validate()
+
+
+def _sample_batch(pos, vocab):
+    """Deterministic batch covering samples [pos, pos+GBS)."""
+    rng = np.random.default_rng(1000 + pos)
+    toks = rng.integers(0, vocab, (2, 2, SEQ))
+    return {
+        "tokens": toks.astype(np.int32),
+        "labels": np.roll(toks, -1, -1).astype(np.int32),
+        "loss_mask": np.ones((2, 2, SEQ), np.float32),
+    }
+
+
+def _poisoned_provider(vocab, lo, hi):
+    """batch_provider whose samples in [lo, hi) are NaN-poisoned — the
+    poison follows the DATA position, exactly like a bad corpus shard, so
+    post-rollback replays (same iteration numbers, fresh data) are clean."""
+    def provider(consumed, gbs):
+        assert gbs == GBS
+        pos = consumed
+        while True:
+            batch = _sample_batch(pos, vocab)
+            if pos < hi and pos + gbs > lo:
+                batch = poison_nan(batch)
+            pos += gbs
+            yield batch
+    return provider
+
+
+def test_rollback_after_k_anomalies_skips_poisoned_window(tmp_path):
+    """save@3 (consumed 12) → iters 4-5 poisoned (samples 12..20) → after
+    K=2 consecutive anomalies the driver restores iteration 3 and resumes
+    on samples 20.. — the final run reaches train_iters with finite params
+    and a consumed_samples count that proves the poison window was passed,
+    not re-read."""
+    cfg = _cfg(tmp_path, anomaly_rollback_after=2)
+    provider = _poisoned_provider(cfg.model.vocab_size, 12, 20)
+    state = pretrain(cfg, batch_provider=provider)
+
+    assert int(state.iteration) == 8
+    assert metrics_lib.RESILIENCE_EVENTS.get("rollbacks") == 1
+    # 8 productive + 2 poisoned-then-rolled-back iterations of data
+    meta = ckpt.load_meta(cfg.train.save)
+    assert meta["consumed_samples"] == 10 * GBS
+    # the poisoned steps were never applied: everything stayed finite
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # the skip counter lives in TrainState, so the rollback restored it to
+    # the checkpoint's value — and no post-rollback step was anomalous
+    assert int(state.skipped) == 0
+
+
+def test_rollback_writes_anchor_checkpoint_when_none_exists(tmp_path):
+    """With rollback armed and an empty save dir, the driver saves an
+    iteration-0 anchor before training so there is always something to
+    roll back to."""
+    cfg = _cfg(tmp_path, train_iters=1, save_interval=100,
+               anomaly_rollback_after=2)
+    provider = _poisoned_provider(cfg.model.vocab_size, -1, -1)  # no poison
+    assert ckpt.latest_complete_iteration(cfg.train.save) is None
+    pretrain(cfg, batch_provider=provider)
+    assert ckpt.is_complete(cfg.train.save, 0)
+
+
+def test_rollback_restores_checkpoint_bitwise(tmp_path):
+    """Unit contract of rollback_to_last_checkpoint: the returned state is
+    the checkpointed one, bit for bit."""
+    cfg = _cfg(tmp_path)
+    root = cfg.train.save
+    saved = {"w": np.arange(16, dtype=np.float32),
+             "step": np.asarray(5, np.int32)}
+    ckpt.save_checkpoint(root, saved, iteration=5)
+    diverged = {"w": np.full(16, np.nan, np.float32),
+                "step": np.asarray(9, np.int32)}
+    restored, it = rollback_to_last_checkpoint(cfg, diverged)
+    assert it == 5
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(restored["w"])), saved["w"])
+    assert metrics_lib.RESILIENCE_EVENTS.get("rollbacks") == 1
+
+
+def test_rollback_gives_up_after_max_rollbacks(tmp_path):
+    cfg = _cfg(tmp_path, anomaly_max_rollbacks=2)
+    ckpt.save_checkpoint(cfg.train.save, {"w": np.zeros(4, np.float32)},
+                         iteration=1)
+    with pytest.raises(RuntimeError, match="giving up"):
+        rollback_to_last_checkpoint(cfg, {"w": np.ones(4, np.float32)},
+                                    attempt=3)
+
+
+def test_rollback_without_checkpoint_root_fails_loudly(tmp_path):
+    cfg = _cfg(tmp_path, save=None)
+    assert cfg.train.load is None
+    with pytest.raises(RuntimeError, match="checkpoint root"):
+        rollback_to_last_checkpoint(cfg, {"w": np.ones(4, np.float32)})
+
+
+def test_driver_resumes_past_torn_checkpoint(tmp_path):
+    """Driver-level torn-checkpoint recovery: the tracker points at a torn
+    iteration (crash aftermath); resume falls back to the newest complete
+    checkpoint and finishes training."""
+    cfg = _cfg(tmp_path, train_iters=2, save_interval=2)
+    provider = _poisoned_provider(cfg.model.vocab_size, -1, -1)
+    pretrain(cfg, batch_provider=provider)
+    assert ckpt.read_tracker(cfg.train.save) == 2
+
+    # fake the aftermath of a crash-after-commit-before-tracker bug plus a
+    # half-synced payload: a torn newer checkpoint the tracker points at
+    torn = tmp_path / "ckpt" / "iter_0000003" / "state"
+    torn.mkdir(parents=True)
+    ckpt.write_tracker(cfg.train.save, 3)
+
+    cfg2 = _cfg(tmp_path, train_iters=4, save_interval=100,
+                load=str(tmp_path / "ckpt"))
+    state = pretrain(cfg2, batch_provider=provider)
+    # resumed from 2 (the newest COMPLETE checkpoint), not 3, and the
+    # fallback was counted
+    assert metrics_lib.RESILIENCE_EVENTS.get("checkpoint_fallbacks") >= 1
+    assert int(state.iteration) == 4
+    assert ckpt.load_meta(cfg2.train.save)["consumed_samples"] == 4 * GBS

@@ -1,0 +1,100 @@
+"""Offline checkpoint verifier CLI: exit 0 on a healthy root, nonzero on
+anything that would break a resume."""
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu import checkpointing as ckpt
+from megatron_llm_tpu.config import (
+    OptimizerConfig,
+    RuntimeConfig,
+    TrainConfig,
+    tiny_config,
+)
+from megatron_llm_tpu.tools.verify_checkpoint import main
+
+pytestmark = pytest.mark.chaos
+
+
+def _cfg():
+    return RuntimeConfig(model=tiny_config(),
+                         optimizer=OptimizerConfig(),
+                         train=TrainConfig(seq_length=32)).validate()
+
+
+def _state(v=1.0):
+    return {"w": np.full(8, v, np.float32)}
+
+
+def _good_root(tmp_path, iteration=3):
+    root = str(tmp_path)
+    ckpt.save_checkpoint(root, _state(), _cfg(), iteration=iteration,
+                         meta={"consumed_samples": 12})
+    return root
+
+
+def test_ok_on_healthy_root(tmp_path, capsys):
+    root = _good_root(tmp_path)
+    assert main([root]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_fails_on_missing_root(tmp_path):
+    assert main([str(tmp_path / "nope")]) != 0
+
+
+def test_fails_on_empty_root(tmp_path):
+    assert main([str(tmp_path)]) != 0
+
+
+def test_fails_on_torn_payload(tmp_path):
+    root = _good_root(tmp_path)
+    # strip the orbax completeness markers: the save never finished
+    state_dir = tmp_path / "iter_0000003" / "state"
+    for m in ("_CHECKPOINT_METADATA", "_METADATA", "manifest.ocdbt"):
+        p = state_dir / m
+        if p.is_dir():
+            import shutil
+
+            shutil.rmtree(p)
+        elif p.exists():
+            p.unlink()
+    assert main([root]) != 0
+
+
+def test_fails_on_corrupt_tracker(tmp_path):
+    root = _good_root(tmp_path)
+    (tmp_path / ckpt.TRACKER_FILENAME).write_text("???")
+    assert main([root]) != 0
+
+
+def test_fails_on_corrupt_meta(tmp_path):
+    root = _good_root(tmp_path)
+    (tmp_path / "iter_0000003" / "meta.json").write_text("{truncated")
+    assert main([root]) != 0
+
+
+def test_fails_on_corrupt_config(tmp_path):
+    root = _good_root(tmp_path)
+    (tmp_path / "iter_0000003" / "config.json").write_text("not json")
+    assert main([root]) != 0
+
+
+def test_pinned_iteration(tmp_path):
+    root = _good_root(tmp_path, iteration=3)
+    assert main([root, "--iteration", "3"]) == 0
+    assert main([root, "--iteration", "7"]) != 0
+
+
+def test_stray_staging_warns_then_strict_fails(tmp_path):
+    root = _good_root(tmp_path)
+    (tmp_path / ("iter_0000009" + ckpt.STAGING_SUFFIX)).mkdir()
+    assert main([root]) == 0          # hygiene finding: warning only
+    assert main([root, "--strict"]) != 0
+
+
+def test_incomplete_non_target_warns_then_strict_fails(tmp_path):
+    root = _good_root(tmp_path)
+    (tmp_path / "iter_0000001" / "state").mkdir(parents=True)
+    assert main([root]) == 0
+    assert main([root, "--strict"]) != 0
