@@ -417,6 +417,28 @@ def _serving_point():
                              slots=8)
 
 
+def _serving_mixed_point():
+    """Mixed-workload serving (megatron_llm_tpu/serving/bench.py): varied
+    prompt lengths with the long prompts arriving mid-decode, chunked
+    prefill + pipelined decode on → aggregate tok/s, TTFT and ITL
+    p50/p99, and the device/host step breakdown (device_idle_frac ~0 is
+    the pipelining evidence).  This is the point where chunked prefill's
+    ITL effect is visible: without it every long admission freezes the
+    active streams for a whole-prompt prefill."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.serving.bench import run_mixed_serving_bench
+
+    max_prompt_len, gen_len = 256, 64
+    cfg = _bench_model(max_prompt_len + gen_len, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return run_mixed_serving_bench(cfg, params, num_requests=24,
+                                   gen_len=gen_len, slots=8,
+                                   max_prompt_len=max_prompt_len,
+                                   prefill_chunk=64)
+
+
 def _transient_error_types():
     """The error classes worth retrying: the axon-tunneled compile service
     occasionally throws a transient remote-compile XlaRuntimeError.
@@ -479,6 +501,8 @@ def _child_main(spec_json: str) -> None:
         out = _retry(_prefill_point, peak)
     elif kind == "serving":
         out = _retry(_serving_point)
+    elif kind == "serving_mixed":
+        out = _retry(_serving_mixed_point)
     else:  # pragma: no cover - parent and child ship together
         raise ValueError(f"unknown point kind {kind!r}")
     print(_CHILD_MARK + json.dumps(out), flush=True)
@@ -630,6 +654,9 @@ def main() -> None:
                                            "platform": platform})
     serving = _point("serving", {"kind": "serving", "platform": platform},
                      timeout_s=1200)
+    serving_mixed = _point("serving/mixed",
+                           {"kind": "serving_mixed", "platform": platform},
+                           timeout_s=1200)
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     record = {
@@ -664,6 +691,8 @@ def main() -> None:
         record.update(prefill_long)
     if serving is not None:
         record["serving"] = serving
+    if serving_mixed is not None:
+        record["serving_mixed"] = serving_mixed
     if headline is not None:
         record.update({
             "value": round(mfu, 4),

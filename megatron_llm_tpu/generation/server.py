@@ -59,7 +59,10 @@ class GenerationService:
                  engine=None, queue_size: int = 32,
                  engine_max_seq_len: int | None = None,
                  retry_after_s: float = 1.0,
-                 request_deadline_s: float | None = None):
+                 request_deadline_s: float | None = None,
+                 prefill_bucket: int = 1,
+                 prefill_chunk: int | None = None,
+                 pipeline_decode: bool = True):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -81,6 +84,12 @@ class GenerationService:
         # robustness): expired requests finish with reason "timeout"
         # instead of holding a KV slot or queue position forever
         self.request_deadline_s = request_deadline_s
+        # admission knobs (docs/serving.md): prefill_bucket bounds the
+        # number of compiled prefill shapes under ragged prompt lengths;
+        # prefill_chunk interleaves admission with decode chunk-at-a-time
+        self.prefill_bucket = prefill_bucket
+        self.prefill_chunk = prefill_chunk
+        self.pipeline_decode = pipeline_decode
         # the lock now guards only the legacy one-shot paths (beam search,
         # scoring, PLD); standard generation goes through the engine
         self.lock = threading.Lock()
@@ -102,8 +111,23 @@ class GenerationService:
                                  max_seq_len=self.engine_max_seq_len,
                                  max_queue_size=self.queue_size,
                                  retry_after_s=self.retry_after_s,
-                                 default_deadline_s=self.request_deadline_s))
+                                 default_deadline_s=self.request_deadline_s,
+                                 prefill_bucket=self.prefill_bucket,
+                                 prefill_chunk=self.prefill_chunk,
+                                 pipeline_decode=self.pipeline_decode))
             return self._engine
+
+    def metrics_snapshot(self) -> dict:
+        """Point-in-time serving metrics (GET /metrics).  An engine that
+        was never created reports an empty-engine snapshot rather than
+        instantiating the slot cache just to be scraped."""
+        with self._engine_init_lock:
+            engine = self._engine
+        if engine is None:
+            from ..serving import ServingMetrics
+
+            return ServingMetrics(self.max_batch_size).snapshot()
+        return engine.metrics.snapshot()
 
     def drain(self, timeout: float | None = 30.0) -> bool:
         """Stop accepting generation requests and wait for the in-flight
@@ -376,6 +400,14 @@ class _Handler(BaseHTTPRequestHandler):
         self._respond(status, payload)
 
     do_POST = do_PUT  # convenience; the reference accepts PUT only
+
+    def do_GET(self):
+        if self.path.rstrip("/") != "/metrics":
+            self._respond(404, "not found")
+            return
+        # counters, gauges (incl. the device/host step breakdown), and
+        # latency histograms — see serving/metrics.py:snapshot
+        self._respond(200, self.service.metrics_snapshot())
 
 
 class MegatronServer:

@@ -86,17 +86,27 @@ _GLU_BASE = {
 }
 
 
-def _decode_step_kernel(nk: int, nm: int, block_k: int, b: int, nq: int,
-                        nkv: int, g: int, d: int, eps: float, scale: float,
-                        act,
+def _decode_step_kernel(per_row: bool, nk: int, nm: int, block_k: int,
+                        b: int, nq: int, nkv: int, g: int, d: int,
+                        eps: float, scale: float, act,
                         lens_ref,
-                        x_ref, rot_ref, in_nw_ref, post_nw_ref,
-                        wq_ref, wk_ref, wv_ref, wo_ref,
-                        wg_ref, wu_ref, wd_ref,
-                        kc_ref, vc_ref,
-                        xo_ref, kr_ref, vr_ref,
-                        x_scr, q_scr, kn_scr, vn_scr, ctx_scr, xn2_scr,
-                        m_scr, l_scr, acc_scr):
+                        x_ref, rot_ref, *refs):
+    # per_row: each batch row carries its own fill level (continuous-
+    # batching serving, one slot per request).  ``lens_ref`` is then
+    # [1 + b]: lens[0] = max fill (drives the cache BlockSpec clamp, so
+    # HBM traffic is bounded by the deepest slot), lens[1 + i] = row i's
+    # fill (drives the per-row attention mask).  RoPE at per-row
+    # positions arrives as precomputed cos/sin row vectors plus the fixed
+    # pair-swap permutation in ``rot_ref`` (see fused_decode_step).
+    if per_row:
+        cos_ref, sin_ref, *refs = refs
+    (in_nw_ref, post_nw_ref,
+     wq_ref, wk_ref, wv_ref, wo_ref,
+     wg_ref, wu_ref, wd_ref,
+     kc_ref, vc_ref,
+     xo_ref, kr_ref, vr_ref,
+     x_scr, q_scr, kn_scr, vn_scr, ctx_scr, xn2_scr,
+     m_scr, l_scr, acc_scr) = refs
     li = pl.program_id(0)
     ki = pl.program_id(1)
     n_layers = pl.num_programs(0)
@@ -119,6 +129,17 @@ def _decode_step_kernel(nk: int, nm: int, block_k: int, b: int, nq: int,
         xnc = xn.astype(wq_ref.dtype)
         rot = rot_ref[...]                               # (d, d) f32
         dims = (((1,), (0,)), ((), ()))
+
+        def rope_head(y):  # (b_pad, d) f32 → rotated at each row's pos
+            z = jax.lax.dot_general(y, rot, dims, preferred_element_type=f32)
+            if per_row:
+                # rot is the fixed pair-swap permutation here: y·P swaps
+                # each (2i, 2i+1) lane pair, and the per-row cos/sin
+                # vectors finish the rotation — one MXU dot per head
+                # regardless of how many distinct positions the batch has
+                return y * cos_ref[...] + z * sin_ref[...]
+            return z
+
         q = jax.lax.dot_general(xnc, wq_ref[0], dims,
                                 preferred_element_type=f32)
         k = jax.lax.dot_general(xnc, wk_ref[0], dims,
@@ -126,16 +147,14 @@ def _decode_step_kernel(nk: int, nm: int, block_k: int, b: int, nq: int,
         v = jax.lax.dot_general(xnc, wv_ref[0], dims,
                                 preferred_element_type=f32)
         for j in range(nkv):
-            kj = jax.lax.dot_general(k[:, j * d:(j + 1) * d], rot, dims,
-                                     preferred_element_type=f32)
+            kj = rope_head(k[:, j * d:(j + 1) * d])
             vj = v[:, j * d:(j + 1) * d]
             kr_ref[0, :, j, :] = kj[:b].astype(kr_ref.dtype)
             vr_ref[0, :, j, :] = vj[:b].astype(vr_ref.dtype)
             kn_scr[:, j, :] = kj[:b]
             vn_scr[:, j, :] = vj[:b]
         for hq in range(nq):
-            qh = jax.lax.dot_general(q[:, hq * d:(hq + 1) * d], rot, dims,
-                                     preferred_element_type=f32)
+            qh = rope_head(q[:, hq * d:(hq + 1) * d])
             q_scr[hq % g, :, hq // g, :] = qh[:b]
         m_scr[...] = jnp.full(m_scr.shape, NEG_INF, f32)
         l_scr[...] = jnp.zeros(l_scr.shape, f32)
@@ -151,7 +170,13 @@ def _decode_step_kernel(nk: int, nm: int, block_k: int, b: int, nq: int,
         v4 = vc_ref[0].astype(f32)
         cols = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, 1, block_k), 2)
-        in_range = cols < pos                            # (1, 1, bk)
+        if per_row:
+            # each batch row masks at its OWN fill level; rows whose fill
+            # lies below the clamped max-fill blocks see only NEG_INF here
+            in_range = jnp.concatenate(
+                [cols < lens_ref[1 + i] for i in range(b)], axis=0)
+        else:
+            in_range = cols < pos                        # (1, 1, bk)
         for gg in range(g):
             qv = q_scr[gg]                               # (b, nkv, d) f32
             s = jnp.sum(qv[:, :, None, :] * k4, axis=-1) * scale
@@ -243,6 +268,21 @@ def rope_rotation_matrix(cos: jax.Array, sin: jax.Array,
     return r
 
 
+def _pair_swap_matrix(d: int) -> jax.Array:
+    """[d, d] permutation: ``x @ P`` swaps each (2i, 2i+1) lane pair.
+
+    The per-row RoPE path factors interleaved-pair rotation as
+    ``x * C + (x @ P) * S`` with per-row cos/sin vectors (C, S), so a
+    batch of rows at DIFFERENT positions still costs one MXU dot per
+    head — the single-position path bakes cos/sin into the matrix
+    instead (rope_rotation_matrix)."""
+    even = jnp.arange(0, d, 2)
+    p = jnp.zeros((d, d), jnp.float32)
+    p = p.at[even, even + 1].set(1.0)
+    p = p.at[even + 1, even].set(1.0)
+    return p
+
+
 def fused_decode_eligible(cfg, params, k_cache, s: int,
                           platform: str) -> bool:
     """Static predicate for the fused path (see module docstring scope).
@@ -327,7 +367,10 @@ def fused_decode_step(
     x: jax.Array,        # [b, h] — embedded hidden of the ONE new token
     k_cache: jax.Array,  # [L, b, kv_heads, max_len, d] (NOT yet updated)
     v_cache: jax.Array,
-    cache_len: jax.Array,  # scalar int32: valid cache rows (= new token pos)
+    cache_len: jax.Array,  # scalar int32: valid cache rows (= new token
+    #                        pos), or a [b] vector of PER-ROW fills (the
+    #                        serving engine's slot batch: each request sits
+    #                        at its own depth, free slots ride at fill 0)
     rope: tuple,           # (cos, sin) tables from rope_tables(cfg)
     *,
     block_k: int = 256,
@@ -337,8 +380,13 @@ def fused_decode_step(
 
     ``hidden`` is the stack output BEFORE the final norm; the caller
     applies final norm + unembedding and writes the returned K/V rows
-    into its cache at ``cache_len`` (ops/kv_quant.py:cache_update) —
-    the same contract as stack_forward_cached with s=1.
+    into its cache at ``cache_len`` (ops/kv_quant.py:cache_update, which
+    accepts the same scalar-or-vector ``cache_len``) — the same contract
+    as stack_forward_cached with s=1.
+
+    With a vector ``cache_len``, cache blocks are fetched up to the MAX
+    fill only (one clamp for the whole batch: a ragged batch costs the
+    deepest row's bytes) and each row masks attention at its own fill.
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
@@ -361,15 +409,33 @@ def fused_decode_step(
 
     b_pad = max(8, -(-b // 8) * 8)
     x_p = x if b_pad == b else jnp.pad(x, ((0, b_pad - b), (0, 0)))
-    rot = rope_rotation_matrix(rope[0], rope[1], cache_len, d)
-    lens = jnp.reshape(cache_len, (1,)).astype(jnp.int32)
+    cache_len = jnp.asarray(cache_len, jnp.int32)
+    per_row = cache_len.ndim == 1
+    if per_row:
+        fills = cache_len
+        lens = jnp.concatenate([jnp.max(fills)[None], fills])
+        # interleaved-pair RoPE at each row's own position, factored as
+        # x·C + (x·P)·S so the kernel needs no per-row matrices
+        c_half = rope[0][fills, :d // 2].astype(jnp.float32)  # (b, d/2)
+        s_half = rope[1][fills, :d // 2].astype(jnp.float32)
+        sign = jnp.where(jnp.arange(d) % 2 == 0, -1.0, 1.0)
+        c_rows = jnp.repeat(c_half, 2, axis=-1)
+        s_rows = jnp.repeat(s_half, 2, axis=-1) * sign[None, :]
+        if b_pad != b:
+            c_rows = jnp.pad(c_rows, ((0, b_pad - b), (0, 0)))
+            s_rows = jnp.pad(s_rows, ((0, b_pad - b), (0, 0)))
+        rot = _pair_swap_matrix(d)
+    else:
+        rot = rope_rotation_matrix(rope[0], rope[1], cache_len, d)
+        lens = jnp.reshape(cache_len, (1,))
 
     attn_p, mlp_p = stacked["attn"], stacked["mlp"]
     # norm scales ride as [L, 1, h]: a (1, 1, h) block keeps the last two
     # dims legal under the TPU (8, 128) tiling rule (a (1, h) block of an
     # [L, h] array has a size-1 sublane dim and is rejected by Mosaic)
+    rope_rows = (c_rows, s_rows) if per_row else ()
     operands = (
-        x_p, rot,
+        x_p, rot, *rope_rows,
         stacked["input_norm"]["scale"][:, None, :],
         stacked["post_attn_norm"]["scale"][:, None, :],
         attn_p["wq"], attn_p["wk"], attn_p["wv"], attn_p["wo"],
@@ -405,6 +471,7 @@ def fused_decode_step(
 
     in_specs = [
         fixed((b_pad, h)), fixed((d, d)),
+        *([fixed((b_pad, d))] * 2 if per_row else []),
         per_layer((1, h)), per_layer((1, h)),
         per_layer((h, nq * d)), per_layer((h, nkv * d)),
         per_layer((h, nkv * d)), per_layer((nq * d, h)),
@@ -432,9 +499,12 @@ def fused_decode_step(
         pltpu.VMEM((g, b, nkv, d), jnp.float32),       # online-softmax acc
     ]
 
+    # jax < 0.5 exposes the TPU compiler params under the old name
+    compiler_params_cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
     hidden, k_rows, v_rows = pl.pallas_call(
-        functools.partial(_decode_step_kernel, nk, nm, block_k, b, nq,
-                          nkv, g, d, eps, scale, act),
+        functools.partial(_decode_step_kernel, per_row, nk, nm, block_k,
+                          b, nq, nkv, g, d, eps, scale, act),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(L, nk + nm),
@@ -443,7 +513,7 @@ def fused_decode_step(
             scratch_shapes=scratch,
         ),
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params_cls(
             dimension_semantics=("arbitrary", "arbitrary"),
             # the whole-layer weight blocks are double-buffered by the
             # pipeline (~2x ~26 MB at the bench geometry), far past the

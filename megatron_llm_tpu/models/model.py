@@ -231,12 +231,14 @@ def forward_cached(
 
     from ..kernels.decode_step import fused_decode_eligible
 
-    if (cache_len.ndim == 0
-            and fused_decode_eligible(cfg, params, k_cache, s,
-                                      jax.default_backend())):
+    if fused_decode_eligible(cfg, params, k_cache, s,
+                             jax.default_backend()):
         # single-token fast path: the whole stack in one Pallas kernel
         # (kernels/decode_step.py) — the caller-visible contract (returned
         # logits + updated caches) is identical to the composed path.
+        # ``cache_len`` may be a [b] per-sample fill vector (the serving
+        # engine's slot batch): the kernel masks each row at its own fill
+        # and cache_update lands each row's K/V at its own position.
         from ..kernels.decode_step import fused_decode_step
         from ..ops.kv_quant import cache_update
 

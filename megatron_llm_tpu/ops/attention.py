@@ -54,9 +54,11 @@ def _active_mesh():
     also set when tracing shard_map bodies) first, then this package's own
     ``parallel.mesh.use_mesh`` stack (the training driver / generation
     entry points use the latter)."""
-    ctx = jax.sharding.get_abstract_mesh()
-    if ctx is not None and not ctx.empty:
-        return ctx
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract is not None:  # jax >= 0.5; older jax has no ambient
+        ctx = get_abstract()      # abstract-mesh context to consult
+        if ctx is not None and not ctx.empty:
+            return ctx
     from ..parallel import mesh as mesh_lib
 
     return mesh_lib.current_mesh()

@@ -80,6 +80,111 @@ def run_serving_bench(cfg, params, *, num_requests: int = 24,
     }
 
 
+def run_mixed_serving_bench(cfg, params, *, num_requests: int = 24,
+                            gen_len: int = 64, slots: int = 8,
+                            max_prompt_len: int = 256,
+                            prefill_chunk: int | None = 64,
+                            pipeline_decode: bool = True,
+                            stagger_s: float = 0.0,
+                            seed: int = 0) -> dict:
+    """Mixed-workload serving point: varied prompt lengths (short tail +
+    some near-max prompts), with the long prompts deliberately arriving
+    MID-DECODE so admission prefill competes with active streams — the
+    scenario chunked prefill exists for.  Reports aggregate tok/s plus
+    TTFT and host-observed inter-token latency (ITL) p50/p99.
+    """
+    import threading
+
+    import numpy as np
+
+    from .engine import EngineConfig, ServingEngine
+    from .metrics import LatencyHistogram, ServingMetrics
+
+    rng = np.random.default_rng(seed)
+    # short-prompt majority, long-prompt minority (arrive mid-decode)
+    short_lens = rng.integers(8, max(9, max_prompt_len // 4),
+                              num_requests - num_requests // 4)
+    long_lens = rng.integers(max(8, (3 * max_prompt_len) // 4),
+                             max_prompt_len + 1, num_requests // 4)
+    prompts = [rng.integers(1, cfg.vocab_size, int(n)).tolist()
+               for n in np.concatenate([short_lens, long_lens])]
+    n_short = len(short_lens)
+
+    engine = ServingEngine(cfg, params, EngineConfig(
+        max_batch_size=slots,
+        max_seq_len=min(max_prompt_len + gen_len,
+                        cfg.max_position_embeddings),
+        max_queue_size=max(num_requests, slots),
+        prefill_bucket=64,  # bounded prefill shapes under ragged lengths
+        prefill_chunk=prefill_chunk,
+        pipeline_decode=pipeline_decode,
+    )).start()
+    itl = LatencyHistogram(max_samples=1 << 16)
+    itl_lock = threading.Lock()
+
+    def make_stream():
+        last = [None]
+
+        def on_token(_tok, _last=last):
+            now = time.perf_counter()
+            if _last[0] is not None:
+                with itl_lock:
+                    itl.observe(now - _last[0])
+            _last[0] = now
+        return on_token
+
+    try:
+        # warmup: compile prefill/chunk + decode outside the window
+        engine.submit(prompts[0][:8], max_new_tokens=2,
+                      use_eos_stop=False).result(timeout=600)
+        engine.submit(prompts[n_short][:max_prompt_len], max_new_tokens=2,
+                      use_eos_stop=False).result(timeout=600)
+        engine.metrics = ServingMetrics(slots)
+
+        t0 = time.perf_counter()
+        handles = []
+        for p in prompts[:n_short]:  # short prompts first: decode starts
+            handles.append(engine.submit(p, max_new_tokens=gen_len,
+                                         use_eos_stop=False,
+                                         on_token=make_stream()))
+            if stagger_s:
+                time.sleep(stagger_s)
+        time.sleep(0.01)  # ensure decode is underway, THEN the long tail
+        for p in prompts[n_short:]:
+            handles.append(engine.submit(p, max_new_tokens=gen_len,
+                                         use_eos_stop=False,
+                                         on_token=make_stream()))
+        results = [h.result(timeout=600) for h in handles]
+        dt = time.perf_counter() - t0
+    finally:
+        engine.shutdown()
+
+    n_tokens = sum(len(r.tokens) - r.prompt_len for r in results)
+    snap = engine.metrics.snapshot()
+    return {
+        "serving_mixed_requests_per_sec": round(num_requests / dt, 3),
+        "serving_mixed_tokens_per_sec": round(n_tokens / dt, 1),
+        "serving_mixed_ttft_ms_p50": round(snap["ttft"]["p50_s"] * 1e3, 2),
+        "serving_mixed_ttft_ms_p99": round(snap["ttft"]["p99_s"] * 1e3, 2),
+        "serving_mixed_itl_ms_p50": round(itl.percentile(50) * 1e3, 3),
+        "serving_mixed_itl_ms_p99": round(itl.percentile(99) * 1e3, 3),
+        "serving_mixed_device_step_ms_mean": round(
+            snap["device_step_time"]["mean_s"] * 1e3, 3),
+        "serving_mixed_sched_host_ms_mean": round(
+            snap["sched_host_time"]["mean_s"] * 1e3, 3),
+        "serving_mixed_device_idle_frac": round(
+            snap["device_idle_frac"], 4),
+        "serving_mixed_prefill_chunks": snap["prefill_chunks"],
+        "serving_mixed_max_decode_batch": snap["max_decode_batch"],
+        "serving_mixed_num_requests": num_requests,
+        "serving_mixed_slots": slots,
+        "serving_mixed_max_prompt_len": max_prompt_len,
+        "serving_mixed_gen_len": gen_len,
+        "serving_mixed_prefill_chunk": prefill_chunk or 0,
+        "serving_mixed_pipeline_decode": int(pipeline_decode),
+    }
+
+
 def main() -> None:
     """Smoke run on the tiny test config (CPU-safe)."""
     import json
@@ -93,6 +198,10 @@ def main() -> None:
     params = model_lib.init_params(jax.random.key(0), cfg)
     out = run_serving_bench(cfg, params, num_requests=8, prompt_len=8,
                             gen_len=16, slots=4)
+    out.update(run_mixed_serving_bench(cfg, params, num_requests=8,
+                                       gen_len=12, slots=4,
+                                       max_prompt_len=64,
+                                       prefill_chunk=16))
     print(json.dumps(out))
 
 

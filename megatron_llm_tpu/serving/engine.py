@@ -28,15 +28,33 @@ Free slots still ride through the decode step (fixed shapes keep ONE
 compiled executable); their writes land at row fill=0 of a free slot and
 are fully overwritten by the next admission's whole-slot insert.
 
-The scheduler fetches each step's sampled tokens to the host — that sync
-is what makes iteration-level scheduling possible (join/leave decisions
-every token), and its ~1 ms dispatch latency on TPU is amortized across
-every active slot, which is exactly the aggregation the old lock threw
-away.  Per-request streaming callbacks fire from the scheduler thread.
+The steady-state decode loop is **pipelined** (``EngineConfig.
+pipeline_decode``, default on): step N's sampled tokens stay on the
+device and feed step N+1's ``pending`` input directly — the host fetch
+of step N's tokens (an async copy started at dispatch) overlaps step
+N+1's execution, so the device never sits idle waiting for Python
+bookkeeping.  The price is that retirement decisions lag one step: by
+the time the host sees that a request hit EOS or its budget at step N,
+step N+1 has already sampled one *speculative* token for that slot.
+That token is masked — never committed to ``FinishedRequest.tokens``,
+never streamed — so committed trajectories stay bitwise identical to
+the one-shot path (the decode step is a pure function of per-slot
+fill/counter/pending state the host tracks without syncing).  Join/
+leave decisions still happen every iteration; they just act on the
+previous step's tokens.
+
+Admission can run **chunked** (``EngineConfig.prefill_chunk``): a long
+prompt prefills at most ``prefill_chunk`` tokens per scheduler
+iteration, interleaved between decode steps, so admission no longer
+freezes every active stream's inter-token latency for the whole prompt
+(the Sarathi-Serve argument).  On eligible TPU configs the batched
+decode step itself runs as the fused whole-stack Pallas kernel
+(kernels/decode_step.py) with a per-slot fill vector — see
+models/model.py:forward_cached, which routes it automatically.
 
 Greedy requests reproduce the one-shot ``generation.generate_tokens``
 trajectory token-for-token (tested bitwise on CPU fp32, the same
-equivalence bar the PLD path meets).
+equivalence bar the PLD path meets), pipelined or not.
 """
 
 from __future__ import annotations
@@ -71,7 +89,22 @@ class EngineConfig:
     #                               buckets bound the number of compiled
     #                               prefill shapes; 1 = exact lengths
     retry_after_s: float = 1.0    # backpressure hint surfaced on QueueFull
-    idle_wait_s: float = 0.02     # scheduler sleep when idle / paused
+    idle_wait_s: float = 0.02     # max scheduler wait when idle / paused
+    #                               (wakeups are condition-variable driven;
+    #                               this only bounds the cancel/deadline
+    #                               sweep latency while nothing else stirs)
+    pipeline_decode: bool = True  # one-step decode pipeline: feed step N's
+    #                               device-resident tokens straight into
+    #                               step N+1 and overlap the host fetch with
+    #                               device execution; retirement lags one
+    #                               step with the speculative token masked.
+    #                               False = classic dispatch->sync->commit.
+    prefill_chunk: Optional[int] = None  # run admission prefill at most
+    #                               this many prompt tokens per scheduler
+    #                               iteration, interleaved between decode
+    #                               steps (Sarathi-style); supersedes
+    #                               prefill_bucket when set.  None = whole-
+    #                               prompt prefill in one forward.
     default_deadline_s: Optional[float] = None  # per-request wall-clock
     #                               budget (submit -> finish) applied when a
     #                               request doesn't set its own; None = no
@@ -267,18 +300,102 @@ _decode_plain = functools.partial(
     jax.jit, static_argnames=("cfg",))(_decode_impl)
 
 
+@jax.jit
+def _merge_pending(tok, mask, vals):
+    """Override the device-resident pending-token vector (last step's
+    sampled tokens, still on device in pipelined mode) with host-known
+    values for freshly (re)admitted slots."""
+    return jnp.where(mask, vals, tok)
+
+
+def _prefill_chunk_impl(cfg: ModelConfig, params, tokens, off, logit_row,
+                        k_small, v_small, *, max_seq_len: int, first: bool,
+                        last: bool):
+    """One bounded chunk of a chunked prefill (batch 1, fixed chunk width).
+
+    ``off`` is the chunk's start position; the batch-1 cache is created on
+    the first chunk and threaded through subsequent calls.  Only the chunk
+    containing the prompt's final real token (``last``) needs its logits
+    (at ``logit_row``, an in-chunk row index); earlier chunks compute one
+    ignored logit row so each (first, last) arm stays a single compiled
+    shape regardless of prompt length."""
+    rope = model_lib.rope_tables(cfg)
+    if first:
+        k_small, v_small = model_lib.init_kv_cache(cfg, 1, max_seq_len)
+    logits, k_small, v_small = model_lib.forward_cached(
+        cfg, params, tokens, k_small, v_small, off, rope=rope,
+        empty_cache=first,
+        **(dict(logit_rows=logit_row) if last
+           else dict(last_logit_only=True)))
+    return logits[:, 0], k_small, v_small
+
+
+_prefill_chunk_donated = functools.partial(
+    jax.jit, static_argnames=("cfg", "max_seq_len", "first", "last"),
+    donate_argnums=(5, 6))(_prefill_chunk_impl)
+_prefill_chunk_plain = functools.partial(
+    jax.jit, static_argnames=("cfg", "max_seq_len", "first", "last"))(
+        _prefill_chunk_impl)
+
+
 # ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
 
 
 class _SlotState:
-    """Host-side per-slot bookkeeping (device state lives in SlotAllocator)."""
+    """Host-side per-slot bookkeeping (device state lives in SlotAllocator).
+
+    ``fill`` and ``count`` advance at DISPATCH time, not commit time: the
+    decode step is a pure function of (pending, fill, counter), so the
+    host can keep dispatching pipelined steps without waiting to see the
+    sampled tokens.  ``pending`` is the host's copy of the slot's last
+    sampled token; in pipelined steady state the authoritative value rides
+    on device in ``_Inflight.tok`` and ``fresh`` marks the slots (new
+    admissions, post-pause survivors) whose host value must override it.
+    """
 
     def __init__(self, req: _Request, fill: int, pending: int):
         self.req = req
-        self.fill = fill          # cache rows committed for this slot
-        self.pending = pending    # sampled token not yet fed to the model
+        self.fill = fill          # cache rows written once every dispatched
+        #                           step lands (prompt + dispatched decodes)
+        self.count = 1            # tokens sampled so far incl. in-flight =
+        #                           RNG fold counter of the NEXT sample
+        self.pending = pending    # host-known last sampled token
+        self.fresh = True         # pending must override the device vector
+
+
+class _Inflight:
+    """A dispatched-but-unprocessed decode step (pipelined mode).
+
+    ``slots`` snapshots slot -> _SlotState at dispatch; a state object is
+    unique per admission, so an identity check at processing time masks
+    every speculative token sampled for a slot that retired (EOS, budget,
+    cancel, deadline) while the step was in flight."""
+
+    __slots__ = ("tok", "tok_lp", "slots", "t_dispatch")
+
+    def __init__(self, tok, tok_lp, slots, t_dispatch):
+        self.tok = tok            # [S] device array of sampled tokens
+        self.tok_lp = tok_lp      # [S] device array of their logprobs
+        self.slots = slots
+        self.t_dispatch = t_dispatch
+
+
+class _PrefillState:
+    """A chunked prefill in progress: the request holds a KV slot but is
+    not yet decoding; its batch-1 cache grows one chunk per scheduler
+    iteration."""
+
+    def __init__(self, req: _Request, slot: int, padded: int):
+        self.req = req
+        self.slot = slot
+        self.padded = padded      # total prompt rows to prefill (chunk-
+        #                           padded; the tail rows hold pad-token
+        #                           K/V masked by the slot's fill level)
+        self.done = 0             # prompt rows prefilled so far
+        self.k_small = None       # batch-1 cache, created on chunk 0
+        self.v_small = None
 
 
 class ServingEngine:
@@ -306,14 +423,24 @@ class ServingEngine:
         self._active: dict[int, _SlotState] = {}    # slot -> state
         self._decode = (_decode_plain if jax.default_backend() == "cpu"
                         else _decode_donated)
+        self._prefill_chunk_fn = (
+            _prefill_chunk_plain if jax.default_backend() == "cpu"
+            else _prefill_chunk_donated)
         self._thread: Optional[threading.Thread] = None
         self._admitting: Optional[_Request] = None  # popped, not yet slotted
+        self._prefilling: Optional[_PrefillState] = None  # chunked prefill
+        self._inflight: Optional[_Inflight] = None  # dispatched decode step
         self._scheduler_error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._paused = threading.Event()
         self._draining = threading.Event()
         self._started = threading.Event()
         self._lock = threading.Lock()  # guards start/shutdown
+        self._wake = threading.Condition()        # paused-loop wakeups
+        self._drain_cond = threading.Condition()  # drain() wakeups
+        # device/host overlap accounting (metrics.observe_step_breakdown)
+        self._last_dispatch_t: Optional[float] = None
+        self._last_ready_t: Optional[float] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -335,8 +462,12 @@ class ServingEngine:
                 return
             self._stop.set()
             self.queue.notify()
+            with self._wake:
+                self._wake.notify_all()
             self._thread.join(timeout)
             self._thread = None
+            with self._drain_cond:
+                self._drain_cond.notify_all()
 
     def pause(self) -> None:
         """Stop admitting and decoding (requests keep queueing) — used for
@@ -345,6 +476,8 @@ class ServingEngine:
 
     def resume(self) -> None:
         self._paused.clear()
+        with self._wake:           # wake the paused scheduler immediately
+            self._wake.notify_all()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful drain: stop admitting new requests (submissions are
@@ -359,14 +492,27 @@ class ServingEngine:
             return True
         deadline = (None if timeout is None
                     else time.perf_counter() + float(timeout))
-        while True:
-            idle = (not self._active and self._admitting is None
-                    and len(self.queue) == 0)
-            if idle or self._stop.is_set():
-                return idle
-            if deadline is not None and time.perf_counter() >= deadline:
-                return False
-            time.sleep(self.config.idle_wait_s)
+        with self._drain_cond:
+            while True:
+                idle = self._is_idle()
+                if idle or self._stop.is_set():
+                    return idle
+                remaining = (None if deadline is None
+                             else deadline - time.perf_counter())
+                if remaining is not None and remaining <= 0:
+                    return False
+                # woken by _finish / the scheduler going idle / shutdown,
+                # not polled
+                self._drain_cond.wait(remaining)
+
+    def _is_idle(self) -> bool:
+        return (not self._active and self._admitting is None
+                and self._prefilling is None and self._inflight is None
+                and len(self.queue) == 0)
+
+    def _notify_drain(self) -> None:
+        with self._drain_cond:
+            self._drain_cond.notify_all()
 
     # -- submission (any thread) ------------------------------------------
 
@@ -438,13 +584,26 @@ class ServingEngine:
                 self._drain_cancellations()
                 self._expire_deadlines()
                 if self._paused.is_set():
-                    time.sleep(self.config.idle_wait_s)
+                    self._flush_inflight()
+                    self._last_dispatch_t = self._last_ready_t = None
+                    with self._wake:  # resume()/shutdown wake this; the
+                        # timeout only bounds the cancel/deadline sweep
+                        if self._paused.is_set() and not self._stop.is_set():
+                            self._wake.wait(self.config.idle_wait_s)
                     continue
                 self._admit()
-                if not self._active:
+                if self._active:
+                    self._step()
+                elif self._inflight is not None:
+                    # every slot retired while the step was in flight: its
+                    # tokens are all speculative — discard without syncing
+                    self._flush_inflight()
+                elif self._prefilling is None:
+                    # idle: queue.notify (submit / drain / shutdown) wakes
+                    # this immediately; no sleep-polling
+                    self._last_dispatch_t = self._last_ready_t = None
+                    self._notify_drain()
                     self.queue.wait_for_work(self.config.idle_wait_s)
-                    continue
-                self._decode_iteration()
         except Exception as e:  # noqa: BLE001 — a dead scheduler must not
             # leave submitters blocked on result() forever: fail every
             # in-flight and queued request loudly, then stop.
@@ -453,9 +612,13 @@ class ServingEngine:
             logging.getLogger(__name__).exception(
                 "serving engine scheduler died: %s", e)
             self._scheduler_error = e
+            self._inflight = None
             if self._admitting is not None:  # popped but not yet slotted
                 self._finish(self._admitting, "error")
                 self._admitting = None
+            if self._prefilling is not None:  # mid chunked prefill
+                self._finish(self._prefilling.req, "error")
+                self._prefilling = None
             for slot in list(self._active):
                 st = self._active.pop(slot)
                 self._finish(st.req, "error")
@@ -465,11 +628,21 @@ class ServingEngine:
                     break
                 self._finish(req, "error")
             self._stop.set()
+            self._notify_drain()
 
     def _drain_cancellations(self) -> None:
         for slot in [s for s, st in self._active.items()
                      if st.req.cancel_flag.is_set()]:
             self._retire(slot, "cancelled")
+        if (self._prefilling is not None
+                and self._prefilling.req.cancel_flag.is_set()):
+            self._abort_prefill("cancelled")
+
+    def _abort_prefill(self, reason: str) -> None:
+        ps, self._prefilling = self._prefilling, None
+        self.slots.release(ps.slot)
+        self._finish(ps.req, reason)
+        self.metrics.set_gauges(slots_active=self.slots.active_slots)
 
     def _expire_deadlines(self) -> None:
         """Retire every request past its wall-clock deadline — active slots
@@ -483,12 +656,17 @@ class ServingEngine:
         for slot in [s for s, st in self._active.items()
                      if expired(st.req)]:
             self._retire(slot, "timeout")
+        if self._prefilling is not None and expired(self._prefilling.req):
+            self._abort_prefill("timeout")
         for req in self.queue.remove_if(expired):
             self._finish(req, "timeout")
         self.metrics.set_gauges(queue_depth=len(self.queue))
 
     def _admit(self) -> None:
         assert self.slots is not None
+        if self.config.prefill_chunk:
+            self._admit_chunked()
+            return
         while self.slots.free_slots:
             req = self.queue.pop()
             if req is None:
@@ -504,6 +682,84 @@ class ServingEngine:
             self._admitting = None
         self.metrics.set_gauges(slots_active=self.slots.active_slots,
                                 queue_depth=len(self.queue))
+
+    def _admit_chunked(self) -> None:
+        """Chunked admission: at most ONE prefill chunk per scheduler
+        iteration, so active streams get a decode step between chunks
+        instead of stalling for a whole long prompt."""
+        if self._prefilling is None and self.slots.free_slots:
+            req = self.queue.pop()
+            while req is not None and req.cancel_flag.is_set():
+                self._finish(req, "cancelled")
+                req = self.queue.pop()
+            self.metrics.set_gauges(queue_depth=len(self.queue))
+            if req is not None:
+                if req.return_logprobs:
+                    # prompt logprobs need every prompt logit in one pass;
+                    # rare admin path — take the whole-prompt prefill
+                    self._admitting = req
+                    self._prefill_into_slot(req)
+                    self._admitting = None
+                else:
+                    chunk = max(1, int(self.config.prefill_chunk))
+                    plen = len(req.prompt)
+                    padded = min(-(-plen // chunk) * chunk,
+                                 self.config.max_seq_len)
+                    slot = self.slots.alloc()
+                    assert slot is not None
+                    self._prefilling = _PrefillState(req, slot, padded)
+        if self._prefilling is not None:
+            self._advance_prefill()
+        self.metrics.set_gauges(slots_active=self.slots.active_slots,
+                                queue_depth=len(self.queue))
+
+    def _advance_prefill(self) -> None:
+        ps = self._prefilling
+        req = ps.req
+        chunk = max(1, int(self.config.prefill_chunk))
+        t = self.metrics.timers("serving-prefill", 2)
+        t.start()
+        off = ps.done
+        c = min(chunk, ps.padded - off)
+        tokens = np.zeros((1, c), np.int32)
+        seg = req.prompt[off:off + c]  # shorter than c at the padded tail
+        tokens[0, :len(seg)] = seg
+        last = off + c >= ps.padded
+        # chunk 0 creates the cache inside the jit; later chunks thread
+        # (and on TPU donate) it
+        fn = (_prefill_chunk_plain if ps.k_small is None
+              else self._prefill_chunk_fn)
+        logits, ps.k_small, ps.v_small = fn(
+            self.cfg, self.params, jnp.asarray(tokens), jnp.int32(off),
+            jnp.asarray([len(req.prompt) - 1 - off], jnp.int32),
+            ps.k_small, ps.v_small,
+            max_seq_len=self.config.max_seq_len,
+            first=(off == 0), last=last)
+        ps.done = off + c
+        self.metrics.inc("prefill_chunks")
+        if not last:
+            t.stop()
+            return
+        # final chunk: its logit_row is the prompt's last real token (the
+        # chunk-padded tail rows, like bucket padding, hold pad-token K/V
+        # masked by the slot's fill level)
+        self._prefilling = None
+        self.slots.insert(ps.slot, ps.k_small, ps.v_small)
+        tok, tok_lp = _first_token_impl(
+            self.cfg, logits,
+            jnp.asarray([req.seed], jnp.uint32),
+            jnp.asarray([0], jnp.int32),
+            jnp.asarray([req.greedy]),
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32))
+        first_tok = int(np.asarray(tok)[0])
+        t.stop()
+        self.metrics.inc("admitted")
+        self.metrics.inc("prefills")
+        self._active[ps.slot] = _SlotState(req, fill=len(req.prompt),
+                                           pending=first_tok)
+        self._commit_token(ps.slot, first_tok, float(np.asarray(tok_lp)[0]))
 
     def _prefill_into_slot(self, req: _Request) -> None:
         slot = self.slots.alloc()
@@ -543,10 +799,37 @@ class ServingEngine:
         self._active[slot] = _SlotState(req, fill=plen, pending=first)
         self._commit_token(slot, first, float(np.asarray(tok_lp)[0]))
 
-    def _decode_iteration(self) -> None:
+    def _step(self) -> None:
+        """One scheduler iteration of the decode fast path: dispatch step
+        N+1, then process step N's tokens (which the device computed — and
+        whose host copy streamed — while we were doing this bookkeeping).
+
+        Non-pipelined mode runs the same code with the processing moved
+        after the dispatch of the SAME step, i.e. the classic
+        dispatch -> sync -> commit loop."""
+        it0 = time.perf_counter()
+        t = self.metrics.timers("serving-decode", 2)
+        t.start()
+        inflight = self._dispatch_decode()
+        prev, self._inflight = self._inflight, inflight
+        wait_s = 0.0
+        if prev is not None:
+            wait_s += self._process_step_results(prev)
+        if not self.config.pipeline_decode:
+            cur, self._inflight = self._inflight, None
+            wait_s += self._process_step_results(cur)
+        t.stop()
+        # scheduler/Python overhead this iteration = wall time minus the
+        # portion actually blocked on the device
+        host_s = max(0.0, (time.perf_counter() - it0) - wait_s)
+        self.metrics.observe_step_breakdown(host_s=host_s)
+        self.metrics.set_gauges(slots_active=self.slots.active_slots)
+
+    def _dispatch_decode(self) -> _Inflight:
         assert self.slots is not None
         S = self.config.max_batch_size
-        pending = np.zeros((S,), np.int32)
+        overrides = np.zeros((S,), np.int32)
+        override_mask = np.zeros((S,), bool)
         fills = np.zeros((S,), np.int32)
         seeds = np.zeros((S,), np.uint32)
         counters = np.zeros((S,), np.int32)
@@ -555,37 +838,93 @@ class ServingEngine:
         top_ks = np.zeros((S,), np.int32)
         top_ps = np.zeros((S,), np.float32)
         for slot, st in self._active.items():
-            pending[slot] = st.pending
             fills[slot] = st.fill
             seeds[slot] = st.req.seed
-            counters[slot] = len(st.req.generated)
+            counters[slot] = st.count
             greedy[slot] = st.req.greedy
             temps[slot] = st.req.temperature
             top_ks[slot] = st.req.top_k
             top_ps[slot] = st.req.top_p
+            overrides[slot] = st.pending
+            if st.fresh:
+                override_mask[slot] = True
+                st.fresh = False
+        if self._inflight is None:
+            # no device-resident tokens: every active slot's pending value
+            # is host-known (fresh admission, post-pause/post-sync commit)
+            pending = jnp.asarray(overrides)
+        elif override_mask.any():
+            pending = _merge_pending(self._inflight.tok,
+                                     jnp.asarray(override_mask),
+                                     jnp.asarray(overrides))
+        else:
+            pending = self._inflight.tok  # pure device->device handoff
 
-        t = self.metrics.timers("serving-decode", 2)
-        t.start()
         t0 = time.perf_counter()
+        if self._last_dispatch_t is not None:
+            wall = t0 - self._last_dispatch_t
+            if wall > 0:
+                # time the device sat idle between steps: zero when a step
+                # was still in flight, else the gap since its results
+                # arrived (= host bookkeeping on the critical path)
+                gap = (0.0 if self._inflight is not None
+                       or self._last_ready_t is None
+                       else min(wall, t0 - self._last_ready_t))
+                self.metrics.observe_step_breakdown(gap_frac=gap / wall)
+        self._last_dispatch_t = t0
+
         tok, tok_lp, k_cache, v_cache = self._decode(
             self.cfg, self.params, self.slots.k_cache, self.slots.v_cache,
-            jnp.asarray(pending), jnp.asarray(fills), jnp.asarray(seeds),
+            pending, jnp.asarray(fills), jnp.asarray(seeds),
             jnp.asarray(counters), jnp.asarray(greedy), jnp.asarray(temps),
             jnp.asarray(top_ks), jnp.asarray(top_ps))
         self.slots.set_caches(k_cache, v_cache)
-        tok = np.asarray(tok)          # host sync: the scheduling point
-        tok_lp = np.asarray(tok_lp)
-        dt = time.perf_counter() - t0
-        t.stop()
+        try:  # start the host copy now so it overlaps the next dispatch
+            tok.copy_to_host_async()
+            tok_lp.copy_to_host_async()
+        except AttributeError:  # backend without async transfers
+            pass
+        snapshot = dict(self._active)
+        for st in snapshot.values():
+            st.fill += 1   # the fed token's K/V row lands this step
+            st.count += 1  # one more token sampled (possibly speculative)
+        return _Inflight(tok, tok_lp, snapshot, t0)
 
-        n_active = len(self._active)
-        self.metrics.observe_decode_iteration(n_active, dt)
-        for slot in list(self._active):
-            st = self._active[slot]
-            st.fill += 1              # pending token's K/V row committed
+    def _process_step_results(self, step: _Inflight) -> float:
+        """Sync a dispatched step's tokens to the host and commit them.
+        Returns the wall time spent blocked on the device."""
+        t_fetch = time.perf_counter()
+        tok = np.asarray(step.tok)     # host sync: the scheduling point
+        tok_lp = np.asarray(step.tok_lp)
+        t_ready = time.perf_counter()
+        self._last_ready_t = t_ready
+        device_s = t_ready - step.t_dispatch
+        committed = 0
+        for slot, st in step.slots.items():
+            if self._active.get(slot) is not st:
+                # the slot retired (EOS/budget/cancel/deadline) or was
+                # re-admitted after this step dispatched: its sampled
+                # token is speculative — masked, never committed/streamed
+                continue
+            committed += 1
             st.pending = int(tok[slot])
+            # with no newer step in flight the device token vector is
+            # gone; the next dispatch must feed this host value
+            st.fresh = self._inflight is None
             self._commit_token(slot, st.pending, float(tok_lp[slot]))
-        self.metrics.set_gauges(slots_active=self.slots.active_slots)
+        self.metrics.observe_decode_iteration(committed, device_s)
+        self.metrics.observe_step_breakdown(device_s=device_s)
+        return t_ready - t_fetch
+
+    def _flush_inflight(self) -> None:
+        """Drain the in-flight step (pause/idle paths).  If every slot it
+        covered has retired, all its tokens are speculative: drop the step
+        without even syncing it."""
+        prev, self._inflight = self._inflight, None
+        if prev is None:
+            return
+        if any(self._active.get(s) is st for s, st in prev.slots.items()):
+            self._process_step_results(prev)
 
     def _commit_token(self, slot: int, token: int, logprob: float) -> None:
         """Append a sampled token to the slot's request, stream it, and
@@ -628,3 +967,4 @@ class ServingEngine:
             self.metrics.inc("completed")
             self.metrics.observe_e2e(time.perf_counter() - req.submit_time)
         req.done_event.set()
+        self._notify_drain()

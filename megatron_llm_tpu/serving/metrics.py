@@ -66,7 +66,7 @@ class LatencyHistogram:
 _COUNTERS = (
     "submitted", "admitted", "completed", "cancelled", "timeouts",
     "rejected_queue_full", "rejected_invalid", "rejected_draining",
-    "prefills", "decode_iterations", "decode_tokens",
+    "prefills", "prefill_chunks", "decode_iterations", "decode_tokens",
 )
 
 
@@ -85,6 +85,16 @@ class ServingMetrics:
         self.ttft = LatencyHistogram()
         self.per_token = LatencyHistogram()
         self.e2e = LatencyHistogram()
+        # device-vs-host breakdown (engine._step): where a decode
+        # iteration's wall time actually goes.  device_step = dispatch ->
+        # tokens on host; sched_host = Python bookkeeping per iteration;
+        # device_idle_frac = EWMA of the fraction of inter-dispatch wall
+        # time the device sat idle waiting on the host (~0 when the
+        # pipelined scheduler keeps a step in flight — the direct evidence
+        # that host overhead is overlapped, not inferred from tok/s).
+        self.device_step = LatencyHistogram()
+        self.sched_host = LatencyHistogram()
+        self.device_idle_frac: Optional[float] = None
         self.timers = Timers(log_level=2)
 
     def inc(self, name: str, by: int = 1) -> None:
@@ -108,6 +118,21 @@ class ServingMetrics:
             for _ in range(batch):
                 self.per_token.observe(seconds)
 
+    def observe_step_breakdown(self, *, device_s: Optional[float] = None,
+                               host_s: Optional[float] = None,
+                               gap_frac: Optional[float] = None) -> None:
+        """Per-iteration device/host split from the engine's step loop."""
+        with self._lock:
+            if device_s is not None:
+                self.device_step.observe(device_s)
+            if host_s is not None:
+                self.sched_host.observe(host_s)
+            if gap_frac is not None:
+                gap_frac = min(1.0, max(0.0, gap_frac))
+                self.device_idle_frac = (
+                    gap_frac if self.device_idle_frac is None
+                    else 0.9 * self.device_idle_frac + 0.1 * gap_frac)
+
     def observe_ttft(self, seconds: float) -> None:
         with self._lock:
             self.ttft.observe(seconds)
@@ -130,6 +155,11 @@ class ServingMetrics:
                 "ttft": self.ttft.snapshot(),
                 "per_token_latency": self.per_token.snapshot(),
                 "e2e_latency": self.e2e.snapshot(),
+                "device_step_time": self.device_step.snapshot(),
+                "sched_host_time": self.sched_host.snapshot(),
+                "device_idle_frac": (self.device_idle_frac
+                                     if self.device_idle_frac is not None
+                                     else 0.0),
             })
             return out
 
@@ -146,9 +176,13 @@ class ServingMetrics:
                           iteration)
         writer.add_scalar("serving/max_decode_batch",
                           snap["max_decode_batch"], iteration)
+        writer.add_scalar("serving/device_idle_frac",
+                          snap["device_idle_frac"], iteration)
         for hist, key in ((self.ttft, "ttft"),
                           (self.per_token, "per_token_latency"),
-                          (self.e2e, "e2e_latency")):
+                          (self.e2e, "e2e_latency"),
+                          (self.device_step, "device_step_time"),
+                          (self.sched_host, "sched_host_time")):
             writer.add_scalar(f"serving/{key}_mean_s", hist.mean(), iteration)
             writer.add_scalar(f"serving/{key}_p95_s", hist.percentile(95),
                               iteration)

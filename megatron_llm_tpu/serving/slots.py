@@ -16,6 +16,15 @@ Donation: the insert splices a fresh prefill cache into the big cache
 functionally; on TPU the old buffer is donated so the update is in-place
 (two full-cache copies per admission otherwise).  XLA:CPU does not
 implement donation and warns, so donation is keyed off the backend.
+
+Pipelined-scheduler ordering contract (engine.py fast path): the engine
+may call ``insert`` while a decode step is still in flight.  That is
+safe because the engine adopts the dispatched step's output caches
+(``set_caches``) *before* inserting, so the insert consumes the step's
+result as a data dependency — XLA orders the whole-row splice after the
+step's masked row-0 write to the then-free slot, and the splice replaces
+the entire row.  No host synchronization is needed to keep admissions
+and in-flight decodes consistent.
 """
 
 from __future__ import annotations

@@ -34,6 +34,21 @@ def main(argv=None) -> int:
     ap.add_argument("--max_seq_len", type=int, default=None,
                     help="per-slot cache width (prompt + generation); "
                          "default: the model's max_position_embeddings")
+    ap.add_argument("--prefill_bucket", type=int, default=64,
+                    help="pad prompt lengths up to a multiple of this "
+                         "before the admission prefill so the number of "
+                         "compiled prefill shapes stays bounded under real "
+                         "traffic (1 = exact lengths = one executable per "
+                         "distinct prompt length, a compile-storm)")
+    ap.add_argument("--prefill_chunk", type=int, default=None,
+                    help="chunked prefill admission: prefill at most this "
+                         "many prompt tokens per scheduler iteration, "
+                         "interleaved with decode steps, so a long prompt "
+                         "doesn't freeze active streams (docs/serving.md); "
+                         "supersedes --prefill_bucket; default: off")
+    ap.add_argument("--no_pipeline_decode", action="store_true",
+                    help="disable the one-step pipelined decode loop "
+                         "(diagnostic; docs/serving.md fast path)")
     ap.add_argument("--retry_after_s", type=float, default=1.0,
                     help="Retry-After hint returned with 503 backpressure")
     ap.add_argument("--request_deadline_s", type=float, default=None,
@@ -111,7 +126,10 @@ def main(argv=None) -> int:
         queue_size=args.queue_size,
         engine_max_seq_len=args.max_seq_len,
         retry_after_s=args.retry_after_s,
-        request_deadline_s=args.request_deadline_s)
+        request_deadline_s=args.request_deadline_s,
+        prefill_bucket=args.prefill_bucket,
+        prefill_chunk=args.prefill_chunk,
+        pipeline_decode=not args.no_pipeline_decode)
     print(f"serving on {args.host}:{args.port}")
     if mesh_ctx is not None:
         with mesh_ctx:

@@ -171,3 +171,71 @@ def test_eligibility_arms():
     kc_q = init_quantized_cache((cfg.num_layers, 2, cfg.kv_heads, 256,
                                  cfg.head_dim))
     assert not ok(cfg, kc=kc_q)
+
+
+def test_fused_matches_composed_vector_fills():
+    """Per-slot fill vector (the serving engine's slot batch): every row
+    attends/writes at its OWN position, including a fill-0 row standing in
+    for a free slot riding through the step."""
+    cfg = _cfg()
+    b, max_len = 4, 256
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    k_cache, v_cache, rope = _prefill_cache(
+        cfg, params, b, max_len, 128, jax.random.key(1))
+    fills = jnp.asarray([37, 0, 128, 64], jnp.int32)
+    x = jax.random.normal(jax.random.key(2), (b, cfg.hidden_size),
+                          jnp.float32)
+
+    position_ids = fills[:, None] + jnp.arange(1, dtype=jnp.int32)[None, :]
+    side = AttnSideInputs(rope_cos=rope[0], rope_sin=rope[1],
+                          position_ids=position_ids, deterministic=True)
+    want_h, want_k, want_v = stack_forward_cached(
+        cfg, params["layers"], x[:, None, :], side, k_cache, v_cache, fills)
+
+    got_h, k_rows, v_rows = fused_decode_step(
+        cfg, params["layers"], x, k_cache, v_cache, fills, rope,
+        interpret=True)
+    got_k = cache_update(k_cache, k_rows, fills)
+    got_v = cache_update(v_cache, v_rows, fills)
+
+    np.testing.assert_allclose(np.asarray(got_h), np.asarray(want_h[:, 0]),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(want_k),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_full_forward_cached_parity_when_forced_vector_fills():
+    """forward_cached routes a [b] fill vector through the fused kernel
+    (the engine's batched decode step) with identical results."""
+    cfg = _cfg()
+    b, max_len = 2, 256
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    k_cache, v_cache, rope = _prefill_cache(
+        cfg, params, b, max_len, 50, jax.random.key(1))
+    fills = jnp.asarray([50, 13], jnp.int32)
+    tok = jax.random.randint(jax.random.key(3), (b, 1), 0, cfg.vocab_size)
+
+    want_logits, want_k, want_v = model_lib.forward_cached(
+        cfg, params, tok, k_cache, v_cache, fills, rope=rope)
+
+    import megatron_llm_tpu.kernels.decode_step as ds
+    orig_step = ds.fused_decode_step
+    orig_eligible = ds.fused_decode_eligible
+    try:
+        ds.fused_decode_eligible = lambda *a: True
+        ds.fused_decode_step = lambda *a, **kw: orig_step(
+            *a, **{**kw, "interpret": True})
+        got_logits, got_k, got_v = model_lib.forward_cached(
+            cfg, params, tok, k_cache, v_cache, fills, rope=rope)
+    finally:
+        ds.fused_decode_eligible = orig_eligible
+        ds.fused_decode_step = orig_step
+
+    np.testing.assert_allclose(np.asarray(got_logits),
+                               np.asarray(want_logits), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(want_k),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v),
+                               rtol=2e-5, atol=2e-5)

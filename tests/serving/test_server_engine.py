@@ -151,3 +151,42 @@ def test_http_503_carries_retry_after_header(model):
         assert body["retry_after"] == 9
     finally:
         server.shutdown()
+
+
+def test_http_metrics_endpoint(model):
+    """GET /metrics returns the live serving snapshot as JSON, including
+    the device/host step breakdown the fast path exposes."""
+    cfg, params = model
+    server = MegatronServer(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=2)
+    server.run("127.0.0.1", 0, block=False)
+    try:
+        url = f"http://127.0.0.1:{server.port}/metrics"
+        # scraping a server whose engine was never created must not
+        # instantiate the slot cache — and still answer
+        with urllib.request.urlopen(url, timeout=60) as resp:
+            cold = json.loads(resp.read())
+        assert cold["completed"] == 0
+        assert server.service._engine is None
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/api",
+            data=json.dumps({"prompts": ["5 9 3"], "tokens_to_generate": 4,
+                             "no_early_termination": True}).encode(),
+            method="PUT", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            assert resp.status == 200
+        with urllib.request.urlopen(url, timeout=60) as resp:
+            snap = json.loads(resp.read())
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/other", timeout=60)
+        assert ei.value.code == 404
+    finally:
+        server.shutdown()
+    assert snap["completed"] == 1
+    assert snap["decode_iterations"] > 0
+    assert snap["device_step_time"]["count"] > 0
+    assert "device_idle_frac" in snap and "sched_host_time" in snap
