@@ -417,14 +417,19 @@ def _serving_point():
                              slots=8)
 
 
-def _serving_mixed_point():
+def _serving_mixed_point(quantize: bool = False):
     """Mixed-workload serving (megatron_llm_tpu/serving/bench.py): varied
     prompt lengths with the long prompts arriving mid-decode, chunked
     prefill + pipelined decode on → aggregate tok/s, TTFT and ITL
     p50/p99, and the device/host step breakdown (device_idle_frac ~0 is
     the pipelining evidence).  This is the point where chunked prefill's
     ITL effect is visible: without it every long admission freezes the
-    active streams for a whole-prompt prefill."""
+    active streams for a whole-prompt prefill.
+
+    With ``quantize`` the model serves fully int8-resident (int8 weights
+    + int8 KV), the configuration the fused decode kernel's int8 path
+    targets — the engine's fused_steps counter tells whether the slot
+    batch actually took it."""
     import jax
 
     from megatron_llm_tpu.models import model as model_lib
@@ -432,7 +437,15 @@ def _serving_mixed_point():
 
     max_prompt_len, gen_len = 256, 64
     cfg = _bench_model(max_prompt_len + gen_len, "selective")
+    if quantize:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, kv_cache_quant="int8").validate()
     params = model_lib.init_params(jax.random.key(0), cfg)
+    if quantize:
+        from megatron_llm_tpu.ops.quant import quantize_params
+
+        params = quantize_params(params)
     return run_mixed_serving_bench(cfg, params, num_requests=24,
                                    gen_len=gen_len, slots=8,
                                    max_prompt_len=max_prompt_len,
@@ -470,6 +483,93 @@ def _retry(fn, *args, **kw):
 
 
 # ---------------------------------------------------------------------------
+# Regression compare (--compare PREV.json [CURRENT.json])
+# ---------------------------------------------------------------------------
+
+# Metrics whose >10% regression fails CI (exit nonzero).  "mfu" is the
+# record's "value" field (surfaced under its real name by _flatten_metrics).
+_HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
+                     "decode_int8_roofline_frac")
+_REGRESSION_TOLERANCE = 0.10
+
+
+def _flatten_metrics(record: dict, prefix: str = "") -> dict:
+    """Numeric leaves of a BENCH record as a flat {dotted.name: float}.
+    The headline "value" field is renamed "mfu"; lists (the mfu_vs_seq
+    curve) are skipped — their rows move between runs."""
+    out = {}
+    for key, val in record.items():
+        name = f"{prefix}{key}"
+        if key == "value" and not prefix:
+            name = "mfu"
+        if isinstance(val, bool):
+            continue
+        if isinstance(val, (int, float)):
+            out[name] = float(val)
+        elif isinstance(val, dict):
+            out.update(_flatten_metrics(val, prefix=f"{name}."))
+    return out
+
+
+def compare_records(prev: dict, cur: dict):
+    """Per-metric deltas between two BENCH records → (lines, regressed).
+
+    ``lines`` is a human-readable report (one line per metric present in
+    either record); ``regressed`` lists the headline metrics that dropped
+    more than _REGRESSION_TOLERANCE — latency-style metrics are reported
+    but never gate, because for every headline metric here bigger is
+    better."""
+    p, c = _flatten_metrics(prev), _flatten_metrics(cur)
+    lines, regressed = [], []
+    for name in sorted(set(p) | set(c)):
+        if name not in p:
+            lines.append(f"  {name}: (new) {c[name]:g}")
+            continue
+        if name not in c:
+            lines.append(f"  {name}: {p[name]:g} -> MISSING")
+            if name in _HEADLINE_METRICS:
+                regressed.append(name)
+            continue
+        pv, cv = p[name], c[name]
+        delta = (cv - pv) / abs(pv) if pv else 0.0
+        mark = ""
+        if name in _HEADLINE_METRICS and delta < -_REGRESSION_TOLERANCE:
+            regressed.append(name)
+            mark = "  << REGRESSION"
+        lines.append(f"  {name}: {pv:g} -> {cv:g} ({delta:+.1%}){mark}")
+    return lines, regressed
+
+
+def _load_record(path: str) -> dict:
+    """Last JSON-object line of a BENCH_*.json file (the bench prints
+    '#'-prefixed progress lines before the record)."""
+    record = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{"):
+                record = json.loads(line)
+    if record is None:
+        raise ValueError(f"no JSON record line in {path}")
+    return record
+
+
+def _run_compare(prev_path: str, cur_record: dict) -> int:
+    prev = _load_record(prev_path)
+    lines, regressed = compare_records(prev, cur_record)
+    print(f"# compare vs {prev_path} "
+          f"(gate: {', '.join(_HEADLINE_METRICS)} "
+          f"> {_REGRESSION_TOLERANCE:.0%} drop):", flush=True)
+    for line in lines:
+        print("#" + line, flush=True)
+    if regressed:
+        print(f"# REGRESSED: {', '.join(regressed)}", flush=True)
+        return 1
+    print("# no headline regression", flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # Orchestration: one subprocess per point (see module docstring)
 # ---------------------------------------------------------------------------
 
@@ -502,7 +602,7 @@ def _child_main(spec_json: str) -> None:
     elif kind == "serving":
         out = _retry(_serving_point)
     elif kind == "serving_mixed":
-        out = _retry(_serving_mixed_point)
+        out = _retry(_serving_mixed_point, spec.get("quantize", False))
     else:  # pragma: no cover - parent and child ship together
         raise ValueError(f"unknown point kind {kind!r}")
     print(_CHILD_MARK + json.dumps(out), flush=True)
@@ -580,6 +680,18 @@ def main() -> None:
     if len(sys.argv) >= 3 and sys.argv[1] == "--point":
         _child_main(sys.argv[2])
         return
+    compare_prev = None
+    if len(sys.argv) >= 2 and sys.argv[1] == "--compare":
+        if len(sys.argv) >= 4:
+            # file-vs-file mode: no measurement, pure CI gate
+            raise SystemExit(_run_compare(sys.argv[2],
+                                          _load_record(sys.argv[3])))
+        if len(sys.argv) == 3:
+            # run the bench, then gate the fresh record against PREV
+            compare_prev = sys.argv[2]
+        else:
+            raise SystemExit("usage: bench.py --compare PREV.json "
+                             "[CURRENT.json]")
 
     try:
         platform = _detect_device()
@@ -657,6 +769,10 @@ def main() -> None:
     serving_mixed = _point("serving/mixed",
                            {"kind": "serving_mixed", "platform": platform},
                            timeout_s=1200)
+    serving_mixed_q = _point("serving/mixed-int8",
+                             {"kind": "serving_mixed", "platform": platform,
+                              "quantize": True},
+                             timeout_s=1200)
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     record = {
@@ -693,6 +809,8 @@ def main() -> None:
         record["serving"] = serving
     if serving_mixed is not None:
         record["serving_mixed"] = serving_mixed
+    if serving_mixed_q is not None:
+        record["serving_mixed_int8"] = serving_mixed_q
     if headline is not None:
         record.update({
             "value": round(mfu, 4),
@@ -703,6 +821,8 @@ def main() -> None:
             "headline_config": headline_config,
         })
     print(json.dumps(record))
+    if compare_prev is not None:
+        raise SystemExit(_run_compare(compare_prev, record))
 
 
 if __name__ == "__main__":

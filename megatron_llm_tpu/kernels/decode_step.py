@@ -17,9 +17,18 @@ op-dispatch latency wall.
 
 Scope (eligibility enforced by :func:`fused_decode_eligible`): dense
 pre-LN RMSNorm GLU decoder layers (the Llama family), rotary positions,
-no biases, bf16/f32 weights, unquantized bf16 cache, single new token,
-no active mesh, per-layer working set within the VMEM budget.
-Everything else — prefill, int8, meshes, BERT/T5, 7B-width layers —
+no biases, single new token, no active mesh, per-layer working set
+within the VMEM budget.  Weights may be bf16/f32 OR the
+``{"q": int8, "scale": fp32}`` form of ops/quant.py — int8 tiles stream
+into VMEM and the per-output-column scale is an epilogue after each dot
+(the algebra of ops/quant.py:mm), applied to q/k BEFORE RoPE because
+the rotation mixes adjacent columns carrying different scales.  The KV
+cache may be plain bf16/f32 OR the int8 ``{"q", "scale"}`` form of
+ops/kv_quant.py — dequantization is fused at the attention tile load
+(the fp copy exists only in registers), and the new token's K/V are
+requantized in-register so their in-kernel attention fold matches what
+later steps read back from the quantized cache.  Everything else —
+prefill, meshes, BERT/T5, 7B-width layers, partially-quantized stacks —
 keeps the composed path (models/transformer.py:stack_forward_cached).
 The reference's serving loop runs one token per python-level
 ForwardStep through the whole module tree
@@ -47,6 +56,13 @@ Design notes:
   VPU ops are issue-latency-bound).  Mosaic unrolls the two leading
   dims, which is exactly the wide straight-line vector code the VPU
   wants here.
+- int8 cache scales ride as ``[L, b, kv, max_len, 1]`` operands so the
+  ``(block_k, 1)`` trailing block dims stay legal under the TPU tiling
+  rule (the flash_decode.py _scale_block_spec trick); a quantized
+  cache's new K/V rows come back as fp32 outputs whose values are
+  already dequant(quant(row)) — the host-side cache_update requantizes
+  them to the exact same int8 rows (idempotent, ops/kv_quant.py), so
+  the kernel needs no narrow in-kernel scale stores.
 """
 
 from __future__ import annotations
@@ -58,6 +74,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from ..ops.kv_quant import fake_quantize_rows
 
 NEG_INF = -1e30
 
@@ -86,7 +104,8 @@ _GLU_BASE = {
 }
 
 
-def _decode_step_kernel(per_row: bool, nk: int, nm: int, block_k: int,
+def _decode_step_kernel(per_row: bool, wq8: bool, cq8: bool,
+                        nk: int, nm: int, block_k: int,
                         b: int, nq: int, nkv: int, g: int, d: int,
                         eps: float, scale: float, act,
                         lens_ref,
@@ -98,13 +117,21 @@ def _decode_step_kernel(per_row: bool, nk: int, nm: int, block_k: int,
     # fill (drives the per-row attention mask).  RoPE at per-row
     # positions arrives as precomputed cos/sin row vectors plus the fixed
     # pair-swap permutation in ``rot_ref`` (see fused_decode_step).
+    # wq8: every projection weight is int8 with [L, 1, out] fp32 scale
+    # operands riding behind the weights.  cq8: the cache refs are int8
+    # with [L, b, kv, block_k, 1] fp32 per-row scale refs behind them.
     if per_row:
         cos_ref, sin_ref, *refs = refs
     (in_nw_ref, post_nw_ref,
      wq_ref, wk_ref, wv_ref, wo_ref,
-     wg_ref, wu_ref, wd_ref,
-     kc_ref, vc_ref,
-     xo_ref, kr_ref, vr_ref,
+     wg_ref, wu_ref, wd_ref, *refs) = refs
+    if wq8:
+        (qs_ref, ks_ref, vs_ref, os_ref,
+         gs_ref, us_ref, ds_ref, *refs) = refs
+    kc_ref, vc_ref, *refs = refs
+    if cq8:
+        kcs_ref, vcs_ref, *refs = refs
+    (xo_ref, kr_ref, vr_ref,
      x_scr, q_scr, kn_scr, vn_scr, ctx_scr, xn2_scr,
      m_scr, l_scr, acc_scr) = refs
     li = pl.program_id(0)
@@ -112,6 +139,9 @@ def _decode_step_kernel(per_row: bool, nk: int, nm: int, block_k: int,
     n_layers = pl.num_programs(0)
     pos = lens_ref[0]
     f32 = jnp.float32
+    # compute dtype of the projection dots: mirrors ops/quant.py:mm for
+    # int8 weights (inner dot int8→x.dtype, scale as output epilogue)
+    cdt = x_ref.dtype if wq8 else wq_ref.dtype
 
     @pl.when(jnp.logical_and(li == 0, ki == 0))
     def _first():
@@ -126,7 +156,7 @@ def _decode_step_kernel(per_row: bool, nk: int, nm: int, block_k: int,
         nw = in_nw_ref[0].astype(f32)                    # (1, h)
         xn = x * jax.lax.rsqrt(
             jnp.mean(x * x, axis=-1, keepdims=True) + eps) * nw
-        xnc = xn.astype(wq_ref.dtype)
+        xnc = xn.astype(cdt)
         rot = rot_ref[...]                               # (d, d) f32
         dims = (((1,), (0,)), ((), ()))
 
@@ -140,15 +170,32 @@ def _decode_step_kernel(per_row: bool, nk: int, nm: int, block_k: int,
                 return y * cos_ref[...] + z * sin_ref[...]
             return z
 
-        q = jax.lax.dot_general(xnc, wq_ref[0], dims,
+        def wmat(ref):  # int8 tiles convert in-register; HBM stays int8
+            return ref[0].astype(cdt) if wq8 else ref[0]
+
+        q = jax.lax.dot_general(xnc, wmat(wq_ref), dims,
                                 preferred_element_type=f32)
-        k = jax.lax.dot_general(xnc, wk_ref[0], dims,
+        k = jax.lax.dot_general(xnc, wmat(wk_ref), dims,
                                 preferred_element_type=f32)
-        v = jax.lax.dot_general(xnc, wv_ref[0], dims,
+        v = jax.lax.dot_general(xnc, wmat(wv_ref), dims,
                                 preferred_element_type=f32)
+        if wq8:
+            # per-output-column scale epilogue (ops/quant.py:mm algebra),
+            # BEFORE RoPE: the rotation mixes the (2i, 2i+1) column pair,
+            # whose scales differ
+            q = q * qs_ref[0]
+            k = k * ks_ref[0]
+            v = v * vs_ref[0]
         for j in range(nkv):
             kj = rope_head(k[:, j * d:(j + 1) * d])
             vj = v[:, j * d:(j + 1) * d]
+            if cq8:
+                # requantize in-register exactly as the host-side cache
+                # write will (ops/kv_quant.py:quantize_rows is idempotent
+                # on these values), so this token's in-kernel attention
+                # fold matches what later steps read back from the cache
+                kj = fake_quantize_rows(kj)
+                vj = fake_quantize_rows(vj)
             kr_ref[0, :, j, :] = kj[:b].astype(kr_ref.dtype)
             vr_ref[0, :, j, :] = vj[:b].astype(vr_ref.dtype)
             kn_scr[:, j, :] = kj[:b]
@@ -168,6 +215,12 @@ def _decode_step_kernel(per_row: bool, nk: int, nm: int, block_k: int,
     def _attend():
         k4 = kc_ref[0].astype(f32)                       # (b, nkv, bk, d)
         v4 = vc_ref[0].astype(f32)
+        if cq8:
+            # dequantize at tile load (ops/kv_quant.py:dequantize_cache
+            # algebra): int8 rows stream from HBM, the fp copy exists
+            # only in VMEM
+            k4 = k4 * kcs_ref[0]                         # ×(b, nkv, bk, 1)
+            v4 = v4 * vcs_ref[0]
         cols = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, 1, block_k), 2)
         if per_row:
@@ -215,9 +268,12 @@ def _decode_step_kernel(per_row: bool, nk: int, nm: int, block_k: int,
                 ctx_scr[:b, hq * d:(hq + 1) * d] = ctx[:, j, :]
 
         dims = (((1,), (0,)), ((), ()))
+        w_o = wo_ref[0].astype(cdt) if wq8 else wo_ref[0]
         attn = jax.lax.dot_general(
-            ctx_scr[...].astype(wo_ref.dtype), wo_ref[0], dims,
+            ctx_scr[...].astype(cdt), w_o, dims,
             preferred_element_type=f32)                   # (b_pad, h)
+        if wq8:
+            attn = attn * os_ref[0]
         x1 = x_scr[...] + attn
         nw2 = post_nw_ref[0].astype(f32)
         xn2_scr[...] = x1 * jax.lax.rsqrt(
@@ -233,14 +289,24 @@ def _decode_step_kernel(per_row: bool, nk: int, nm: int, block_k: int,
     @pl.when(jnp.logical_and(ki >= nk, "finish" in phases))
     def _mlp_chunk():
         dims = (((1,), (0,)), ((), ()))
-        xn2c = xn2_scr[...].astype(wg_ref.dtype)
-        gate = jax.lax.dot_general(xn2c, wg_ref[0], dims,
+        xn2c = xn2_scr[...].astype(cdt)
+        w_g = wg_ref[0].astype(cdt) if wq8 else wg_ref[0]
+        w_u = wu_ref[0].astype(cdt) if wq8 else wu_ref[0]
+        w_d = wd_ref[0].astype(cdt) if wq8 else wd_ref[0]
+        gate = jax.lax.dot_general(xn2c, w_g, dims,
                                    preferred_element_type=f32)
-        up = jax.lax.dot_general(xn2c, wu_ref[0], dims,
+        up = jax.lax.dot_general(xn2c, w_u, dims,
                                  preferred_element_type=f32)
-        hid = (act(gate) * up).astype(wd_ref.dtype)
-        part = jax.lax.dot_general(hid, wd_ref[0], dims,
+        if wq8:
+            # gate/up scales chunk with the ffn columns; the w_down scale
+            # is per output column, so scaling each partial sum is exact
+            gate = gate * gs_ref[0]
+            up = up * us_ref[0]
+        hid = (act(gate) * up).astype(cdt)
+        part = jax.lax.dot_general(hid, w_d, dims,
                                    preferred_element_type=f32)
+        if wq8:
+            part = part * ds_ref[0]
         x_scr[...] = x_scr[...] + part
 
     @pl.when(jnp.logical_and(li == n_layers - 1, ki == nk + nm - 1))
@@ -302,7 +368,7 @@ def fused_decode_eligible(cfg, params, k_cache, s: int,
         # sharded caches/params: the kernel is single-device; the mesh
         # paths keep the composed stack (ops/attention shard_map kernels)
         return False
-    if s != 1 or is_quantized_cache(k_cache):
+    if s != 1:
         return False
     if (cfg.norm_type != "rmsnorm" or cfg.parallel_attn
             or cfg.num_experts > 0 or cfg.use_bias or cfg.qkv_bias
@@ -312,20 +378,35 @@ def fused_decode_eligible(cfg, params, k_cache, s: int,
             or cfg.position_embedding_type != PositionEmbeddingType.ROTARY):
         return False
     layers = params["layers"]
-    if is_quantized(layers["attn"]["wq"]) or "mlp_norm" in layers:
+    if "mlp_norm" in layers:
         return False
     if not (is_glu(cfg.activation) and "w_gate" in layers["mlp"]):
         return False
+    # int8 weights fuse when ALL seven projections are quantized — a
+    # partially-quantized stack (quantize_params never produces one)
+    # would need per-projection kernel variants, so it keeps the
+    # composed path instead of silently dequantizing
+    projections = (layers["attn"]["wq"], layers["attn"]["wk"],
+                   layers["attn"]["wv"], layers["attn"]["wo"],
+                   layers["mlp"]["w_gate"], layers["mlp"]["w_up"],
+                   layers["mlp"]["w_down"])
+    quant_flags = {is_quantized(w) for w in projections}
+    if len(quant_flags) != 1:
+        return False
+    wq8 = quant_flags.pop()
+    cq8 = is_quantized_cache(k_cache)
+    kc = k_cache["q"] if cq8 else k_cache
     d = cfg.head_dim
     h = cfg.hidden_size
-    max_len = k_cache.shape[3]
-    b = k_cache.shape[1]
+    max_len = kc.shape[3]
+    b = kc.shape[1]
     if not (d % 128 == 0 and h % 128 == 0 and cfg.ffn_size % 128 == 0
             and (cfg.num_attention_heads * d) % 128 == 0
             and (cfg.kv_heads * d) % 128 == 0
             and max_len % 128 == 0):
         return False
-    return _vmem_fit(cfg, b, min(256, max_len), k_cache.dtype.itemsize)
+    w_item = 1 if wq8 else layers["attn"]["wq"].dtype.itemsize
+    return _pick_block_k(cfg, b, max_len, w_item, kc.dtype.itemsize) >= 128
 
 
 def _mlp_chunks(ffn: int, cap: int = 4) -> int:
@@ -339,25 +420,57 @@ def _mlp_chunks(ffn: int, cap: int = 4) -> int:
     return 1
 
 
-def _vmem_fit(cfg, b: int, block_k: int, itemsize: int,
+def _default_block_k(cache_int8: bool) -> int:
+    """int8 cache blocks are half the bytes: a double-width tile costs
+    the same VMEM and amortizes better (flash_decode.py's int8 kernel
+    measured ~7% faster at its doubled default)."""
+    return 512 if cache_int8 else 256
+
+
+def _pick_block_k(cfg, b: int, max_len: int, weight_itemsize: int,
+                  cache_itemsize: int) -> int:
+    """Largest cache block that fits the VMEM estimate: start from the
+    dtype-appropriate default and halve while the budget rejects it (the
+    fp32 broadcast-reduce temporaries scale with block_k, so a wide int8
+    block can cost more scratch than its HBM-byte savings).  Returns
+    < 128 when no legal block fits — the kernel floor, i.e. ineligible."""
+    bk = min(_default_block_k(cache_itemsize == 1), max_len)
+    while max_len % bk:
+        bk //= 2
+    while bk >= 128 and not _vmem_fit(cfg, b, bk, weight_itemsize,
+                                      cache_itemsize):
+        bk //= 2
+    return bk
+
+
+def _vmem_fit(cfg, b: int, block_k: int, weight_itemsize: int,
+              cache_itemsize: int,
               budget: int = 100 * 1024 * 1024) -> bool:
     """Whole-layer-resident VMEM estimate: the kernel holds one layer's
     weights + two KV blocks, double-buffered, plus fp32 scratch.  Layers
     wider than the budget (e.g. 7B-width: ~354 MB/layer bf16) must keep
-    the composed path — Mosaic would fail the scoped-vmem allocation."""
+    the composed path — Mosaic would fail the scoped-vmem allocation.
+    Weight and cache itemsizes are independent (weight-only int8, int8
+    KV, or both); int8 roughly doubles the feasible block_k/batch on
+    whichever side is quantized.  The int8 scale vectors ([out] per
+    weight, one fp32 per cache row) are <1% of the blocks and ride
+    inside the budget slack."""
     d = cfg.head_dim
     h = cfg.hidden_size
     nq, nkv, ffn = cfg.num_attention_heads, cfg.kv_heads, cfg.ffn_size
     weight_elts = (h * nq * d + 2 * h * nkv * d + nq * d * h
                    + (3 if cfg.is_glu else 2) * h * ffn // _mlp_chunks(ffn))
     cache_elts = 2 * b * nkv * block_k * d
-    blocks = (weight_elts + cache_elts) * itemsize * 2  # double-buffered
+    blocks = (weight_elts * weight_itemsize
+              + cache_elts * cache_itemsize) * 2  # double-buffered
     b_pad = max(8, -(-b // 8) * 8)
     g = nq // nkv
+    # quantized caches materialize scaled fp32 copies of both tile loads
+    n_tmp = 5 if cache_itemsize == 1 else 3
     scratch = 4 * (2 * b_pad * h + b_pad * nq * d
                    + g * b * nkv * (2 * d + 2 * 128) + 2 * b * nkv * d
                    # the (b, nkv, block_k, d) broadcast-reduce temporaries
-                   + 3 * b * nkv * block_k * d)
+                   + n_tmp * b * nkv * block_k * d)
     return blocks + scratch <= budget
 
 
@@ -365,15 +478,16 @@ def fused_decode_step(
     cfg,
     stacked,             # params["layers"]: stacked [L, ...] pytree
     x: jax.Array,        # [b, h] — embedded hidden of the ONE new token
-    k_cache: jax.Array,  # [L, b, kv_heads, max_len, d] (NOT yet updated)
-    v_cache: jax.Array,
+    k_cache,             # [L, b, kv_heads, max_len, d] (NOT yet updated),
+    #                      or the int8 {"q", "scale"} dict of ops/kv_quant
+    v_cache,
     cache_len: jax.Array,  # scalar int32: valid cache rows (= new token
     #                        pos), or a [b] vector of PER-ROW fills (the
     #                        serving engine's slot batch: each request sits
     #                        at its own depth, free slots ride at fill 0)
     rope: tuple,           # (cos, sin) tables from rope_tables(cfg)
     *,
-    block_k: int = 256,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """→ ``(hidden [b, h], k_rows [L, b, kv, 1, d], v_rows ...)``.
@@ -384,14 +498,27 @@ def fused_decode_step(
     accepts the same scalar-or-vector ``cache_len``) — the same contract
     as stack_forward_cached with s=1.
 
+    Weights may be the int8 {"q", "scale"} form (all seven projections,
+    as quantize_params produces); the cache may be the int8 dict form.
+    For a quantized cache the returned rows are fp32 values the kernel
+    already requantized in-register — cache_update's quantize_rows maps
+    them back to the exact same int8 rows, so the one host-side write
+    stays the single cache write point.
+
     With a vector ``cache_len``, cache blocks are fetched up to the MAX
     fill only (one clamp for the whole batch: a ragged batch costs the
     deepest row's bytes) and each row masks attention at its own fill.
     """
+    from ..ops.kv_quant import is_quantized_cache
+    from ..ops.quant import is_quantized
+
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    cq8 = is_quantized_cache(k_cache)
+    k_arr = k_cache["q"] if cq8 else k_cache
+    v_arr = v_cache["q"] if cq8 else v_cache
     b, h = x.shape
-    L, _, nkv, max_len, d = k_cache.shape
+    L, _, nkv, max_len, d = k_arr.shape
     nq = cfg.num_attention_heads
     g = nq // nkv
     ffn = cfg.ffn_size
@@ -399,6 +526,13 @@ def fused_decode_step(
     scale = 1.0 / float(np.sqrt(d))
     act = _GLU_BASE[cfg.activation]
 
+    if block_k is None:
+        # same probe as fused_decode_eligible, so the block the predicate
+        # accepted is the block the call actually launches with
+        wq = stacked["attn"]["wq"]
+        w_item = 1 if is_quantized(wq) else wq.dtype.itemsize
+        block_k = _pick_block_k(cfg, b, max_len, w_item,
+                                1 if cq8 else k_arr.dtype.itemsize)
     block_k = min(block_k, max_len)
     while max_len % block_k:
         block_k //= 2
@@ -430,17 +564,38 @@ def fused_decode_step(
         lens = jnp.reshape(cache_len, (1,))
 
     attn_p, mlp_p = stacked["attn"], stacked["mlp"]
+    wq8 = is_quantized(attn_p["wq"])
+
+    def wm(w):  # int8 weights ship their q payload; scales ride separately
+        return w["q"] if wq8 else w
+
     # norm scales ride as [L, 1, h]: a (1, 1, h) block keeps the last two
     # dims legal under the TPU (8, 128) tiling rule (a (1, h) block of an
     # [L, h] array has a size-1 sublane dim and is rejected by Mosaic)
     rope_rows = (c_rows, s_rows) if per_row else ()
+    # int8 weight scales are [L, out] fp32 → ride as [L, 1, out] (same
+    # norm-scale tiling trick); order matches the kernel's unpacking
+    # (qs, ks, vs, os, gs, us, ds)
+    weight_scales = (
+        attn_p["wq"]["scale"][:, None, :], attn_p["wk"]["scale"][:, None, :],
+        attn_p["wv"]["scale"][:, None, :], attn_p["wo"]["scale"][:, None, :],
+        mlp_p["w_gate"]["scale"][:, None, :],
+        mlp_p["w_up"]["scale"][:, None, :],
+        mlp_p["w_down"]["scale"][:, None, :],
+    ) if wq8 else ()
+    # int8 cache scales are [L, b, kv, max_len] fp32 → a trailing unit dim
+    # keeps the (block_k, 1) block legal (flash_decode _scale_block_spec)
+    cache_scales = (k_cache["scale"][..., None],
+                    v_cache["scale"][..., None]) if cq8 else ()
     operands = (
         x_p, rot, *rope_rows,
         stacked["input_norm"]["scale"][:, None, :],
         stacked["post_attn_norm"]["scale"][:, None, :],
-        attn_p["wq"], attn_p["wk"], attn_p["wv"], attn_p["wo"],
-        mlp_p["w_gate"], mlp_p["w_up"], mlp_p["w_down"],
-        k_cache, v_cache,
+        wm(attn_p["wq"]), wm(attn_p["wk"]), wm(attn_p["wv"]),
+        wm(attn_p["wo"]),
+        wm(mlp_p["w_gate"]), wm(mlp_p["w_up"]), wm(mlp_p["w_down"]),
+        *weight_scales,
+        k_arr, v_arr, *cache_scales,
     )
 
     def fixed(shape):
@@ -469,6 +624,24 @@ def fused_decode_step(
             return (li, jnp.clip(ki - nk, 0, nm - 1), 0)
         return pl.BlockSpec((1, f_chunk, h), idx)
 
+    def mlp_scale_spec():
+        # gate/up scales chunk with the ffn columns of mlp_col_spec
+        def idx(li, ki, lens):
+            return (li, 0, jnp.clip(ki - nk, 0, nm - 1))
+        return pl.BlockSpec((1, 1, f_chunk), idx)
+
+    def cache_scale_spec():
+        # same fill-clamped block walk as cache_spec, trailing unit dim
+        def idx(li, ki, lens):
+            last = jnp.maximum(lens[0] - 1, 0) // block_k
+            return (li, 0, 0, jnp.minimum(ki, last), 0)
+        return pl.BlockSpec((1, b, nkv, block_k, 1), idx)
+
+    weight_scale_specs = [
+        per_layer((1, nq * d)), per_layer((1, nkv * d)),
+        per_layer((1, nkv * d)), per_layer((1, h)),
+        mlp_scale_spec(), mlp_scale_spec(), per_layer((1, h)),
+    ] if wq8 else []
     in_specs = [
         fixed((b_pad, h)), fixed((d, d)),
         *([fixed((b_pad, d))] * 2 if per_row else []),
@@ -476,16 +649,22 @@ def fused_decode_step(
         per_layer((h, nq * d)), per_layer((h, nkv * d)),
         per_layer((h, nkv * d)), per_layer((nq * d, h)),
         mlp_col_spec(), mlp_col_spec(), mlp_row_spec(),
+        *weight_scale_specs,
         cache_spec(), cache_spec(),
+        *([cache_scale_spec(), cache_scale_spec()] if cq8 else []),
     ]
     out_specs = [
         fixed((b_pad, h)),
         per_layer((b, nkv, d)), per_layer((b, nkv, d)),
     ]
+    # quantized caches get fp32 rows back (already dequant(quant(row));
+    # the host-side cache_update requantizes them losslessly — see
+    # ops/kv_quant.py:fake_quantize_rows)
+    row_dt = jnp.float32 if cq8 else k_arr.dtype
     out_shape = [
         jax.ShapeDtypeStruct((b_pad, h), x.dtype),
-        jax.ShapeDtypeStruct((L, b, nkv, d), k_cache.dtype),
-        jax.ShapeDtypeStruct((L, b, nkv, d), v_cache.dtype),
+        jax.ShapeDtypeStruct((L, b, nkv, d), row_dt),
+        jax.ShapeDtypeStruct((L, b, nkv, d), row_dt),
     ]
     scratch = [
         pltpu.VMEM((b_pad, h), jnp.float32),           # residual stream
@@ -503,7 +682,8 @@ def fused_decode_step(
     compiler_params_cls = getattr(pltpu, "CompilerParams", None) \
         or pltpu.TPUCompilerParams
     hidden, k_rows, v_rows = pl.pallas_call(
-        functools.partial(_decode_step_kernel, per_row, nk, nm, block_k,
+        functools.partial(_decode_step_kernel, per_row, wq8, cq8,
+                          nk, nm, block_k,
                           b, nq, nkv, g, d, eps, scale, act),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,

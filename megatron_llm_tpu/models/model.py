@@ -239,6 +239,11 @@ def forward_cached(
         # ``cache_len`` may be a [b] per-sample fill vector (the serving
         # engine's slot batch): the kernel masks each row at its own fill
         # and cache_update lands each row's K/V at its own position.
+        # int8 weights and the int8 {"q", "scale"} cache dict both route
+        # through here too (eligibility checks all seven projections are
+        # consistently quantized); for a quantized cache the kernel
+        # returns pre-requantized fp rows that cache_update writes back
+        # losslessly.
         from ..kernels.decode_step import fused_decode_step
         from ..ops.kv_quant import cache_update
 

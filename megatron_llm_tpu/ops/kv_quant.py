@@ -12,7 +12,11 @@ never occurs.
 A quantized cache is ``{"q": int8 [..., max_len, d],
 "scale": fp32 [..., max_len]}`` — a plain dict subtree, so the scan-xs /
 dynamic-update-slice / while-loop-carry plumbing of the decode path works
-unchanged on it (pytrees all the way down).
+unchanged on it (pytrees all the way down).  The fused decode-step
+kernel streams the int8 payload directly (dequant fused at the
+attention tile load) and hands back new rows it already passed through
+``fake_quantize_rows``, so the single host-side ``cache_update`` write
+reproduces the exact values the kernel attended over.
 
 The reference has no quantized inference cache; its InferenceParams holds
 compute-dtype tensors (megatron/model/transformer.py:423-496).
@@ -42,6 +46,25 @@ def quantize_rows(rows: jax.Array) -> dict:
     q = jnp.clip(jnp.round(r32 / scale[..., None]),
                  -127, 127).astype(jnp.int8)
     return {"q": q, "scale": scale}
+
+
+def fake_quantize_rows(rows: jax.Array) -> jax.Array:
+    """dequantize(quantize(rows)) in one shot: the fp values an int8
+    cache will hold after ``cache_update`` writes ``rows``.
+
+    The fused decode kernel (kernels/decode_step.py) attends over the NEW
+    token's K/V in-register before the host writes them; running the rows
+    through this first makes the fused step see exactly what the composed
+    path reads back from the quantized cache.  The kernel then returns
+    these fp rows and the host-side ``quantize_rows`` reproduces the same
+    int8 payload — requantizing a dequantized row is idempotent (the row
+    max is exactly scale·127, so the recovered scale matches to 1 ulp and
+    every q/scale quotient rounds back to the same integer)."""
+    r32 = rows.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(r32), axis=-1, keepdims=True) / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    deq = jnp.clip(jnp.round(r32 / scale), -127, 127) * scale
+    return deq.astype(rows.dtype)
 
 
 def dequantize_cache(cache: dict, dtype=jnp.float32) -> jax.Array:

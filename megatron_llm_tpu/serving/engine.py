@@ -441,6 +441,11 @@ class ServingEngine:
         # device/host overlap accounting (metrics.observe_step_breakdown)
         self._last_dispatch_t: Optional[float] = None
         self._last_ready_t: Optional[float] = None
+        # whether forward_cached routes this config's slot batch through
+        # the fused decode kernel — resolved once at start() (the
+        # predicate is static in cfg/params/cache shape) and used to
+        # attribute each decode iteration to fused_steps/fallback_steps
+        self._fused_decode = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -450,6 +455,10 @@ class ServingEngine:
                 self.slots = SlotAllocator(self.cfg,
                                            self.config.max_batch_size,
                                            self.config.max_seq_len)
+                from ..kernels.decode_step import fused_decode_eligible
+                self._fused_decode = fused_decode_eligible(
+                    self.cfg, self.params, self.slots.k_cache, 1,
+                    jax.default_backend())
                 self._thread = threading.Thread(
                     target=self._loop, name="serving-engine", daemon=True)
                 self._thread.start()
@@ -873,6 +882,8 @@ class ServingEngine:
                 self.metrics.observe_step_breakdown(gap_frac=gap / wall)
         self._last_dispatch_t = t0
 
+        self.metrics.inc(
+            "fused_steps" if self._fused_decode else "fallback_steps")
         tok, tok_lp, k_cache, v_cache = self._decode(
             self.cfg, self.params, self.slots.k_cache, self.slots.v_cache,
             pending, jnp.asarray(fills), jnp.asarray(seeds),

@@ -67,6 +67,11 @@ _COUNTERS = (
     "submitted", "admitted", "completed", "cancelled", "timeouts",
     "rejected_queue_full", "rejected_invalid", "rejected_draining",
     "prefills", "prefill_chunks", "decode_iterations", "decode_tokens",
+    # fused-kernel routing (kernels/decode_step.py): decode iterations
+    # through the fused whole-stack kernel vs the composed per-op path.
+    # An int8 config silently losing eligibility shows up here as
+    # fallback_steps climbing where fused_steps should.
+    "fused_steps", "fallback_steps",
 )
 
 

@@ -59,9 +59,14 @@ def main(argv=None) -> int:
     ap.add_argument("--drain_timeout_s", type=float, default=30.0,
                     help="on SIGTERM, how long to let in-flight requests "
                          "finish before the listener stops")
+    ap.add_argument("--weight_quant", default=None, choices=["int8"],
+                    help="weight-only int8 applied after load (halves "
+                         "decode HBM traffic; ops/quant.py). With "
+                         "--kv_quant int8 the fully int8-resident fused "
+                         "decode kernel serves the slot batch "
+                         "(kernels/decode_step.py)")
     ap.add_argument("--quantize", default=None, choices=["int8"],
-                    help="weight-only int8 (halves decode HBM traffic; "
-                         "ops/quant.py)")
+                    help="compatibility alias for --weight_quant")
     ap.add_argument("--kv_quant", default=None, choices=["int8"],
                     help="int8 KV cache (halves decode cache traffic; "
                          "ops/kv_quant.py)")
@@ -97,7 +102,7 @@ def main(argv=None) -> int:
             lm.cfg, kv_cache_quant=args.kv_quant).validate())
     tokenizer = build_tokenizer(args.tokenizer_type, args.tokenizer_model)
     params = load_params_for_inference(args.load, lm.cfg)
-    if args.quantize == "int8":
+    if args.weight_quant == "int8" or args.quantize == "int8":
         from ..ops.quant import quantize_params
 
         params = quantize_params(params)

@@ -166,11 +166,156 @@ def test_eligibility_arms():
     # non-128-divisible cache length
     kc_odd, _ = model_lib.init_kv_cache(cfg, 2, 200)
     assert not ok(cfg, kc=kc_odd)
-    # int8 cache dict form
-    from megatron_llm_tpu.ops.kv_quant import init_quantized_cache
-    kc_q = init_quantized_cache((cfg.num_layers, 2, cfg.kv_heads, 256,
-                                 cfg.head_dim))
-    assert not ok(cfg, kc=kc_q)
+
+
+def test_eligibility_matrix_int8():
+    """int8 weights × {int8, fp} cache × per-row fill × s>1 × biases:
+    pins exactly which combinations take the fused path."""
+    from megatron_llm_tpu.ops.quant import quantize_params
+
+    cfg = _cfg()
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    params_q = quantize_params(params)
+    kc, _ = model_lib.init_kv_cache(cfg, 2, 256)
+    cfg_c = dataclasses.replace(cfg, kv_cache_quant="int8")
+    kc_q, _ = model_lib.init_kv_cache(cfg_c, 2, 256)
+    ok = lambda c, p, kcache, s=1, plat="tpu": \
+        fused_decode_eligible(c, p, kcache, s, plat)
+
+    # every weight × cache quantization combo fuses (per-row fill is a
+    # runtime property of cache_len, invisible to the static predicate,
+    # so the same verdict covers the serving engine's slot batch)
+    assert ok(cfg, params, kc)
+    assert ok(cfg, params_q, kc)
+    assert ok(cfg_c, params, kc_q)
+    assert ok(cfg_c, params_q, kc_q)
+    # ... but never for multi-token steps or biased/composed-only stacks
+    assert not ok(cfg_c, params_q, kc_q, s=2)
+    assert not ok(dataclasses.replace(cfg_c, use_bias=True), params_q, kc_q)
+    assert not ok(cfg, params_q, kc, plat="cpu")
+    # a partially-quantized stack (wq left fp) keeps the composed path
+    # rather than silently dequantizing one projection in-kernel
+    mixed = {**params_q, "layers": {
+        **params_q["layers"],
+        "attn": {**params_q["layers"]["attn"],
+                 "wq": params["layers"]["attn"]["wq"]},
+    }}
+    assert not ok(cfg, mixed, kc)
+    assert not ok(cfg_c, mixed, kc_q)
+
+
+def _maybe_dequant(cache):
+    from megatron_llm_tpu.ops.kv_quant import (dequantize_cache,
+                                               is_quantized_cache)
+    return dequantize_cache(cache) if is_quantized_cache(cache) else cache
+
+
+def _int8_setup(wq8, cq8, b=2, max_len=256, fill=100, key=1):
+    """Params/caches for an int8 parity case: quantized weights and/or an
+    int8 cache, prefilled through the composed path."""
+    from megatron_llm_tpu.ops.quant import quantize_params
+
+    cfg = _cfg(kv_cache_quant="int8") if cq8 else _cfg()
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    if wq8:
+        params = quantize_params(params)
+    k_cache, v_cache, rope = _prefill_cache(
+        cfg, params, b, max_len, fill, jax.random.key(key))
+    return cfg, params, k_cache, v_cache, rope
+
+
+@pytest.mark.parametrize("wq8,cq8", [(True, False), (False, True),
+                                     (True, True)])
+def test_fused_matches_composed_int8(wq8, cq8):
+    """int8 weights and/or int8 KV cache through the fused kernel vs the
+    composed dequant path.  wq8-only is near-exact (both paths run the
+    identical int8·scale algebra); a quantized cache admits one-code
+    flips where the two paths' new K/V rows land on opposite sides of a
+    rounding boundary, so those arms use a scale-sized tolerance."""
+    cfg, params, k_cache, v_cache, rope = _int8_setup(wq8, cq8)
+    b = 2
+    x = jax.random.normal(jax.random.key(2), (b, cfg.hidden_size),
+                          jnp.float32)
+    cache_len = jnp.int32(100)
+    tol = dict(rtol=3e-2, atol=3e-2) if cq8 else dict(rtol=2e-4, atol=2e-4)
+
+    want_h, want_k, want_v = _composed_step(
+        cfg, params, x, k_cache, v_cache, cache_len, rope)
+    got_h, k_rows, v_rows = fused_decode_step(
+        cfg, params["layers"], x, k_cache, v_cache, cache_len, rope,
+        interpret=True)
+    got_k = cache_update(k_cache, k_rows, cache_len)
+    got_v = cache_update(v_cache, v_rows, cache_len)
+
+    np.testing.assert_allclose(np.asarray(got_h), np.asarray(want_h[:, 0]),
+                               **tol)
+    np.testing.assert_allclose(np.asarray(_maybe_dequant(got_k)),
+                               np.asarray(_maybe_dequant(want_k)), **tol)
+    np.testing.assert_allclose(np.asarray(_maybe_dequant(got_v)),
+                               np.asarray(_maybe_dequant(want_v)), **tol)
+
+
+def test_fused_matches_composed_int8_vector_fills():
+    """Fully int8-resident decode (int8 weights + int8 cache) under the
+    serving engine's per-slot fill vector, free slot included."""
+    cfg, params, k_cache, v_cache, rope = _int8_setup(
+        True, True, b=4, fill=128)
+    fills = jnp.asarray([37, 0, 128, 64], jnp.int32)
+    x = jax.random.normal(jax.random.key(2), (4, cfg.hidden_size),
+                          jnp.float32)
+
+    position_ids = fills[:, None] + jnp.arange(1, dtype=jnp.int32)[None, :]
+    side = AttnSideInputs(rope_cos=rope[0], rope_sin=rope[1],
+                          position_ids=position_ids, deterministic=True)
+    want_h, want_k, want_v = stack_forward_cached(
+        cfg, params["layers"], x[:, None, :], side, k_cache, v_cache, fills)
+
+    got_h, k_rows, v_rows = fused_decode_step(
+        cfg, params["layers"], x, k_cache, v_cache, fills, rope,
+        interpret=True)
+    got_k = cache_update(k_cache, k_rows, fills)
+    got_v = cache_update(v_cache, v_rows, fills)
+
+    np.testing.assert_allclose(np.asarray(got_h), np.asarray(want_h[:, 0]),
+                               rtol=3e-2, atol=3e-2)
+    np.testing.assert_allclose(np.asarray(_maybe_dequant(got_k)),
+                               np.asarray(_maybe_dequant(want_k)),
+                               rtol=3e-2, atol=3e-2)
+    np.testing.assert_allclose(np.asarray(_maybe_dequant(got_v)),
+                               np.asarray(_maybe_dequant(want_v)),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_full_forward_cached_parity_when_forced_int8():
+    """forward_cached with int8 weights + int8 cache, fused path forced:
+    same logits/caches as the composed path on the same quantized tree."""
+    cfg, params, k_cache, v_cache, rope = _int8_setup(True, True, fill=50)
+    tok = jax.random.randint(jax.random.key(3), (2, 1), 0, cfg.vocab_size)
+
+    want_logits, want_k, want_v = model_lib.forward_cached(
+        cfg, params, tok, k_cache, v_cache, jnp.int32(50), rope=rope)
+
+    import megatron_llm_tpu.kernels.decode_step as ds
+    orig_step = ds.fused_decode_step
+    orig_eligible = ds.fused_decode_eligible
+    try:
+        ds.fused_decode_eligible = lambda *a: True
+        ds.fused_decode_step = lambda *a, **kw: orig_step(
+            *a, **{**kw, "interpret": True})
+        got_logits, got_k, got_v = model_lib.forward_cached(
+            cfg, params, tok, k_cache, v_cache, jnp.int32(50), rope=rope)
+    finally:
+        ds.fused_decode_eligible = orig_eligible
+        ds.fused_decode_step = orig_step
+
+    np.testing.assert_allclose(np.asarray(got_logits),
+                               np.asarray(want_logits), rtol=3e-2, atol=3e-2)
+    np.testing.assert_allclose(np.asarray(_maybe_dequant(got_k)),
+                               np.asarray(_maybe_dequant(want_k)),
+                               rtol=3e-2, atol=3e-2)
+    np.testing.assert_allclose(np.asarray(_maybe_dequant(got_v)),
+                               np.asarray(_maybe_dequant(want_v)),
+                               rtol=3e-2, atol=3e-2)
 
 
 def test_fused_matches_composed_vector_fills():

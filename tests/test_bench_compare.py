@@ -1,0 +1,108 @@
+"""bench.py --compare regression gate.
+
+The bench emits one JSON record per run; --compare diffs two of them and
+exits nonzero when a headline metric (mfu, decode_tokens_per_sec,
+decode_int8_roofline_frac) regresses more than 10% — the CI hook that
+keeps a perf PR from silently undoing a previous one.  Latency-style and
+secondary metrics are reported but never gate.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import bench
+
+REPO = Path(bench.__file__).resolve().parent
+
+
+def _record(**overrides):
+    rec = {
+        "metric": "mfu", "value": 0.5, "unit": "fraction_of_peak",
+        "vs_baseline": 4.167, "seq_length": 1024, "device": "TPU v5 lite",
+        "mfu_vs_seq": [{"seq_length": 1024, "mfu": 0.5}],
+        "decode_tokens_per_sec": 3800.0,
+        "decode_roofline_frac": 0.61,
+        "decode_tokens_per_sec_int8": 4500.0,
+        "decode_int8_roofline_frac": 0.45,
+        "serving_mixed": {"serving_mixed_tokens_per_sec": 900.0,
+                          "serving_mixed_ttft_p50_s": 0.12},
+    }
+    rec.update(overrides)
+    return rec
+
+
+def test_flatten_surfaces_value_as_mfu_and_nests_dicts():
+    flat = bench._flatten_metrics(_record())
+    assert flat["mfu"] == 0.5
+    assert "value" not in flat
+    assert flat["decode_tokens_per_sec"] == 3800.0
+    assert flat["serving_mixed.serving_mixed_ttft_p50_s"] == 0.12
+    assert not any(k.startswith("mfu_vs_seq") for k in flat)  # lists skip
+    assert "device" not in flat  # strings skip
+
+
+def test_compare_no_regression():
+    lines, regressed = bench.compare_records(
+        _record(), _record(decode_tokens_per_sec=3900.0))
+    assert regressed == []
+    assert any("decode_tokens_per_sec" in l and "+2.6%" in l for l in lines)
+
+
+def test_compare_flags_headline_regressions_only():
+    cur = _record(value=0.43,                       # -14%: gates (as mfu)
+                  decode_int8_roofline_frac=0.30,   # -33%: gates
+                  serving_mixed={"serving_mixed_tokens_per_sec": 100.0,
+                                 "serving_mixed_ttft_p50_s": 9.9})
+    lines, regressed = bench.compare_records(_record(), cur)
+    assert sorted(regressed) == ["decode_int8_roofline_frac", "mfu"]
+    # the serving collapse is reported but does not gate
+    assert any("serving_mixed_tokens_per_sec" in l for l in lines)
+
+
+def test_compare_within_tolerance_does_not_gate():
+    lines, regressed = bench.compare_records(
+        _record(), _record(value=0.46))  # -8% < 10% tolerance
+    assert regressed == []
+
+
+def test_missing_headline_metric_gates_new_metric_does_not():
+    prev, cur = _record(), _record()
+    del cur["decode_int8_roofline_frac"]
+    cur["brand_new_metric"] = 1.0
+    lines, regressed = bench.compare_records(prev, cur)
+    assert regressed == ["decode_int8_roofline_frac"]
+    assert any("(new) 1" in l for l in lines)
+
+
+def test_load_record_skips_progress_lines(tmp_path):
+    p = tmp_path / "BENCH_r05.json"
+    p.write_text("# bench point decode ok (63s)\n"
+                 + json.dumps(_record(value=0.31)) + "\n")
+    assert bench._load_record(str(p))["value"] == 0.31
+
+
+def test_cli_compare_exit_codes(tmp_path):
+    """File-vs-file mode end to end: exit 0 clean, 1 on regression.
+    (--compare with two files never touches a device, so the subprocess
+    is cheap.)"""
+    prev = tmp_path / "prev.json"
+    good = tmp_path / "good.json"
+    bad = tmp_path / "bad.json"
+    prev.write_text(json.dumps(_record()) + "\n")
+    good.write_text(json.dumps(_record(decode_tokens_per_sec=4000.0)) + "\n")
+    bad.write_text(json.dumps(_record(decode_tokens_per_sec=1000.0)) + "\n")
+
+    ok = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--compare",
+         str(prev), str(good)], capture_output=True, text=True, cwd=REPO)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "no headline regression" in ok.stdout
+
+    fail = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--compare",
+         str(prev), str(bad)], capture_output=True, text=True, cwd=REPO)
+    assert fail.returncode == 1, fail.stdout + fail.stderr
+    assert "REGRESSION" in fail.stdout
+    assert "decode_tokens_per_sec" in fail.stdout
