@@ -211,6 +211,34 @@ def _decode_roofline_tps(cfg, param_bytes: int, batch: int,
     return batch / ((param_bytes + kv_bytes) / hbm_bw)
 
 
+def _audited_decode_bytes(cfg, params, batch: int, avg_cache_len: int):
+    """Per-step bytes a decode step actually streams → (weight_bytes,
+    kv_bytes).  The naive roofline denominator (sum of every stored
+    param byte + analytic KV bytes) overstates int8 decode traffic in
+    one place: the word-embedding table.  Decode *gathers* ``batch``
+    rows of it per step — the full table only streams when it doubles
+    as the unembedding matrix (tied embeddings).  Weight leaves are
+    counted at stored width, so an int8 {q, scale} subtree contributes
+    1 byte/element + its fp32 scales; KV bytes come from the cache's own
+    per-position leaf sizes (exact {q, scale} traffic for int8 caches)
+    rather than an analytic elt-size formula."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+
+    weight_bytes = sum(p.size * p.dtype.itemsize
+                       for p in jax.tree.leaves(params))
+    word = params["embedding"]["word"]
+    if not cfg.tie_embed_logits:
+        weight_bytes -= word.size * word.dtype.itemsize
+        weight_bytes += batch * word.shape[-1] * word.dtype.itemsize
+    # one cache position's stored bytes across all layers/heads/sides
+    k1, v1 = model_lib.init_kv_cache(cfg, batch, 1)
+    per_pos = sum(a.size * a.dtype.itemsize
+                  for a in jax.tree.leaves((k1, v1)))
+    return int(weight_bytes), int(per_pos * avg_cache_len)
+
+
 def _min_time(run, n=3):
     """Best-of-n wall time: tunnel latency drifts wildly between runs, and
     subtraction-based rates amplify single-shot jitter — minimums of
@@ -292,13 +320,30 @@ def _decode_point(hbm_bw: float, quantize: bool = False,
     roof = _decode_roofline_tps(cfg, param_bytes, b,
                                 prompt_len + gen_len // 2, hbm_bw)
     n_params = sum(p.size for p in jax.tree.leaves(params))
-    return {
+    result = {
         "tokens_per_sec": round(tps, 1),
         "roofline_tokens_per_sec": round(roof, 1),
         "roofline_frac": round(tps / roof, 4),
         "prefill_tokens_per_sec": round(prefill_tps, 1),
         "model_params": n_params,
     }
+    if quantize:
+        # per-step bytes-moved audit for the int8 point: the naive
+        # denominator streams the (untied, gathered-not-streamed) word
+        # embedding table every step, understating roofline_frac; the
+        # audited denominator counts actual {q, scale} traffic
+        # (docs/inference.md files the residual gap as a measured number)
+        weight_bytes, kv_bytes = _audited_decode_bytes(
+            cfg, params, b, prompt_len + gen_len // 2)
+        roof_a = b * hbm_bw / (weight_bytes + kv_bytes)
+        result.update({
+            "step_weight_bytes": weight_bytes,
+            "step_kv_bytes": kv_bytes,
+            "naive_roofline_frac": result["roofline_frac"],
+            "roofline_tokens_per_sec": round(roof_a, 1),
+            "roofline_frac": round(tps / roof_a, 4),
+        })
+    return result
 
 
 def _pld_point(wide_layers: int = 0):
@@ -452,6 +497,28 @@ def _serving_mixed_point(quantize: bool = False):
                                    prefill_chunk=64)
 
 
+def _serving_prefix_point():
+    """Prefix-cache serving point (serving/prefix_cache.py): a wave of
+    requests sharing one 896-token system prompt (64-token blocks) vs a
+    wave with distinct prefixes, each request timed submit -> first
+    token.  Headline ``serving_prefix_ttft_speedup`` = cold TTFT p50 /
+    hit TTFT p50 — the acceptance bar is ≥ 3x at this geometry (the hit
+    path runs one fused cache-assembly dispatch plus a 64-token bucket
+    prefill instead of 928 prompt rows) — plus the hit rate; both gate
+    in --compare."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.serving.bench import run_prefix_serving_bench
+
+    shared_len, unique_len, gen_len = 896, 32, 16
+    cfg = _bench_model(shared_len + unique_len + gen_len + 64, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return run_prefix_serving_bench(
+        cfg, params, num_requests=16, shared_len=shared_len,
+        unique_len=unique_len, gen_len=gen_len, slots=8, block=64)
+
+
 def _transient_error_types():
     """The error classes worth retrying: the axon-tunneled compile service
     occasionally throws a transient remote-compile XlaRuntimeError.
@@ -489,7 +556,9 @@ def _retry(fn, *args, **kw):
 # Metrics whose >10% regression fails CI (exit nonzero).  "mfu" is the
 # record's "value" field (surfaced under its real name by _flatten_metrics).
 _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
-                     "decode_int8_roofline_frac")
+                     "decode_int8_roofline_frac",
+                     "serving_prefix.serving_prefix_ttft_speedup",
+                     "serving_prefix.serving_prefix_hit_rate")
 _REGRESSION_TOLERANCE = 0.10
 
 
@@ -603,6 +672,8 @@ def _child_main(spec_json: str) -> None:
         out = _retry(_serving_point)
     elif kind == "serving_mixed":
         out = _retry(_serving_mixed_point, spec.get("quantize", False))
+    elif kind == "serving_prefix":
+        out = _retry(_serving_prefix_point)
     else:  # pragma: no cover - parent and child ship together
         raise ValueError(f"unknown point kind {kind!r}")
     print(_CHILD_MARK + json.dumps(out), flush=True)
@@ -773,6 +844,10 @@ def main() -> None:
                              {"kind": "serving_mixed", "platform": platform,
                               "quantize": True},
                              timeout_s=1200)
+    serving_prefix = _point("serving/prefix",
+                            {"kind": "serving_prefix",
+                             "platform": platform},
+                            timeout_s=1200)
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     record = {
@@ -797,6 +872,17 @@ def main() -> None:
             "decode_tokens_per_sec_int8": decode_q["tokens_per_sec"],
             "decode_int8_roofline_frac": decode_q["roofline_frac"],
         })
+        if "step_weight_bytes" in decode_q:
+            # bytes-moved audit (definition change vs pre-audit records:
+            # roofline_frac now uses the audited denominator; the naive
+            # value rides along for continuity — docs/inference.md)
+            record.update({
+                "decode_int8_step_weight_bytes":
+                    decode_q["step_weight_bytes"],
+                "decode_int8_step_kv_bytes": decode_q["step_kv_bytes"],
+                "decode_int8_naive_roofline_frac":
+                    decode_q["naive_roofline_frac"],
+            })
     if decode_7b is not None:
         record["decode_7b_width"] = decode_7b
     if pld is not None:
@@ -811,6 +897,8 @@ def main() -> None:
         record["serving_mixed"] = serving_mixed
     if serving_mixed_q is not None:
         record["serving_mixed_int8"] = serving_mixed_q
+    if serving_prefix is not None:
+        record["serving_prefix"] = serving_prefix
     if headline is not None:
         record.update({
             "value": round(mfu, 4),

@@ -62,7 +62,8 @@ class GenerationService:
                  request_deadline_s: float | None = None,
                  prefill_bucket: int = 1,
                  prefill_chunk: int | None = None,
-                 pipeline_decode: bool = True):
+                 pipeline_decode: bool = True,
+                 prefix_cache_blocks: int | None = None):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -90,6 +91,9 @@ class GenerationService:
         self.prefill_bucket = prefill_bucket
         self.prefill_chunk = prefill_chunk
         self.pipeline_decode = pipeline_decode
+        # automatic prefix caching (serving/prefix_cache.py): HBM budget
+        # in blocks; 0 disables, None keeps the engine default
+        self.prefix_cache_blocks = prefix_cache_blocks
         # the lock now guards only the legacy one-shot paths (beam search,
         # scoring, PLD); standard generation goes through the engine
         self.lock = threading.Lock()
@@ -105,6 +109,9 @@ class GenerationService:
             if self._engine is None:
                 from ..serving import EngineConfig, ServingEngine
 
+                extra = {}
+                if self.prefix_cache_blocks is not None:
+                    extra["prefix_cache_blocks"] = self.prefix_cache_blocks
                 self._engine = ServingEngine(
                     self.cfg, self.params,
                     EngineConfig(max_batch_size=self.max_batch_size,
@@ -114,7 +121,8 @@ class GenerationService:
                                  default_deadline_s=self.request_deadline_s,
                                  prefill_bucket=self.prefill_bucket,
                                  prefill_chunk=self.prefill_chunk,
-                                 pipeline_decode=self.pipeline_decode))
+                                 pipeline_decode=self.pipeline_decode,
+                                 **extra))
             return self._engine
 
     def metrics_snapshot(self) -> dict:

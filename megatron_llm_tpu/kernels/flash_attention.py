@@ -34,6 +34,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 exposes the TPU compiler params under the old name
+_CompilerParams = (getattr(pltpu, "CompilerParams", None)
+                   or pltpu.TPUCompilerParams)
+
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free
 
 
@@ -194,7 +198,7 @@ def _fwd(cfg: _Config, q, k, v, q_seg, k_seg):
             pltpu.VMEM((cfg.block_q, 128), jnp.float32),
             pltpu.VMEM((cfg.block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),
@@ -355,7 +359,7 @@ def _bwd_impl(cfg: _Config, q, k, v, o, lse, do, q_seg, k_seg):
         out_specs=pl.BlockSpec((1, 1, cfg.block_q, d), qmap),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((cfg.block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),
@@ -404,7 +408,7 @@ def _bwd_impl(cfg: _Config, q, k, v, o, lse, do, q_seg, k_seg):
             pltpu.VMEM((cfg.block_k, d), jnp.float32),
             pltpu.VMEM((cfg.block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),

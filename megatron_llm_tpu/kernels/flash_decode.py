@@ -22,6 +22,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 exposes the TPU compiler params under the old name
+_CompilerParams = (getattr(pltpu, "CompilerParams", None)
+                   or pltpu.TPUCompilerParams)
+
 NEG_INF = -1e30
 
 
@@ -166,7 +170,7 @@ def _decode_call(kernel_fn, q, caches, cache_len, softmax_scale,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, kv_heads, g_pad, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

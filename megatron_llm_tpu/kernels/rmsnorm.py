@@ -25,6 +25,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 exposes the TPU compiler params under the old name
+_CompilerParams = (getattr(pltpu, "CompilerParams", None)
+                   or pltpu.TPUCompilerParams)
+
 
 def _default_interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
@@ -130,7 +134,7 @@ def _row_call(kernel, n_out, rows_p, hidden, br, dtypes, operands, interpret):
         in_specs=specs,
         out_specs=out_specs if n_out > 1 else out_specs[0],
         out_shape=out_shape if n_out > 1 else out_shape[0],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",),
         ),
         interpret=interpret,

@@ -324,6 +324,37 @@ def cache_slot_read(cache, slot):
         lambda a: jax.lax.dynamic_slice_in_dim(a, slot, 1, axis=1), cache)
 
 
+def cache_slot_copy(dst_cache, src_cache, dst_slot, dst_pos, src_slot,
+                    src_pos, length: int):
+    """Copy ``length`` sequence rows of K/V from one cache's batch slot
+    into another's, at arbitrary (and possibly different) row offsets.
+
+    The prefix cache (serving/prefix_cache.py) uses this to splice cached
+    shared-prefix blocks into a fresh admission cache before the suffix
+    prefill runs.  Every leaf carries the sequence on axis 3 of the
+    ``[L, b, kv_heads, max_len(, d)]`` layout — true for the plain array
+    cache AND both leaves of the int8 ``{"q", "scale"}`` pytree
+    (ops/kv_quant.py), so quantized rows move verbatim: the {q, scale}
+    pair is copied bit-identical, never dequantized.  ``length`` must be
+    static (it fixes the slice shape); positions/slots may be traced.
+    """
+    dst_slot = jnp.asarray(dst_slot, jnp.int32)
+    src_slot = jnp.asarray(src_slot, jnp.int32)
+    dst_pos = jnp.asarray(dst_pos, jnp.int32)
+    src_pos = jnp.asarray(src_pos, jnp.int32)
+
+    def cp(dst, src):
+        zeros = (jnp.int32(0),) * (src.ndim - 4)
+        rows = jax.lax.dynamic_slice(
+            src, (jnp.int32(0), src_slot, jnp.int32(0), src_pos) + zeros,
+            (src.shape[0], 1, src.shape[2], length) + tuple(src.shape[4:]))
+        return jax.lax.dynamic_update_slice(
+            dst, rows.astype(dst.dtype),
+            (jnp.int32(0), dst_slot, jnp.int32(0), dst_pos) + zeros)
+
+    return jax.tree.map(cp, dst_cache, src_cache)
+
+
 def num_params(params: Params) -> int:
     return sum(p.size for p in jax.tree.leaves(params))
 

@@ -4,6 +4,8 @@
   retirement, per-request streaming and cancellation.
 - ``slots.py`` — KV-slot allocator over one long-lived fixed-shape cache.
 - ``queue.py`` — bounded admission queue with backpressure (``QueueFull``).
+- ``prefix_cache.py`` — automatic prefix caching: block-granular radix
+  cache of shared-prefix K/V consulted at admission, fed at retirement.
 - ``metrics.py`` — serving counters / gauges / latency histograms.
 - ``bench.py`` — serving-throughput measurement (requests/s, token
   latency), consumed by the repo-level ``bench.py``.
@@ -16,6 +18,7 @@ from .engine import (
     ServingEngine,
 )
 from .metrics import LatencyHistogram, ServingMetrics
+from .prefix_cache import PrefixCache, PrefixLease
 from .queue import QueueFull, RequestQueue
 from .slots import SlotAllocator
 
@@ -23,6 +26,8 @@ __all__ = [
     "EngineConfig",
     "FinishedRequest",
     "LatencyHistogram",
+    "PrefixCache",
+    "PrefixLease",
     "QueueFull",
     "RequestHandle",
     "RequestQueue",

@@ -52,6 +52,15 @@ decode step itself runs as the fused whole-stack Pallas kernel
 (kernels/decode_step.py) with a per-slot fill vector — see
 models/model.py:forward_cached, which routes it automatically.
 
+Admission also consults the **automatic prefix cache**
+(``EngineConfig.prefix_cache_blocks``, prefix_cache.py): a request whose
+prompt shares a block-aligned prefix with an earlier request's gets the
+cached K/V rows spliced into its admission cache and prefills only the
+uncached suffix; retiring requests donate their prefix blocks back.
+Because the spliced rows are exactly what a cold prefill would write,
+the cache is purely a prefill shortcut — TTFT drops, trajectories don't
+move.
+
 Greedy requests reproduce the one-shot ``generation.generate_tokens``
 trajectory token-for-token (tested bitwise on CPU fp32, the same
 equivalence bar the PLD path meets), pipelined or not.
@@ -74,6 +83,7 @@ from ..config import ModelConfig
 from ..generation.sampling import NEG_INF
 from ..models import model as model_lib
 from .metrics import ServingMetrics
+from .prefix_cache import PrefixCache
 from .queue import QueueFull, RequestQueue  # noqa: F401  (re-exported)
 from .slots import SlotAllocator
 
@@ -111,6 +121,15 @@ class EngineConfig:
     #                               deadline.  Expired requests finish with
     #                               reason "timeout" instead of occupying a
     #                               slot / queue position forever.
+    prefix_cache_blocks: int = 256  # automatic prefix caching
+    #                               (serving/prefix_cache.py): HBM budget in
+    #                               blocks of prefill_chunk (chunked mode)
+    #                               or prefill_bucket tokens each.  Shared
+    #                               block-aligned prompt prefixes skip
+    #                               re-prefill on admission; retiring
+    #                               requests donate theirs back.  Bitwise
+    #                               neutral to sampled trajectories.
+    #                               0 disables the cache.
 
 
 @dataclasses.dataclass
@@ -363,6 +382,8 @@ class _SlotState:
         #                           RNG fold counter of the NEXT sample
         self.pending = pending    # host-known last sampled token
         self.fresh = True         # pending must override the device vector
+        self.lease = None         # PrefixLease pinning this request's
+        #                           cached prefix blocks until retirement
 
 
 class _Inflight:
@@ -393,9 +414,12 @@ class _PrefillState:
         self.padded = padded      # total prompt rows to prefill (chunk-
         #                           padded; the tail rows hold pad-token
         #                           K/V masked by the slot's fill level)
-        self.done = 0             # prompt rows prefilled so far
+        self.done = 0             # prompt rows prefilled so far (a prefix
+        #                           hit pre-advances this past the cached
+        #                           blocks already spliced into k_small)
         self.k_small = None       # batch-1 cache, created on chunk 0
         self.v_small = None
+        self.lease = None         # PrefixLease behind a pre-advanced done
 
 
 class ServingEngine:
@@ -420,6 +444,7 @@ class ServingEngine:
         self.queue = RequestQueue(self.config.max_queue_size,
                                   self.config.retry_after_s)
         self.slots: Optional[SlotAllocator] = None  # allocated on start
+        self.prefix_cache: Optional[PrefixCache] = None  # built on start
         self._active: dict[int, _SlotState] = {}    # slot -> state
         self._decode = (_decode_plain if jax.default_backend() == "cpu"
                         else _decode_donated)
@@ -455,6 +480,17 @@ class ServingEngine:
                 self.slots = SlotAllocator(self.cfg,
                                            self.config.max_batch_size,
                                            self.config.max_seq_len)
+                if self.config.prefix_cache_blocks:
+                    # block size follows the admission granularity so hit
+                    # suffixes reuse the cold path's compiled shapes
+                    block = int(self.config.prefill_chunk
+                                or max(1, self.config.prefill_bucket))
+                    self.prefix_cache = PrefixCache(
+                        self.cfg,
+                        block_tokens=min(block, self.config.max_seq_len),
+                        max_blocks=self.config.prefix_cache_blocks,
+                        max_seq_len=self.config.max_seq_len,
+                        metrics=lambda: self.metrics)
                 from ..kernels.decode_step import fused_decode_eligible
                 self._fused_decode = fused_decode_eligible(
                     self.cfg, self.params, self.slots.k_cache, 1,
@@ -649,6 +685,9 @@ class ServingEngine:
 
     def _abort_prefill(self, reason: str) -> None:
         ps, self._prefilling = self._prefilling, None
+        if self.prefix_cache is not None:
+            # unpin without offering: the slot holds a partial prefill
+            self.prefix_cache.release(ps.lease)
         self.slots.release(ps.slot)
         self._finish(ps.req, reason)
         self.metrics.set_gauges(slots_active=self.slots.active_slots)
@@ -716,7 +755,19 @@ class ServingEngine:
                                  self.config.max_seq_len)
                     slot = self.slots.alloc()
                     assert slot is not None
-                    self._prefilling = _PrefillState(req, slot, padded)
+                    ps = _PrefillState(req, slot, padded)
+                    if self.prefix_cache is not None:
+                        lease = self.prefix_cache.match_and_acquire(
+                            req.prompt)
+                        if lease is not None:
+                            # prefix hit: the cached blocks (block size ==
+                            # chunk) land pre-spliced and the chunk cursor
+                            # starts past them; only the suffix chunks run
+                            ps.lease = lease
+                            ps.done = lease.tokens
+                            ps.k_small, ps.v_small = (
+                                self.prefix_cache.assemble(lease))
+                    self._prefilling = ps
         if self._prefilling is not None:
             self._advance_prefill()
         self.metrics.set_gauges(slots_active=self.slots.active_slots,
@@ -766,8 +817,9 @@ class ServingEngine:
         t.stop()
         self.metrics.inc("admitted")
         self.metrics.inc("prefills")
-        self._active[ps.slot] = _SlotState(req, fill=len(req.prompt),
-                                           pending=first_tok)
+        st = _SlotState(req, fill=len(req.prompt), pending=first_tok)
+        st.lease = ps.lease
+        self._active[ps.slot] = st
         self._commit_token(ps.slot, first_tok, float(np.asarray(tok_lp)[0]))
 
     def _prefill_into_slot(self, req: _Request) -> None:
@@ -777,19 +829,44 @@ class ServingEngine:
         t.start()
         plen = len(req.prompt)
         bucket = max(1, self.config.prefill_bucket)
-        padded = -(-plen // bucket) * bucket
-        padded = min(padded, self.config.max_seq_len)
-        tokens = np.zeros((1, padded), np.int32)
-        tokens[0, :plen] = req.prompt
-        last_logits, picked, k_small, v_small = _prefill_impl(
-            self.cfg, self.params, jnp.asarray(tokens),
-            jnp.asarray([plen], jnp.int32),
-            max_seq_len=self.config.max_seq_len,
-            want_logprobs=req.return_logprobs)
+        # prompt-logprob requests need every prompt logit in one pass, so
+        # they always take the cold whole-prompt prefill
+        lease = None
+        if self.prefix_cache is not None and not req.return_logprobs:
+            lease = self.prefix_cache.match_and_acquire(req.prompt)
+        if lease is not None:
+            # prefix hit: splice the cached blocks into a fresh batch-1
+            # cache and prefill only the uncached suffix.  The spliced
+            # rows are the ones a cold prefill would have written, so the
+            # logits at the prompt's last token — and every sampled token
+            # after — are bitwise identical (prefix_cache.py)
+            matched = lease.tokens
+            k_small, v_small = self.prefix_cache.assemble(lease)
+            suffix = plen - matched
+            width = min(-(-suffix // bucket) * bucket,
+                        self.config.max_seq_len - matched)
+            tokens = np.zeros((1, width), np.int32)
+            tokens[0, :suffix] = req.prompt[matched:]
+            last_logits, k_small, v_small = self._prefill_chunk_fn(
+                self.cfg, self.params, jnp.asarray(tokens),
+                jnp.int32(matched),
+                jnp.asarray([suffix - 1], jnp.int32), k_small, v_small,
+                max_seq_len=self.config.max_seq_len, first=False,
+                last=True)
+        else:
+            padded = -(-plen // bucket) * bucket
+            padded = min(padded, self.config.max_seq_len)
+            tokens = np.zeros((1, padded), np.int32)
+            tokens[0, :plen] = req.prompt
+            last_logits, picked, k_small, v_small = _prefill_impl(
+                self.cfg, self.params, jnp.asarray(tokens),
+                jnp.asarray([plen], jnp.int32),
+                max_seq_len=self.config.max_seq_len,
+                want_logprobs=req.return_logprobs)
+            if req.return_logprobs:
+                req.logprobs.extend(
+                    np.asarray(picked)[0, :plen - 1].tolist())
         self.slots.insert(slot, k_small, v_small)
-        if req.return_logprobs:
-            req.logprobs.extend(
-                np.asarray(picked)[0, :plen - 1].tolist())
 
         # first generated token: same per-request sampling rule as decode
         tok, tok_lp = _first_token_impl(
@@ -805,7 +882,9 @@ class ServingEngine:
         self.metrics.inc("admitted")
         self.metrics.inc("prefills")
 
-        self._active[slot] = _SlotState(req, fill=plen, pending=first)
+        st = _SlotState(req, fill=plen, pending=first)
+        st.lease = lease
+        self._active[slot] = st
         self._commit_token(slot, first, float(np.asarray(tok_lp)[0]))
 
     def _step(self) -> None:
@@ -960,6 +1039,15 @@ class ServingEngine:
 
     def _retire(self, slot: int, reason: str) -> None:
         st = self._active.pop(slot)
+        if self.prefix_cache is not None:
+            # donate the slot's block-aligned prompt prefix back before
+            # the slot can be reused, then unpin the admission lease (so
+            # the request's own prefix blocks were protected throughout)
+            self.prefix_cache.offer(st.req.prompt, self.slots.k_cache,
+                                    self.slots.v_cache, slot)
+            self.prefix_cache.release(st.lease)
+            self.metrics.set_gauges(
+                prefix_blocks=self.prefix_cache.blocks)
         self.slots.release(slot)
         self._finish(st.req, reason)
         self.metrics.set_gauges(slots_active=self.slots.active_slots)

@@ -72,6 +72,12 @@ _COUNTERS = (
     # An int8 config silently losing eligibility shows up here as
     # fallback_steps climbing where fused_steps should.
     "fused_steps", "fallback_steps",
+    # automatic prefix caching (serving/prefix_cache.py): admissions that
+    # reused cached shared-prefix K/V vs prefilled cold, and blocks LRU-
+    # evicted under the prefix_cache_blocks budget.  A workload expected
+    # to share system prompts but showing prefix_misses climbing means
+    # prompts diverge inside the first block (check block alignment).
+    "prefix_hits", "prefix_misses", "prefix_evicted_blocks",
 )
 
 
@@ -100,6 +106,10 @@ class ServingMetrics:
         self.device_step = LatencyHistogram()
         self.sched_host = LatencyHistogram()
         self.device_idle_frac: Optional[float] = None
+        # tokens served from the prefix cache per hit (the reservoir is
+        # generic; samples here are token counts, not seconds)
+        self.prefix_hit_tokens = LatencyHistogram()
+        self.prefix_blocks = 0   # gauge: blocks resident in the cache
         self.timers = Timers(log_level=2)
 
     def inc(self, name: str, by: int = 1) -> None:
@@ -107,12 +117,15 @@ class ServingMetrics:
             self.counters[name] += by
 
     def set_gauges(self, *, slots_active: Optional[int] = None,
-                   queue_depth: Optional[int] = None) -> None:
+                   queue_depth: Optional[int] = None,
+                   prefix_blocks: Optional[int] = None) -> None:
         with self._lock:
             if slots_active is not None:
                 self.slots_active = slots_active
             if queue_depth is not None:
                 self.queue_depth = queue_depth
+            if prefix_blocks is not None:
+                self.prefix_blocks = prefix_blocks
 
     def observe_decode_iteration(self, batch: int, seconds: float) -> None:
         """One scheduler decode step over ``batch`` active slots."""
@@ -137,6 +150,11 @@ class ServingMetrics:
                 self.device_idle_frac = (
                     gap_frac if self.device_idle_frac is None
                     else 0.9 * self.device_idle_frac + 0.1 * gap_frac)
+
+    def observe_prefix_hit_tokens(self, tokens: int) -> None:
+        """Tokens whose prefill one prefix-cache hit skipped."""
+        with self._lock:
+            self.prefix_hit_tokens.observe(float(tokens))
 
     def observe_ttft(self, seconds: float) -> None:
         with self._lock:
@@ -165,6 +183,18 @@ class ServingMetrics:
                 "device_idle_frac": (self.device_idle_frac
                                      if self.device_idle_frac is not None
                                      else 0.0),
+                # prefix cache (the histogram samples are token counts)
+                "prefix_hit_rate": (
+                    self.counters["prefix_hits"]
+                    / max(1, self.counters["prefix_hits"]
+                          + self.counters["prefix_misses"])),
+                "prefix_blocks": self.prefix_blocks,
+                "prefix_hit_tokens": {
+                    "count": self.prefix_hit_tokens.count,
+                    "mean": self.prefix_hit_tokens.mean(),
+                    "p50": self.prefix_hit_tokens.percentile(50),
+                    "p99": self.prefix_hit_tokens.percentile(99),
+                },
             })
             return out
 
@@ -183,6 +213,12 @@ class ServingMetrics:
                           snap["max_decode_batch"], iteration)
         writer.add_scalar("serving/device_idle_frac",
                           snap["device_idle_frac"], iteration)
+        writer.add_scalar("serving/prefix_hit_rate",
+                          snap["prefix_hit_rate"], iteration)
+        writer.add_scalar("serving/prefix_blocks",
+                          snap["prefix_blocks"], iteration)
+        writer.add_scalar("serving/prefix_hit_tokens_mean",
+                          snap["prefix_hit_tokens"]["mean"], iteration)
         for hist, key in ((self.ttft, "ttft"),
                           (self.per_token, "per_token_latency"),
                           (self.e2e, "e2e_latency"),

@@ -1,0 +1,298 @@
+"""Automatic prefix caching: shared-prefix KV reuse across requests.
+
+Every admission used to recompute its prompt from token 0 even when the
+first few hundred tokens were the same system prompt every other request
+carried — and BENCH_r05 puts long-prompt prefill at 0.174 MFU, so that
+recompute dominates TTFT for exactly the traffic the engine targets.
+This module is the RadixAttention / vLLM-automatic-prefix-caching idea
+adapted to the fixed-slot TPU cache: a host-side trie over **block
+aligned** token-id prefixes whose nodes own device-resident K/V rows,
+consulted at admission and fed at retirement.
+
+Block granularity.  A node holds exactly ``block_tokens`` sequence rows
+(one per side) shaped ``[L, 1, kv_heads, block, ...]``.  The engine picks
+``block_tokens = prefill_chunk`` when chunked admission is on (so a hit
+just advances the chunk cursor and suffix chunks keep the one compiled
+chunk width) and ``prefill_bucket`` otherwise (so suffix padding keeps
+the same bounded set of compiled prefill shapes the cold path has).
+RoPE is applied at a token's absolute position before K enters the
+cache, and a prefix occupies the same absolute positions in every
+sequence that shares it — cached rows are valid verbatim, no re-rotation.
+
+Admission (``match_and_acquire`` + ``assemble``).  The longest cached
+block-aligned prefix STRICTLY shorter than the prompt is matched (at
+least one real token must run through the suffix prefill to produce the
+logits the first sampled token needs).  Matched nodes are **ref-count
+pinned** for the life of the request, then their rows are spliced into a
+fresh batch-1 admission cache in ONE fused dispatch
+(concatenate-and-pad; per-dispatch tunnel latency, not row traffic, is
+the marginal cost) — for int8 caches the {q, scale} pair moves
+verbatim, so quantized rows stay bit-identical to the rows the donor
+request wrote.  The engine then prefills only the uncached suffix.
+Because prefill writes the exact same K/V rows the cache returns,
+sampling, logprobs, and the pipelined decode path are bitwise identical
+to a cold admission (asserted against ``generate_tokens`` in
+tests/serving/test_prefix_cache.py, fp32 + int8).
+(``models/model.py:cache_slot_copy`` is the general slot-to-slot row
+splice of the same shape family, kept as the model-level primitive.)
+
+Retirement (``offer``).  The slot's block-aligned prompt prefix is
+walked into the trie; blocks already present are LRU-touched, missing
+ones — always one contiguous tail of the walk — are extracted from the
+big batch cache in one device dispatch (a gather of rows the decode
+loop never overwrites: decode appends at fill >= plen).
+
+Eviction.  A soft HBM budget of ``max_blocks`` blocks: when an offer
+pushes past it, least-recently-used nodes with ``ref == 0`` and no
+children are dropped (evicting a middle node would orphan its
+descendants' match path).  Pinned chains can transiently exceed the
+budget — correctness over strict accounting — and get trimmed on the
+next release/offer.
+
+Host cost is O(prompt/block) dict lookups per admission; all row traffic
+stays on device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from .metrics import ServingMetrics
+
+
+@functools.partial(jax.jit, static_argnames=("n_blocks", "block"))
+def _read_blocks(cache, slot, pos, *, n_blocks: int, block: int):
+    """Extract ``n_blocks`` consecutive ``block``-row blocks of batch row
+    ``slot`` starting at sequence position ``pos``, as a tuple of batch-1
+    block pytrees (every leaf: seq axis 3 of [L, b, kv, max_len(, d)]).
+    ONE dispatch regardless of block count — per-dispatch latency through
+    the device tunnel (~1 ms) is the dominant cost at serving scale, not
+    the row traffic."""
+    slot = jnp.asarray(slot, jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
+
+    def rd(a):
+        zeros = (jnp.int32(0),) * (a.ndim - 4)
+        return jax.lax.dynamic_slice(
+            a, (jnp.int32(0), slot, jnp.int32(0), pos) + zeros,
+            (a.shape[0], 1, a.shape[2], n_blocks * block)
+            + tuple(a.shape[4:]))
+
+    rows = jax.tree.map(rd, cache)
+    return tuple(
+        jax.tree.map(lambda a: a[:, :, :, i * block:(i + 1) * block], rows)
+        for i in range(n_blocks))
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def _assemble_impl(*blocks, max_len: int):
+    """Concatenate a lease's blocks along the sequence axis and pad out
+    to a full ``max_len``-wide batch-1 admission cache — again ONE
+    dispatch per hit (one compiled executable per distinct block count;
+    counts are small and recur).  ``jnp.pad`` zeros match
+    ``init_kv_cache``'s zero fill, so the assembled cache is bit-equal
+    to a cold admission cache after its prefix prefill."""
+    def cat(*leaves):
+        full = jnp.concatenate(leaves, axis=3)
+        pad = [(0, 0)] * full.ndim
+        pad[3] = (0, max_len - full.shape[3])
+        return jnp.pad(full, pad)
+
+    return jax.tree.map(cat, *blocks)
+
+
+class _Node:
+    """One cached block: ``key`` is its block_tokens token ids, ``kv``
+    its device-resident (k_rows, v_rows) pair."""
+
+    __slots__ = ("key", "parent", "children", "kv", "ref", "tick")
+
+    def __init__(self, key: Tuple[int, ...], parent: "_Node"):
+        self.key = key
+        self.parent = parent
+        self.children: dict = {}
+        self.kv = None
+        self.ref = 0        # live leases pinning this block
+        self.tick = 0       # LRU clock at last touch
+
+
+class PrefixLease:
+    """A matched chain of blocks, pinned against eviction until
+    ``PrefixCache.release``.  ``tokens`` is the matched prefix length."""
+
+    __slots__ = ("nodes", "tokens")
+
+    def __init__(self, nodes: List[_Node], tokens: int):
+        self.nodes = nodes
+        self.tokens = tokens
+
+
+class PrefixCache:
+    """Block-granular radix cache over token-id prefixes (module doc)."""
+
+    def __init__(self, cfg: ModelConfig, *, block_tokens: int,
+                 max_blocks: int, max_seq_len: int,
+                 metrics: Union[ServingMetrics, Callable, None] = None):
+        assert block_tokens >= 1 and max_blocks >= 1
+        self.cfg = cfg
+        self.block_tokens = int(block_tokens)
+        self.max_blocks = int(max_blocks)
+        self.max_seq_len = int(max_seq_len)
+        # the engine replaces its metrics object between warmup and
+        # measurement (serving/bench.py), so accept a zero-arg callable
+        # resolved at use time rather than capturing one registry forever
+        self._metrics = metrics
+        self._root = _Node((), None)
+        self._blocks = 0
+        self._tick = 0
+        self._zero_block = None  # lazy zeros block, pads assemble's arity
+
+    @property
+    def blocks(self) -> int:
+        """Blocks currently resident (tooling / budget introspection)."""
+        return self._blocks
+
+    def _m(self) -> Optional[ServingMetrics]:
+        m = self._metrics
+        return m() if callable(m) else m
+
+    def _touch(self, node: _Node) -> None:
+        self._tick += 1
+        node.tick = self._tick
+
+    def _keys(self, tokens: Sequence[int], n_blocks: int):
+        b = self.block_tokens
+        for i in range(n_blocks):
+            yield tuple(int(t) for t in tokens[i * b:(i + 1) * b])
+
+    # -- admission side ----------------------------------------------------
+
+    def match_and_acquire(self,
+                          tokens: Sequence[int]) -> Optional[PrefixLease]:
+        """Pin and return the longest cached block-aligned prefix of
+        ``tokens`` that is strictly shorter than it, or None on a miss.
+
+        The strict cap — at most ``(len - 1) // block`` blocks — leaves
+        >= 1 real token for the suffix prefill, whose last-row logits
+        seed the first sampled token exactly as a cold prefill's would.
+        """
+        usable = (len(tokens) - 1) // self.block_tokens
+        nodes: List[_Node] = []
+        cur = self._root
+        for key in self._keys(tokens, usable):
+            child = cur.children.get(key)
+            if child is None:
+                break
+            nodes.append(child)
+            cur = child
+        m = self._m()
+        if not nodes:
+            if m is not None:
+                m.inc("prefix_misses")
+            return None
+        for n in nodes:
+            n.ref += 1
+            self._touch(n)
+        matched = len(nodes) * self.block_tokens
+        if m is not None:
+            m.inc("prefix_hits")
+            m.observe_prefix_hit_tokens(matched)
+        return PrefixLease(nodes, matched)
+
+    def assemble(self, lease: PrefixLease):
+        """Materialize a lease as a fresh batch-1 admission cache
+        ``[L, 1, kv, max_seq_len, ...]`` with the leased rows spliced in
+        — one fused device dispatch (int8 {q, scale} blocks land
+        bit-identical; concatenation never dequantizes).  The block list
+        pads to a FIXED arity with a shared zeros block so every hit,
+        whatever its matched length, runs the one compiled executable
+        (zeros beyond the match equal ``init_kv_cache``'s fill)."""
+        blocks = [n.kv for n in lease.nodes]
+        if self._zero_block is None:
+            self._zero_block = jax.tree.map(jnp.zeros_like, blocks[0])
+        n_total = self.max_seq_len // self.block_tokens
+        blocks.extend([self._zero_block] * (n_total - len(blocks)))
+        return _assemble_impl(*blocks, max_len=self.max_seq_len)
+
+    def release(self, lease: Optional[PrefixLease]) -> None:
+        """Unpin a lease (request retired or aborted); then trim any
+        over-budget blocks the pin was protecting."""
+        if lease is None:
+            return
+        nodes, lease.nodes = lease.nodes, []  # idempotent
+        for n in nodes:
+            n.ref -= 1
+        if nodes:
+            self._evict()
+
+    # -- retirement side ---------------------------------------------------
+
+    def offer(self, tokens: Sequence[int], k_cache, v_cache,
+              slot: int) -> int:
+        """Insert the block-aligned prefix of ``tokens`` from batch row
+        ``slot`` of the engine's big cache.  Blocks already cached are
+        LRU-touched; missing ones are extracted device-side.  Returns the
+        number of newly inserted blocks."""
+        n_blocks = len(tokens) // self.block_tokens
+        keys = list(self._keys(tokens, n_blocks))
+        # Walk the existing chain first.  A missing block can only be
+        # followed by missing blocks (a node's descendants exist only
+        # under a present node), so the blocks to extract are one
+        # contiguous tail — read them in a single fused dispatch.
+        cur = self._root
+        first_missing = n_blocks
+        for i, key in enumerate(keys):
+            child = cur.children.get(key)
+            if child is None:
+                first_missing = i
+                break
+            self._touch(child)
+            cur = child
+        added = n_blocks - first_missing
+        if added:
+            blocks = _read_blocks(
+                (k_cache, v_cache), slot,
+                first_missing * self.block_tokens,
+                n_blocks=added, block=self.block_tokens)
+            for key, kv in zip(keys[first_missing:], blocks):
+                child = _Node(key, cur)
+                child.kv = kv
+                cur.children[key] = child
+                self._touch(child)
+                self._blocks += 1
+                cur = child
+            self._evict()
+        return added
+
+    # -- eviction ----------------------------------------------------------
+
+    def _evict(self) -> int:
+        """LRU-evict unpinned childless blocks until within budget (or
+        everything left over budget is pinned — soft budget)."""
+        evicted = 0
+        while self._blocks > self.max_blocks:
+            victim = None
+            stack = list(self._root.children.values())
+            while stack:
+                n = stack.pop()
+                if (n.ref == 0 and not n.children
+                        and (victim is None or n.tick < victim.tick)):
+                    victim = n
+                stack.extend(n.children.values())
+            if victim is None:
+                break
+            del victim.parent.children[victim.key]
+            victim.kv = None     # drop the device buffers now
+            victim.parent = None
+            self._blocks -= 1
+            evicted += 1
+        if evicted:
+            m = self._m()
+            if m is not None:
+                m.inc("prefix_evicted_blocks", by=evicted)
+        return evicted

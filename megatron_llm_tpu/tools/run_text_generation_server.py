@@ -13,6 +13,36 @@ from __future__ import annotations
 import argparse
 
 
+def _start_metrics_logger(service, interval_s: float):
+    """Daemon thread printing a one-line JSON serving summary every
+    ``interval_s`` — the operational counters (queue/slots/tokens) plus
+    the prefix-cache hit rate, without scraping GET /metrics."""
+    import json
+    import threading
+    import time
+
+    def loop():
+        while True:
+            time.sleep(interval_s)
+            snap = service.metrics_snapshot()
+            print(json.dumps({"serving_metrics": {
+                "completed": snap["completed"],
+                "running": snap["running"],
+                "queued": snap["queued"],
+                "decode_tokens": snap["decode_tokens"],
+                "ttft_p50_s": round(snap["ttft"]["p50_s"], 4),
+                "prefix_hits": snap["prefix_hits"],
+                "prefix_misses": snap["prefix_misses"],
+                "prefix_hit_rate": round(snap["prefix_hit_rate"], 4),
+                "prefix_blocks": snap["prefix_blocks"],
+            }}), flush=True)
+
+    t = threading.Thread(target=loop, name="serving-metrics-log",
+                         daemon=True)
+    t.start()
+    return t
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--load", required=True, help="checkpoint directory")
@@ -49,6 +79,20 @@ def main(argv=None) -> int:
     ap.add_argument("--no_pipeline_decode", action="store_true",
                     help="disable the one-step pipelined decode loop "
                          "(diagnostic; docs/serving.md fast path)")
+    ap.add_argument("--prefix_cache_blocks", type=int, default=256,
+                    help="automatic prefix caching HBM budget, in blocks "
+                         "of --prefill_chunk (or --prefill_bucket) tokens "
+                         "each: requests sharing a block-aligned prompt "
+                         "prefix (system prompts, few-shot templates) "
+                         "reuse cached K/V instead of re-prefilling "
+                         "(docs/serving.md, 'Prefix caching'); sampled "
+                         "tokens are bitwise unaffected")
+    ap.add_argument("--no_prefix_cache", action="store_true",
+                    help="disable automatic prefix caching (diagnostic)")
+    ap.add_argument("--metrics_interval_s", type=float, default=60.0,
+                    help="periodically print a one-line JSON serving-"
+                         "metrics summary (prefix-cache hit rate "
+                         "included) to stdout; 0 disables")
     ap.add_argument("--retry_after_s", type=float, default=1.0,
                     help="Retry-After hint returned with 503 backpressure")
     ap.add_argument("--request_deadline_s", type=float, default=None,
@@ -123,6 +167,7 @@ def main(argv=None) -> int:
 
     from ..generation.server import MegatronServer
 
+    prefix_blocks = 0 if args.no_prefix_cache else args.prefix_cache_blocks
     server = MegatronServer(
         lm.cfg, params, tokenizer,
         max_batch_size=args.max_batch_size,
@@ -134,7 +179,17 @@ def main(argv=None) -> int:
         request_deadline_s=args.request_deadline_s,
         prefill_bucket=args.prefill_bucket,
         prefill_chunk=args.prefill_chunk,
-        pipeline_decode=not args.no_pipeline_decode)
+        pipeline_decode=not args.no_pipeline_decode,
+        prefix_cache_blocks=prefix_blocks)
+    if prefix_blocks:
+        block_tokens = args.prefill_chunk or max(1, args.prefill_bucket)
+        print(f"prefix cache: {prefix_blocks} blocks x {block_tokens} "
+              f"tokens (budget {prefix_blocks * block_tokens} cached "
+              "prompt tokens; docs/serving.md 'Prefix caching')")
+    else:
+        print("prefix cache: disabled")
+    if args.metrics_interval_s > 0:
+        _start_metrics_logger(server.service, args.metrics_interval_s)
     print(f"serving on {args.host}:{args.port}")
     if mesh_ctx is not None:
         with mesh_ctx:

@@ -51,6 +51,26 @@ def test_counters_gauges_and_decode_stats():
     assert snap["per_token_latency"]["count"] == 5
 
 
+def test_prefix_cache_counters_and_hit_rate():
+    m = ServingMetrics(num_slots=2)
+    snap = m.snapshot()
+    assert snap["prefix_hits"] == 0 and snap["prefix_hit_rate"] == 0.0
+    m.inc("prefix_hits", by=3)
+    m.inc("prefix_misses")
+    m.inc("prefix_evicted_blocks", by=7)
+    m.set_gauges(prefix_blocks=12)
+    for n in (64, 64, 128):
+        m.observe_prefix_hit_tokens(n)
+    snap = m.snapshot()
+    assert snap["prefix_hits"] == 3 and snap["prefix_misses"] == 1
+    assert snap["prefix_hit_rate"] == 0.75
+    assert snap["prefix_evicted_blocks"] == 7
+    assert snap["prefix_blocks"] == 12
+    hist = snap["prefix_hit_tokens"]
+    assert hist["count"] == 3 and hist["mean"] == 256.0 / 3
+    assert hist["p50"] == 64.0
+
+
 def test_write_exports_serving_scalars():
     m = ServingMetrics(num_slots=2)
     m.inc("submitted")
@@ -66,5 +86,8 @@ def test_write_exports_serving_scalars():
     assert w.scalars["serving/slot_occupancy"] == (0.0, 7)
     for key in ("serving/running", "serving/queued",
                 "serving/per_token_latency_p95_s",
-                "serving/e2e_latency_mean_s"):
+                "serving/e2e_latency_mean_s",
+                "serving/prefix_hits", "serving/prefix_misses",
+                "serving/prefix_hit_rate", "serving/prefix_blocks",
+                "serving/prefix_hit_tokens_mean"):
         assert key in w.scalars

@@ -28,6 +28,9 @@ def _record(**overrides):
         "decode_int8_roofline_frac": 0.45,
         "serving_mixed": {"serving_mixed_tokens_per_sec": 900.0,
                           "serving_mixed_ttft_p50_s": 0.12},
+        "serving_prefix": {"serving_prefix_ttft_speedup": 4.0,
+                           "serving_prefix_hit_rate": 1.0,
+                           "serving_prefix_ttft_ms_hit_p50": 3.0},
     }
     rec.update(overrides)
     return rec
@@ -59,6 +62,21 @@ def test_compare_flags_headline_regressions_only():
     assert sorted(regressed) == ["decode_int8_roofline_frac", "mfu"]
     # the serving collapse is reported but does not gate
     assert any("serving_mixed_tokens_per_sec" in l for l in lines)
+
+
+def test_compare_gates_prefix_cache_collapse():
+    """The prefix-cache headline metrics gate: losing the hit-path TTFT
+    speedup (cache silently disabled / always missing) must fail the
+    compare, while hit-path latency jitter alone must not."""
+    cur = _record(serving_prefix={"serving_prefix_ttft_speedup": 1.0,
+                                  "serving_prefix_hit_rate": 0.0,
+                                  "serving_prefix_ttft_ms_hit_p50": 9.0})
+    lines, regressed = bench.compare_records(_record(), cur)
+    assert sorted(regressed) == [
+        "serving_prefix.serving_prefix_hit_rate",
+        "serving_prefix.serving_prefix_ttft_speedup"]
+    # raw hit latency is reported but never gates (machine-load noise)
+    assert any("serving_prefix_ttft_ms_hit_p50" in l for l in lines)
 
 
 def test_compare_within_tolerance_does_not_gate():
