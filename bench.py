@@ -474,7 +474,12 @@ def _serving_mixed_point(quantize: bool = False):
     With ``quantize`` the model serves fully int8-resident (int8 weights
     + int8 KV), the configuration the fused decode kernel's int8 path
     targets — the engine's fused_steps counter tells whether the slot
-    batch actually took it."""
+    batch actually took it.
+
+    The plain (non-int8) point also reruns the identical workload with
+    the span recorder off (trace=False) and stamps the untraced ITL
+    percentiles into the same dict — the traced/untraced pair feeds the
+    --compare tracing-overhead gate (docs/observability.md)."""
     import jax
 
     from megatron_llm_tpu.models import model as model_lib
@@ -491,10 +496,22 @@ def _serving_mixed_point(quantize: bool = False):
         from megatron_llm_tpu.ops.quant import quantize_params
 
         params = quantize_params(params)
-    return run_mixed_serving_bench(cfg, params, num_requests=24,
-                                   gen_len=gen_len, slots=8,
-                                   max_prompt_len=max_prompt_len,
-                                   prefill_chunk=64)
+    out = run_mixed_serving_bench(cfg, params, num_requests=24,
+                                  gen_len=gen_len, slots=8,
+                                  max_prompt_len=max_prompt_len,
+                                  prefill_chunk=64)
+    if not quantize:
+        # same workload, recorder off; jit caches are warm from the
+        # traced run so this pays only its measurement window
+        bare = run_mixed_serving_bench(cfg, params, num_requests=24,
+                                       gen_len=gen_len, slots=8,
+                                       max_prompt_len=max_prompt_len,
+                                       prefill_chunk=64, trace=False)
+        out["serving_mixed_itl_ms_p50_untraced"] = \
+            bare["serving_mixed_itl_ms_p50"]
+        out["serving_mixed_itl_ms_p99_untraced"] = \
+            bare["serving_mixed_itl_ms_p99"]
+    return out
 
 
 def _serving_prefix_point():
@@ -560,17 +577,64 @@ _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
                      "serving_prefix.serving_prefix_ttft_speedup",
                      "serving_prefix.serving_prefix_hit_rate")
 _REGRESSION_TOLERANCE = 0.10
+# Tracing must stay effectively free on the serving hot path: the mixed
+# point's ITL p50 with the span recorder on may exceed the untraced rerun
+# riding in the same record by at most this fraction.
+_TRACE_OVERHEAD_TOLERANCE = 0.10
+
+# Bumped when the record's shape changes (new points / renamed keys) so
+# --compare across old records is interpretable.
+_BENCH_SCHEMA_VERSION = 2
+
+
+def _run_metadata(platform: str, device_count: int) -> dict:
+    """Provenance stamped into the record as ``run_meta``: without the
+    git sha + jax version + device geometry, two BENCH_*.json files a few
+    rounds apart cannot be attributed to code vs toolchain vs topology."""
+    import os
+    import subprocess
+
+    meta = {
+        "schema_version": _BENCH_SCHEMA_VERSION,
+        "device_kind": platform,
+        "device_count": device_count,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            capture_output=True, text=True, timeout=10, cwd=here)
+        if sha.returncode == 0 and sha.stdout.strip():
+            meta["git_sha"] = sha.stdout.strip()
+            dirty = subprocess.run(
+                ["git", "status", "--porcelain"],
+                capture_output=True, text=True, timeout=10, cwd=here)
+            if dirty.returncode == 0 and dirty.stdout.strip():
+                meta["git_dirty"] = True
+    except (OSError, subprocess.TimeoutExpired):
+        pass  # not a git checkout / git missing: record stays attributable
+    try:
+        import importlib.metadata
+
+        meta["jax_version"] = importlib.metadata.version("jax")
+    except Exception:  # noqa: BLE001 — provenance only, never fatal
+        pass
+    return meta
 
 
 def _flatten_metrics(record: dict, prefix: str = "") -> dict:
     """Numeric leaves of a BENCH record as a flat {dotted.name: float}.
     The headline "value" field is renamed "mfu"; lists (the mfu_vs_seq
-    curve) are skipped — their rows move between runs."""
+    curve) are skipped — their rows move between runs — and so is
+    run_meta (provenance, not a measurement; device_count deltas must
+    not read as regressions)."""
     out = {}
     for key, val in record.items():
         name = f"{prefix}{key}"
         if key == "value" and not prefix:
             name = "mfu"
+        if key == "run_meta" and not prefix:
+            continue
         if isinstance(val, bool):
             continue
         if isinstance(val, (int, float)):
@@ -578,6 +642,27 @@ def _flatten_metrics(record: dict, prefix: str = "") -> dict:
         elif isinstance(val, dict):
             out.update(_flatten_metrics(val, prefix=f"{name}."))
     return out
+
+
+def trace_overhead_check(record: dict):
+    """→ (line, ok): the tracing-overhead gate.  The serving_mixed point
+    records ITL p50 with the span recorder on AND off; tracing is only
+    acceptable as an always-on default while the traced number stays
+    within _TRACE_OVERHEAD_TOLERANCE of the untraced one (the --no_trace
+    server flag is the escape hatch if this ever trips)."""
+    sm = record.get("serving_mixed") or {}
+    traced = sm.get("serving_mixed_itl_ms_p50")
+    untraced = sm.get("serving_mixed_itl_ms_p50_untraced")
+    if not traced or not untraced:
+        return ("# trace-overhead gate: skipped "
+                "(no traced/untraced ITL pair in record)"), True
+    overhead = traced / untraced - 1.0
+    ok = traced <= (1.0 + _TRACE_OVERHEAD_TOLERANCE) * untraced
+    line = (f"# trace-overhead gate: serving_mixed_itl_ms_p50 {traced:g} "
+            f"traced vs {untraced:g} untraced ({overhead:+.1%}, limit "
+            f"+{_TRACE_OVERHEAD_TOLERANCE:.0%})"
+            + ("" if ok else "  << REGRESSION"))
+    return line, ok
 
 
 def compare_records(prev: dict, cur: dict):
@@ -625,14 +710,24 @@ def _load_record(path: str) -> dict:
 
 def _run_compare(prev_path: str, cur_record: dict) -> int:
     prev = _load_record(prev_path)
+    for tag, rec in (("prev", prev), ("cur", cur_record)):
+        meta = rec.get("run_meta")
+        if meta:
+            print(f"# {tag} run_meta: {json.dumps(meta, sort_keys=True)}",
+                  flush=True)
     lines, regressed = compare_records(prev, cur_record)
     print(f"# compare vs {prev_path} "
           f"(gate: {', '.join(_HEADLINE_METRICS)} "
           f"> {_REGRESSION_TOLERANCE:.0%} drop):", flush=True)
     for line in lines:
         print("#" + line, flush=True)
-    if regressed:
-        print(f"# REGRESSED: {', '.join(regressed)}", flush=True)
+    trace_line, trace_ok = trace_overhead_check(cur_record)
+    print(trace_line, flush=True)
+    if regressed or not trace_ok:
+        if regressed:
+            print(f"# REGRESSED: {', '.join(regressed)}", flush=True)
+        if not trace_ok:
+            print("# REGRESSED: tracing overhead over limit", flush=True)
         return 1
     print("# no headline regression", flush=True)
     return 0
@@ -722,7 +817,8 @@ def _point(label: str, spec: dict, timeout_s: int = 900):
 
 
 def _detect_device(timeout_s: int = 240):
-    """First device's kind, probed in a SUBPROCESS with a hard timeout.
+    """First device's kind + visible device count, probed in a SUBPROCESS
+    with a hard timeout.
 
     A degraded axon tunnel makes ``jax.devices()`` hang indefinitely
     *inside a C call* — a benchmark that hangs is worse for the driver
@@ -732,7 +828,8 @@ def _detect_device(timeout_s: int = 240):
     try:
         out = subprocess.run(
             [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].device_kind)"],
+             "import jax; ds = jax.devices(); "
+             "print(ds[0].device_kind); print(len(ds))"],
             capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
         raise TimeoutError(
@@ -741,10 +838,12 @@ def _detect_device(timeout_s: int = 240):
     if out.returncode != 0:
         tail = (out.stderr or "").strip().splitlines()[-1:] or ["?"]
         raise RuntimeError(f"device probe failed: {tail[0]}")
-    kind = (out.stdout or "").strip().splitlines()[-1:]
-    if not kind:
+    lines = (out.stdout or "").strip().splitlines()
+    if not lines:
         raise RuntimeError("device probe printed nothing")
-    return kind[0]
+    if len(lines) >= 2 and lines[-1].isdigit():
+        return lines[-2], int(lines[-1])
+    return lines[-1], 1
 
 
 def main() -> None:
@@ -765,7 +864,7 @@ def main() -> None:
                              "[CURRENT.json]")
 
     try:
-        platform = _detect_device()
+        platform, device_count = _detect_device()
     except (TimeoutError, RuntimeError, OSError) as e:
         print(json.dumps({
             "metric": "mfu", "value": None, "unit": "fraction_of_peak",
@@ -857,6 +956,7 @@ def main() -> None:
         "vs_baseline": None,
         "seq_length": 1024,
         "device": platform,
+        "run_meta": _run_metadata(platform, device_count),
         "mfu_vs_seq": curve,
     }
     if decode is not None:

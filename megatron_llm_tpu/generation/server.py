@@ -38,8 +38,11 @@ import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 from ..config import ModelConfig
+from ..obs.logging import EVENT_LOG
+from ..obs.registry import REGISTRY
 from ..tokenizer.tokenizer import Tokenizer
 from .api import (
     beam_search_and_post_process,
@@ -63,7 +66,8 @@ class GenerationService:
                  prefill_bucket: int = 1,
                  prefill_chunk: int | None = None,
                  pipeline_decode: bool = True,
-                 prefix_cache_blocks: int | None = None):
+                 prefix_cache_blocks: int | None = None,
+                 trace: bool = True):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -94,6 +98,9 @@ class GenerationService:
         # automatic prefix caching (serving/prefix_cache.py): HBM budget
         # in blocks; 0 disables, None keeps the engine default
         self.prefix_cache_blocks = prefix_cache_blocks
+        # per-request span tracing (obs/trace.py, GET /trace); the CLI's
+        # --no_trace escape hatch lands here
+        self.trace_enabled = trace
         # the lock now guards only the legacy one-shot paths (beam search,
         # scoring, PLD); standard generation goes through the engine
         self.lock = threading.Lock()
@@ -122,6 +129,7 @@ class GenerationService:
                                  prefill_bucket=self.prefill_bucket,
                                  prefill_chunk=self.prefill_chunk,
                                  pipeline_decode=self.pipeline_decode,
+                                 trace=self.trace_enabled,
                                  **extra))
             return self._engine
 
@@ -134,8 +142,31 @@ class GenerationService:
         if engine is None:
             from ..serving import ServingMetrics
 
-            return ServingMetrics(self.max_batch_size).snapshot()
+            # register=False: a scrape-only throwaway must not displace a
+            # live engine's collector in the shared obs registry
+            return ServingMetrics(self.max_batch_size,
+                                  register=False).snapshot()
         return engine.metrics.snapshot()
+
+    def prometheus_metrics(self) -> str:
+        """Prometheus text exposition of the shared obs registry
+        (GET /metrics?format=prometheus): serving + resilience + training
+        metrics from one scrape."""
+        # the resilience collector registers when ..metrics imports; a
+        # serving-only process would otherwise never pull that module in
+        from .. import metrics as _resilience  # noqa: F401
+
+        return REGISTRY.prometheus_text()
+
+    def trace_snapshot(self) -> dict:
+        """Chrome trace-event JSON of the engine's span ring (GET /trace).
+        An engine that was never created reports an empty trace."""
+        with self._engine_init_lock:
+            engine = self._engine
+        if engine is None:
+            return {"traceEvents": [], "displayTimeUnit": "ms",
+                    "otherData": {"dropped_events": 0}}
+        return engine.trace.chrome_trace()
 
     def drain(self, timeout: float | None = 30.0) -> bool:
         """Stop accepting generation requests and wait for the in-flight
@@ -350,9 +381,13 @@ class GenerationService:
                          "retry_after": int(math.ceil(e.retry_after_s))}
         except ValueError as e:
             return 400, str(e)
+        rids = [h.rid for h in handles]
         try:
             results = [h.result() for h in handles]
         except RuntimeError as e:
+            for rid in rids:
+                EVENT_LOG.emit("server", "http_response", request_id=rid,
+                               status=500)
             return 500, str(e)
 
         texts, segments, lps = [], [], []
@@ -363,11 +398,17 @@ class GenerationService:
             if logprobs:
                 lps.append(r.logprobs)
         resp = {"text": texts, "segments": segments,
-                "logprobs": lps if logprobs else None}
+                "logprobs": lps if logprobs else None,
+                # correlation ids (one per prompt): the same ids every
+                # engine log line and trace span for these prompts carry
+                "request_ids": rids}
         if spec_tag is not None:
             # surface PLD-vs-fallback so clients can see when the
             # requested speculative path did not serve them
             resp["speculative"] = spec_tag
+        for rid, r in zip(rids, results):
+            EVENT_LOG.emit("server", "http_response", request_id=rid,
+                           status=200, finish_reason=r.finish_reason)
         return 200, resp
 
 
@@ -377,13 +418,13 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # quiet by default
         pass
 
-    def _respond(self, status: int, payload):
+    def _respond(self, status: int, payload, ctype: str | None = None):
         if isinstance(payload, str):
             body = payload.encode()
-            ctype = "text/plain"
+            ctype = ctype or "text/plain"
         else:
             body = json.dumps(payload).encode()
-            ctype = "application/json"
+            ctype = ctype or "application/json"
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
@@ -410,12 +451,27 @@ class _Handler(BaseHTTPRequestHandler):
     do_POST = do_PUT  # convenience; the reference accepts PUT only
 
     def do_GET(self):
-        if self.path.rstrip("/") != "/metrics":
-            self._respond(404, "not found")
+        url = urlparse(self.path)
+        route = url.path.rstrip("/")
+        if route == "/metrics":
+            fmt = parse_qs(url.query).get("format", ["json"])[0]
+            if fmt == "prometheus":
+                # the shared obs registry (serving + resilience +
+                # training) in text exposition format
+                self._respond(
+                    200, self.service.prometheus_metrics(),
+                    ctype="text/plain; version=0.0.4; charset=utf-8")
+                return
+            # counters, gauges (incl. the device/host step breakdown), and
+            # latency histograms — see serving/metrics.py:snapshot
+            self._respond(200, self.service.metrics_snapshot())
             return
-        # counters, gauges (incl. the device/host step breakdown), and
-        # latency histograms — see serving/metrics.py:snapshot
-        self._respond(200, self.service.metrics_snapshot())
+        if route == "/trace":
+            # Chrome trace-event JSON of the engine's span ring — load in
+            # chrome://tracing or Perfetto (obs/trace.py)
+            self._respond(200, self.service.trace_snapshot())
+            return
+        self._respond(404, "not found")
 
 
 class MegatronServer:

@@ -11,10 +11,12 @@ the jitted eval step.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+
+from .obs.registry import REGISTRY, MetricFamily
 
 
 class EventCounters:
@@ -52,12 +54,25 @@ class EventCounters:
         for name, value in sorted(self.snapshot().items()):
             writer.add_scalar(f"{prefix}/{name}", value, iteration)
 
+    def collect(self, family: str = "resilience_events_total",
+                help: str = "host-side resilience event counters"
+                ) -> List[MetricFamily]:
+        """obs.REGISTRY collector: one labeled counter family,
+        ``<family>{event="<name>"}``."""
+        fam = MetricFamily(family, "counter", help)
+        for name, value in sorted(self.snapshot().items()):
+            fam.add(value, labels={"event": name})
+        return [fam]
+
 
 # Process-global resilience event stream: checkpoint_saves, io_retries,
 # io_giveups, checkpoint_fallbacks, checkpoint_gc_deleted, anomalies,
 # rollbacks, ... (producers name events freely; docs/robustness.md lists
 # the ones the training stack emits).
 RESILIENCE_EVENTS = EventCounters()
+# Scraped alongside serving/training metrics via the shared obs registry
+# (GET /metrics?format=prometheus).
+REGISTRY.register_collector("resilience", RESILIENCE_EVENTS.collect)
 
 
 class MetricInput:

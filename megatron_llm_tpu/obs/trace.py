@@ -1,0 +1,155 @@
+"""Per-request span tracing with Chrome trace-event export.
+
+A ``TraceRecorder`` is a lock-guarded bounded ring buffer of completed
+spans.  The serving engine records one span per request phase (queued,
+prefix_match, prefill / prefill_chunk[i], decode, retire) and one span
+per scheduler iteration (engine_step, carrying batch size and
+fused/fallback routing as args), so a single stalled chunked-prefill
+admission that aggregate p50s hide shows up as an obvious gap on the
+timeline.
+
+Export is Chrome trace-event JSON (``chrome://tracing`` / Perfetto's
+legacy loader): complete events (``ph="X"``) with microsecond timestamps
+relative to the recorder's creation, ``tid`` = request id so each
+request gets its own track, and ``args.request_id`` for correlation
+with the structured event log.  ``device_annotation`` mirrors the same
+phase names into ``jax.profiler.TraceAnnotation`` so spans line up with
+device profiles captured by the existing driver profiler window.
+
+Overhead discipline: when ``enabled`` is False every record path returns
+before taking the lock or allocating, and the recorder stores compact
+tuples — dict construction is deferred to export time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+_PROFILER_SENTINEL = object()
+_profiler = _PROFILER_SENTINEL  # lazily resolved jax.profiler module (or None)
+
+
+def device_annotation(name: str):
+    """``jax.profiler.TraceAnnotation(name)``, or a no-op context manager.
+
+    Lazy so importing obs never forces JAX backend initialization; the
+    annotation itself is a no-op unless a device profile is being taken.
+    """
+    global _profiler
+    if _profiler is _PROFILER_SENTINEL:
+        try:
+            from jax import profiler as _p  # noqa: PLC0415
+            _profiler = _p
+        except Exception:
+            _profiler = None
+    if _profiler is None:
+        return contextlib.nullcontext()
+    try:
+        return _profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+class TraceRecorder:
+    """Bounded ring buffer of completed spans; Chrome-trace JSON export."""
+
+    def __init__(self, capacity: int = 8192, enabled: bool = True):
+        self.enabled = enabled
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        # (name, ph, t0, dur, tid, request_id, args) — compact on the hot
+        # path; the ring drops the oldest spans once capacity is reached.
+        self._events: deque = deque(maxlen=capacity)
+        self._dropped = 0
+        self._epoch = time.perf_counter()
+        self._pid = os.getpid()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+    def add(self, name: str, t0: float, t1: float, *,
+            request_id: Optional[str] = None, tid: int = 0,
+            args: Optional[Dict] = None) -> None:
+        """Record a completed span; ``t0``/``t1`` are perf_counter times."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self._dropped += 1
+            self._events.append((name, "X", t0, max(0.0, t1 - t0), tid,
+                                 request_id, args))
+
+    def instant(self, name: str, *, request_id: Optional[str] = None,
+                tid: int = 0, args: Optional[Dict] = None) -> None:
+        """Record a zero-duration marker event (``ph="i"``)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self._dropped += 1
+            self._events.append((name, "i", time.perf_counter(), 0.0, tid,
+                                 request_id, args))
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, request_id: Optional[str] = None,
+             tid: int = 0, annotate: bool = False,
+             args: Optional[Dict] = None) -> Iterator[None]:
+        """Time a block; optionally mirror it as a device TraceAnnotation."""
+        if not self.enabled:
+            if annotate:
+                with device_annotation(name):
+                    yield
+            else:
+                yield
+            return
+        ctx = device_annotation(name) if annotate else contextlib.nullcontext()
+        t0 = time.perf_counter()
+        try:
+            with ctx:
+                yield
+        finally:
+            self.add(name, t0, time.perf_counter(),
+                     request_id=request_id, tid=tid, args=args)
+
+    def chrome_trace(self) -> Dict:
+        """The retained spans as a Chrome trace-event JSON object."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        out: List[Dict] = []
+        for name, ph, t0, dur, tid, request_id, args in events:
+            ev: Dict = {
+                "name": name,
+                "ph": ph,
+                "ts": round((t0 - self._epoch) * 1e6, 3),
+                "pid": self._pid,
+                "tid": tid,
+            }
+            if ph == "X":
+                ev["dur"] = round(dur * 1e6, 3)
+            if ph == "i":
+                ev["s"] = "t"  # instant scope: thread
+            ev_args = dict(args) if args else {}
+            if request_id is not None:
+                ev_args["request_id"] = request_id
+            if ev_args:
+                ev["args"] = ev_args
+            out.append(ev)
+        return {"traceEvents": out, "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": dropped}}

@@ -6,7 +6,9 @@
 - ``queue.py`` — bounded admission queue with backpressure (``QueueFull``).
 - ``prefix_cache.py`` — automatic prefix caching: block-granular radix
   cache of shared-prefix K/V consulted at admission, fed at retirement.
-- ``metrics.py`` — serving counters / gauges / latency histograms.
+- ``metrics.py`` — serving counters / gauges / latency histograms, plus
+  the SLO tracker; registered into the shared ``obs.REGISTRY`` for
+  Prometheus export (docs/observability.md).
 - ``bench.py`` — serving-throughput measurement (requests/s, token
   latency), consumed by the repo-level ``bench.py``.
 """

@@ -85,6 +85,7 @@ def run_mixed_serving_bench(cfg, params, *, num_requests: int = 24,
                             max_prompt_len: int = 256,
                             prefill_chunk: int | None = 64,
                             pipeline_decode: bool = True,
+                            trace: bool = True,
                             stagger_s: float = 0.0,
                             seed: int = 0) -> dict:
     """Mixed-workload serving point: varied prompt lengths (short tail +
@@ -92,6 +93,10 @@ def run_mixed_serving_bench(cfg, params, *, num_requests: int = 24,
     MID-DECODE so admission prefill competes with active streams — the
     scenario chunked prefill exists for.  Reports aggregate tok/s plus
     TTFT and host-observed inter-token latency (ITL) p50/p99.
+
+    ``trace=False`` disables the per-request span recorder; the repo
+    ``bench.py`` runs this point both ways so ``--compare`` can gate
+    the tracing overhead (docs/observability.md).
     """
     import threading
 
@@ -118,6 +123,7 @@ def run_mixed_serving_bench(cfg, params, *, num_requests: int = 24,
         prefill_bucket=64,  # bounded prefill shapes under ragged lengths
         prefill_chunk=prefill_chunk,
         pipeline_decode=pipeline_decode,
+        trace=trace,
     )).start()
     itl = LatencyHistogram(max_samples=1 << 16)
     itl_lock = threading.Lock()

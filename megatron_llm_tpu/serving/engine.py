@@ -82,6 +82,8 @@ import numpy as np
 from ..config import ModelConfig
 from ..generation.sampling import NEG_INF
 from ..models import model as model_lib
+from ..obs.logging import EVENT_LOG
+from ..obs.trace import TraceRecorder, device_annotation
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .queue import QueueFull, RequestQueue  # noqa: F401  (re-exported)
@@ -130,6 +132,14 @@ class EngineConfig:
     #                               requests donate theirs back.  Bitwise
     #                               neutral to sampled trajectories.
     #                               0 disables the cache.
+    trace: bool = True            # per-request span tracing (obs/trace.py):
+    #                               queued / prefix_match / prefill_chunk[i]
+    #                               / decode / retire spans per request plus
+    #                               per-iteration engine_step spans, kept in
+    #                               a bounded ring and exported as Chrome
+    #                               trace JSON (GET /trace).  Off = every
+    #                               record path returns before locking.
+    trace_capacity: int = 8192    # span ring size (oldest spans drop)
 
 
 @dataclasses.dataclass
@@ -153,6 +163,8 @@ class _Request:
                  on_token: Optional[Callable[[int], None]] = None,
                  deadline_s: Optional[float] = None):
         self.id = next(self._ids)
+        self.rid = f"req-{self.id}"  # correlation id: every log line and
+        #                              trace span of this request carries it
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = int(eos_id)
@@ -190,6 +202,11 @@ class RequestHandle:
     @property
     def request_id(self) -> int:
         return self._req.id
+
+    @property
+    def rid(self) -> str:
+        """String correlation id shared by log lines and trace spans."""
+        return self._req.rid
 
     def done(self) -> bool:
         return self._req.done_event.is_set()
@@ -441,6 +458,8 @@ class ServingEngine:
             f"max_position_embeddings {cfg.max_position_embeddings}")
         self.metrics = metrics or ServingMetrics(self.config.max_batch_size)
         self.metrics.num_slots = self.config.max_batch_size
+        self.trace = TraceRecorder(capacity=self.config.trace_capacity,
+                                   enabled=self.config.trace)
         self.queue = RequestQueue(self.config.max_queue_size,
                                   self.config.retry_after_s)
         self.slots: Optional[SlotAllocator] = None  # allocated on start
@@ -611,6 +630,11 @@ class ServingEngine:
             raise
         self.metrics.inc("submitted", by=len(reqs))
         self.metrics.set_gauges(queue_depth=len(self.queue))
+        for req in reqs:
+            EVENT_LOG.emit("engine", "submitted", request_id=req.rid,
+                           prompt_len=len(req.prompt),
+                           max_new_tokens=req.max_new_tokens,
+                           queue_depth=len(self.queue))
         return [RequestHandle(r, self) for r in reqs]
 
     def _cancel(self, req: _Request) -> None:
@@ -710,6 +734,12 @@ class ServingEngine:
             self._finish(req, "timeout")
         self.metrics.set_gauges(queue_depth=len(self.queue))
 
+    def _note_dequeued(self, req: _Request) -> None:
+        """Close the request's ``queued`` span (submit -> scheduler pop)."""
+        self.trace.add("queued", req.submit_time, time.perf_counter(),
+                       request_id=req.rid, tid=req.id,
+                       args={"prompt_len": len(req.prompt)})
+
     def _admit(self) -> None:
         assert self.slots is not None
         if self.config.prefill_chunk:
@@ -719,6 +749,7 @@ class ServingEngine:
             req = self.queue.pop()
             if req is None:
                 break
+            self._note_dequeued(req)
             self.metrics.set_gauges(queue_depth=len(self.queue))
             if req.cancel_flag.is_set():
                 self._finish(req, "cancelled")
@@ -742,6 +773,7 @@ class ServingEngine:
                 req = self.queue.pop()
             self.metrics.set_gauges(queue_depth=len(self.queue))
             if req is not None:
+                self._note_dequeued(req)
                 if req.return_logprobs:
                     # prompt logprobs need every prompt logit in one pass;
                     # rare admin path — take the whole-prompt prefill
@@ -757,8 +789,15 @@ class ServingEngine:
                     assert slot is not None
                     ps = _PrefillState(req, slot, padded)
                     if self.prefix_cache is not None:
+                        t_pm = time.perf_counter()
                         lease = self.prefix_cache.match_and_acquire(
                             req.prompt)
+                        self.trace.add(
+                            "prefix_match", t_pm, time.perf_counter(),
+                            request_id=req.rid, tid=req.id,
+                            args={"hit": lease is not None,
+                                  "matched_tokens":
+                                      lease.tokens if lease else 0})
                         if lease is not None:
                             # prefix hit: the cached blocks (block size ==
                             # chunk) land pre-spliced and the chunk cursor
@@ -789,12 +828,15 @@ class ServingEngine:
         # (and on TPU donate) it
         fn = (_prefill_chunk_plain if ps.k_small is None
               else self._prefill_chunk_fn)
-        logits, ps.k_small, ps.v_small = fn(
-            self.cfg, self.params, jnp.asarray(tokens), jnp.int32(off),
-            jnp.asarray([len(req.prompt) - 1 - off], jnp.int32),
-            ps.k_small, ps.v_small,
-            max_seq_len=self.config.max_seq_len,
-            first=(off == 0), last=last)
+        with self.trace.span(f"prefill_chunk[{off // chunk}]",
+                             request_id=req.rid, tid=req.id, annotate=True,
+                             args={"off": off, "tokens": c}):
+            logits, ps.k_small, ps.v_small = fn(
+                self.cfg, self.params, jnp.asarray(tokens), jnp.int32(off),
+                jnp.asarray([len(req.prompt) - 1 - off], jnp.int32),
+                ps.k_small, ps.v_small,
+                max_seq_len=self.config.max_seq_len,
+                first=(off == 0), last=last)
         ps.done = off + c
         self.metrics.inc("prefill_chunks")
         if not last:
@@ -817,6 +859,10 @@ class ServingEngine:
         t.stop()
         self.metrics.inc("admitted")
         self.metrics.inc("prefills")
+        EVENT_LOG.emit("engine", "admitted", request_id=req.rid,
+                       slot=ps.slot, prompt_len=len(req.prompt),
+                       cached_tokens=ps.lease.tokens if ps.lease else 0,
+                       chunked=True)
         st = _SlotState(req, fill=len(req.prompt), pending=first_tok)
         st.lease = ps.lease
         self._active[ps.slot] = st
@@ -833,7 +879,14 @@ class ServingEngine:
         # they always take the cold whole-prompt prefill
         lease = None
         if self.prefix_cache is not None and not req.return_logprobs:
+            t_pm = time.perf_counter()
             lease = self.prefix_cache.match_and_acquire(req.prompt)
+            self.trace.add(
+                "prefix_match", t_pm, time.perf_counter(),
+                request_id=req.rid, tid=req.id,
+                args={"hit": lease is not None,
+                      "matched_tokens": lease.tokens if lease else 0})
+        t_pf = time.perf_counter()
         if lease is not None:
             # prefix hit: splice the cached blocks into a fresh batch-1
             # cache and prefill only the uncached suffix.  The spliced
@@ -847,22 +900,24 @@ class ServingEngine:
                         self.config.max_seq_len - matched)
             tokens = np.zeros((1, width), np.int32)
             tokens[0, :suffix] = req.prompt[matched:]
-            last_logits, k_small, v_small = self._prefill_chunk_fn(
-                self.cfg, self.params, jnp.asarray(tokens),
-                jnp.int32(matched),
-                jnp.asarray([suffix - 1], jnp.int32), k_small, v_small,
-                max_seq_len=self.config.max_seq_len, first=False,
-                last=True)
+            with device_annotation("prefill"):
+                last_logits, k_small, v_small = self._prefill_chunk_fn(
+                    self.cfg, self.params, jnp.asarray(tokens),
+                    jnp.int32(matched),
+                    jnp.asarray([suffix - 1], jnp.int32), k_small, v_small,
+                    max_seq_len=self.config.max_seq_len, first=False,
+                    last=True)
         else:
             padded = -(-plen // bucket) * bucket
             padded = min(padded, self.config.max_seq_len)
             tokens = np.zeros((1, padded), np.int32)
             tokens[0, :plen] = req.prompt
-            last_logits, picked, k_small, v_small = _prefill_impl(
-                self.cfg, self.params, jnp.asarray(tokens),
-                jnp.asarray([plen], jnp.int32),
-                max_seq_len=self.config.max_seq_len,
-                want_logprobs=req.return_logprobs)
+            with device_annotation("prefill"):
+                last_logits, picked, k_small, v_small = _prefill_impl(
+                    self.cfg, self.params, jnp.asarray(tokens),
+                    jnp.asarray([plen], jnp.int32),
+                    max_seq_len=self.config.max_seq_len,
+                    want_logprobs=req.return_logprobs)
             if req.return_logprobs:
                 req.logprobs.extend(
                     np.asarray(picked)[0, :plen - 1].tolist())
@@ -879,8 +934,16 @@ class ServingEngine:
             jnp.asarray([req.top_p], jnp.float32))
         first = int(np.asarray(tok)[0])
         t.stop()
+        self.trace.add("prefill", t_pf, time.perf_counter(),
+                       request_id=req.rid, tid=req.id,
+                       args={"prompt_len": plen,
+                             "cached_tokens": lease.tokens if lease else 0})
         self.metrics.inc("admitted")
         self.metrics.inc("prefills")
+        EVENT_LOG.emit("engine", "admitted", request_id=req.rid, slot=slot,
+                       prompt_len=plen,
+                       cached_tokens=lease.tokens if lease else 0,
+                       chunked=False)
 
         st = _SlotState(req, fill=plen, pending=first)
         st.lease = lease
@@ -912,6 +975,11 @@ class ServingEngine:
         host_s = max(0.0, (time.perf_counter() - it0) - wait_s)
         self.metrics.observe_step_breakdown(host_s=host_s)
         self.metrics.set_gauges(slots_active=self.slots.active_slots)
+        self.trace.add(
+            "engine_step", it0, time.perf_counter(), tid=0,
+            args={"batch": len(inflight.slots),
+                  "route": "fused" if self._fused_decode else "fallback",
+                  "pipelined": self.config.pipeline_decode})
 
     def _dispatch_decode(self) -> _Inflight:
         assert self.slots is not None
@@ -963,11 +1031,14 @@ class ServingEngine:
 
         self.metrics.inc(
             "fused_steps" if self._fused_decode else "fallback_steps")
-        tok, tok_lp, k_cache, v_cache = self._decode(
-            self.cfg, self.params, self.slots.k_cache, self.slots.v_cache,
-            pending, jnp.asarray(fills), jnp.asarray(seeds),
-            jnp.asarray(counters), jnp.asarray(greedy), jnp.asarray(temps),
-            jnp.asarray(top_ks), jnp.asarray(top_ps))
+        with device_annotation("decode"):
+            tok, tok_lp, k_cache, v_cache = self._decode(
+                self.cfg, self.params, self.slots.k_cache,
+                self.slots.v_cache,
+                pending, jnp.asarray(fills), jnp.asarray(seeds),
+                jnp.asarray(counters), jnp.asarray(greedy),
+                jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps))
         self.slots.set_caches(k_cache, v_cache)
         try:  # start the host copy now so it overlaps the next dispatch
             tok.copy_to_host_async()
@@ -1001,6 +1072,11 @@ class ServingEngine:
             # with no newer step in flight the device token vector is
             # gone; the next dispatch must feed this host value
             st.fresh = self._inflight is None
+            if self.trace.enabled:
+                self.trace.add("decode", step.t_dispatch, t_ready,
+                               request_id=st.req.rid, tid=st.req.id,
+                               args={"slot": slot,
+                                     "token_index": len(st.req.generated)})
             self._commit_token(slot, st.pending, float(tok_lp[slot]))
         self.metrics.observe_decode_iteration(committed, device_s)
         self.metrics.observe_step_breakdown(device_s=device_s)
@@ -1026,7 +1102,10 @@ class ServingEngine:
             req.logprobs.append(logprob)
         if req.first_token_time is None:
             req.first_token_time = time.perf_counter()
-            self.metrics.observe_ttft(req.first_token_time - req.submit_time)
+            ttft = req.first_token_time - req.submit_time
+            self.metrics.observe_ttft(ttft)
+            EVENT_LOG.emit("engine", "first_token", request_id=req.rid,
+                           ttft_s=round(ttft, 6))
         if req.on_token is not None:
             try:
                 req.on_token(token)
@@ -1039,6 +1118,8 @@ class ServingEngine:
 
     def _retire(self, slot: int, reason: str) -> None:
         st = self._active.pop(slot)
+        self.trace.instant("retire", request_id=st.req.rid, tid=st.req.id,
+                           args={"slot": slot, "reason": reason})
         if self.prefix_cache is not None:
             # donate the slot's block-aligned prompt prefix back before
             # the slot can be reused, then unpin the admission lease (so
@@ -1065,5 +1146,11 @@ class ServingEngine:
         elif reason != "error":
             self.metrics.inc("completed")
             self.metrics.observe_e2e(time.perf_counter() - req.submit_time)
+        # availability SLO: timeouts and scheduler errors are the server's
+        # fault; eos/length/cancelled finishes are successful service
+        self.metrics.observe_finish(reason not in ("timeout", "error"))
+        EVENT_LOG.emit("engine", "finished", request_id=req.rid,
+                       reason=reason, generated=len(req.generated),
+                       e2e_s=round(time.perf_counter() - req.submit_time, 6))
         req.done_event.set()
         self._notify_drain()

@@ -5,10 +5,17 @@ request lifecycle counters (submitted/admitted/completed/rejected/
 cancelled), slot-occupancy gauges, decode-iteration stats (including the
 max per-iteration batch — the direct evidence that requests actually
 shared a decode step), and latency histograms (time-to-first-token,
-per-token, end-to-end).  Engine phase timing reuses the repo's hierarchical
-timers (utils/timers.py), and ``write`` exports everything to the same
-tensorboard-style writer interface the training metrics use, so the
-``tests/test_metrics.py``-style fake-writer assertions work unchanged.
+per-token, end-to-end).
+
+Export paths: every ``ServingMetrics`` registers itself as the
+``"serving"`` collector in the process-global ``obs.REGISTRY`` (newest
+instance wins), so Prometheus scrapes via
+``GET /metrics?format=prometheus`` see serving, resilience, and training
+metrics side by side; ``snapshot()`` backs the JSON ``GET /metrics``
+shape; ``write`` exports scalars to the tensorboard-style writer
+interface the training metrics use.  An ``obs.SLOTracker`` rides along
+(``self.slo``), fed from the TTFT / decode-iteration / finish observers,
+so router health checks can read burn rates per replica.
 
 Everything is host-side and lock-guarded: the writers are the scheduler
 thread and HTTP threads, the readers are tests / monitoring pollers.
@@ -17,8 +24,10 @@ thread and HTTP threads, the readers are tests / monitoring pollers.
 from __future__ import annotations
 
 import threading
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
+from ..obs.registry import REGISTRY, MetricFamily, summary_family
+from ..obs.slo import SLOConfig, SLOTracker
 from ..utils.timers import Timers
 
 
@@ -27,27 +36,53 @@ class LatencyHistogram:
 
     Keeps the most recent ``max_samples`` observations — serving wants
     *recent* tail latency, and an unbounded list would grow forever on a
-    long-lived engine."""
+    long-lived engine.  Mean and percentiles cover the same retained
+    window so they stay mutually consistent on long-lived engines;
+    ``total_count`` / ``total`` are the all-time aggregates."""
 
     def __init__(self, max_samples: int = 4096):
         self.max_samples = max_samples
         self._samples: list[float] = []
         self._count = 0
         self._total = 0.0
+        self._window_total = 0.0
 
     def observe(self, seconds: float) -> None:
         self._count += 1
         self._total += seconds
         self._samples.append(seconds)
+        self._window_total += seconds
         if len(self._samples) > self.max_samples:
-            del self._samples[: len(self._samples) - self.max_samples]
+            evict = len(self._samples) - self.max_samples
+            self._window_total -= sum(self._samples[:evict])
+            del self._samples[:evict]
 
     @property
     def count(self) -> int:
+        """All-time observation count (kept for back-compat; alias of
+        ``total_count``)."""
         return self._count
 
+    @property
+    def total_count(self) -> int:
+        """All-time observation count, across every retained window."""
+        return self._count
+
+    @property
+    def window_count(self) -> int:
+        """Observations inside the retained window."""
+        return len(self._samples)
+
+    @property
+    def total(self) -> float:
+        """All-time sum of observations (Prometheus summary ``_sum``)."""
+        return self._total
+
     def mean(self) -> float:
-        return self._total / self._count if self._count else 0.0
+        """Mean over the retained window — same window as percentiles."""
+        if not self._samples:
+            return 0.0
+        return self._window_total / len(self._samples)
 
     def percentile(self, p: float) -> float:
         """p in [0, 100], nearest-rank over the retained window."""
@@ -57,10 +92,20 @@ class LatencyHistogram:
         idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
         return xs[idx]
 
-    def snapshot(self) -> dict:
-        return {"count": self._count, "mean_s": self.mean(),
-                "p50_s": self.percentile(50), "p95_s": self.percentile(95),
-                "p99_s": self.percentile(99)}
+    def snapshot(self, suffix: str = "_s") -> dict:
+        """Windowed stats under unified keys: ``count`` (windowed),
+        ``total_count`` (all-time), ``mean``/``p50``/``p95``/``p99`` with
+        ``suffix`` appended (``"_s"`` for latencies, ``""`` for unitless
+        reservoirs like prefix-hit token counts)."""
+        out = {"count": len(self._samples), "total_count": self._count,
+               f"mean{suffix}": self.mean()}
+        for p in (50, 95, 99):
+            out[f"p{p}{suffix}"] = self.percentile(p)
+        return out
+
+    def quantiles(self, qs: Sequence[float] = (0.5, 0.95, 0.99)) -> dict:
+        """{q: value} for Prometheus summary export."""
+        return {q: self.percentile(100.0 * q) for q in qs}
 
 
 _COUNTERS = (
@@ -80,11 +125,30 @@ _COUNTERS = (
     "prefix_hits", "prefix_misses", "prefix_evicted_blocks",
 )
 
+# (attribute, prometheus family name, help) for the latency reservoirs
+_PROM_SUMMARIES = (
+    ("ttft", "serving_ttft_seconds", "time to first token"),
+    ("per_token", "serving_per_token_latency_seconds",
+     "per-token decode latency (one sample per token per iteration)"),
+    ("e2e", "serving_e2e_latency_seconds", "request end-to-end latency"),
+    ("device_step", "serving_device_step_seconds",
+     "decode dispatch to tokens-on-host"),
+    ("sched_host", "serving_sched_host_seconds",
+     "scheduler host bookkeeping per iteration"),
+    ("prefix_hit_tokens", "serving_prefix_hit_tokens",
+     "tokens per admission served from the prefix cache"),
+)
+
 
 class ServingMetrics:
-    """Thread-safe serving counter/gauge/histogram registry."""
+    """Thread-safe serving counter/gauge/histogram registry.
 
-    def __init__(self, num_slots: int = 0):
+    Unless ``register=False``, the instance installs itself as the
+    ``"serving"`` collector of ``obs.REGISTRY`` — replacing any previous
+    instance, so the newest engine's metrics are the ones scraped."""
+
+    def __init__(self, num_slots: int = 0,
+                 slo: Optional[SLOConfig] = None, register: bool = True):
         self._lock = threading.Lock()
         self.counters = {name: 0 for name in _COUNTERS}
         self.num_slots = num_slots
@@ -111,6 +175,9 @@ class ServingMetrics:
         self.prefix_hit_tokens = LatencyHistogram()
         self.prefix_blocks = 0   # gauge: blocks resident in the cache
         self.timers = Timers(log_level=2)
+        self.slo = SLOTracker(slo or SLOConfig())
+        if register:
+            REGISTRY.register_collector("serving", self.collect)
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -135,6 +202,7 @@ class ServingMetrics:
             self.max_decode_batch = max(self.max_decode_batch, batch)
             for _ in range(batch):
                 self.per_token.observe(seconds)
+        self.slo.record_itl(seconds, n=batch)
 
     def observe_step_breakdown(self, *, device_s: Optional[float] = None,
                                host_s: Optional[float] = None,
@@ -159,10 +227,15 @@ class ServingMetrics:
     def observe_ttft(self, seconds: float) -> None:
         with self._lock:
             self.ttft.observe(seconds)
+        self.slo.record_ttft(seconds)
 
     def observe_e2e(self, seconds: float) -> None:
         with self._lock:
             self.e2e.observe(seconds)
+
+    def observe_finish(self, ok: bool) -> None:
+        """Request retired; ``ok`` False on timeout/error (availability)."""
+        self.slo.record_request(ok)
 
     def snapshot(self) -> dict:
         """Point-in-time dict of every counter, gauge, and histogram."""
@@ -183,20 +256,57 @@ class ServingMetrics:
                 "device_idle_frac": (self.device_idle_frac
                                      if self.device_idle_frac is not None
                                      else 0.0),
-                # prefix cache (the histogram samples are token counts)
+                # prefix cache (the histogram samples are token counts,
+                # hence the unitless suffix)
                 "prefix_hit_rate": (
                     self.counters["prefix_hits"]
                     / max(1, self.counters["prefix_hits"]
                           + self.counters["prefix_misses"])),
                 "prefix_blocks": self.prefix_blocks,
-                "prefix_hit_tokens": {
-                    "count": self.prefix_hit_tokens.count,
-                    "mean": self.prefix_hit_tokens.mean(),
-                    "p50": self.prefix_hit_tokens.percentile(50),
-                    "p99": self.prefix_hit_tokens.percentile(99),
-                },
+                "prefix_hit_tokens": self.prefix_hit_tokens.snapshot(
+                    suffix=""),
             })
-            return out
+        out["slo"] = self.slo.snapshot()
+        return out
+
+    def collect(self) -> List[MetricFamily]:
+        """obs.REGISTRY collector: every counter, gauge, and reservoir
+        summary under ``serving_*`` names, plus SLO burn-rate gauges."""
+        fams: List[MetricFamily] = []
+        with self._lock:
+            for name in _COUNTERS:
+                fams.append(MetricFamily(
+                    f"serving_{name}_total", "counter",
+                    f"serving lifecycle counter: {name}").add(
+                        self.counters[name]))
+            hits = self.counters["prefix_hits"]
+            misses = self.counters["prefix_misses"]
+            for gname, help_, value in (
+                    ("serving_slots_active", "slots currently decoding",
+                     self.slots_active),
+                    ("serving_slots_total", "configured KV slots",
+                     self.num_slots),
+                    ("serving_queue_depth", "requests waiting for a slot",
+                     self.queue_depth),
+                    ("serving_max_decode_batch",
+                     "largest decode batch observed", self.max_decode_batch),
+                    ("serving_device_idle_frac",
+                     "EWMA fraction of step wall time the device sat idle",
+                     self.device_idle_frac or 0.0),
+                    ("serving_prefix_blocks",
+                     "K/V blocks resident in the prefix cache",
+                     self.prefix_blocks),
+                    ("serving_prefix_hit_rate",
+                     "prefix-cache admission hit rate",
+                     hits / max(1, hits + misses))):
+                fams.append(MetricFamily(gname, "gauge", help_).add(value))
+            for attr, pname, help_ in _PROM_SUMMARIES:
+                hist: LatencyHistogram = getattr(self, attr)
+                fams.append(summary_family(
+                    pname, help_, count=hist.total_count, total=hist.total,
+                    quantiles=hist.quantiles()))
+        fams.extend(self.slo.collect(prefix="serving_slo"))
+        return fams
 
     def write(self, writer, iteration: int,
               names: Optional[Sequence[str]] = None) -> None:
@@ -226,5 +336,7 @@ class ServingMetrics:
                           (self.sched_host, "sched_host_time")):
             writer.add_scalar(f"serving/{key}_mean_s", hist.mean(), iteration)
             writer.add_scalar(f"serving/{key}_p95_s", hist.percentile(95),
+                              iteration)
+            writer.add_scalar(f"serving/{key}_p99_s", hist.percentile(99),
                               iteration)
         self.timers.write(writer, iteration)

@@ -93,6 +93,17 @@ def main(argv=None) -> int:
                     help="periodically print a one-line JSON serving-"
                          "metrics summary (prefix-cache hit rate "
                          "included) to stdout; 0 disables")
+    ap.add_argument("--no_trace", action="store_true",
+                    help="disable per-request span tracing (obs/trace.py, "
+                         "GET /trace).  Tracing is on by default and holds "
+                         "the serving_mixed ITL p50 within the bench.py "
+                         "--compare regression gate; this is the escape "
+                         "hatch if a deployment wants the last few "
+                         "microseconds back")
+    ap.add_argument("--log_json", action="store_true",
+                    help="emit the structured JSON event log "
+                         "(obs/logging.py: request lifecycle lines with "
+                         "request_id correlation ids) to stderr")
     ap.add_argument("--retry_after_s", type=float, default=1.0,
                     help="Retry-After hint returned with 503 backpressure")
     ap.add_argument("--request_deadline_s", type=float, default=None,
@@ -167,6 +178,13 @@ def main(argv=None) -> int:
 
     from ..generation.server import MegatronServer
 
+    if args.log_json:
+        import sys
+
+        from ..obs.logging import EVENT_LOG
+
+        EVENT_LOG.configure(stream=sys.stderr)
+
     prefix_blocks = 0 if args.no_prefix_cache else args.prefix_cache_blocks
     server = MegatronServer(
         lm.cfg, params, tokenizer,
@@ -180,7 +198,8 @@ def main(argv=None) -> int:
         prefill_bucket=args.prefill_bucket,
         prefill_chunk=args.prefill_chunk,
         pipeline_decode=not args.no_pipeline_decode,
-        prefix_cache_blocks=prefix_blocks)
+        prefix_cache_blocks=prefix_blocks,
+        trace=not args.no_trace)
     if prefix_blocks:
         block_tokens = args.prefill_chunk or max(1, args.prefill_bucket)
         print(f"prefix cache: {prefix_blocks} blocks x {block_tokens} "
@@ -188,6 +207,8 @@ def main(argv=None) -> int:
               "prompt tokens; docs/serving.md 'Prefix caching')")
     else:
         print("prefix cache: disabled")
+    print("tracing: " + ("disabled (--no_trace)" if args.no_trace
+                         else "on (GET /trace; tools/dump_trace.py)"))
     if args.metrics_interval_s > 0:
         _start_metrics_logger(server.service, args.metrics_interval_s)
     print(f"serving on {args.host}:{args.port}")

@@ -37,6 +37,8 @@ from ..data.samplers import BatchIterator
 from ..models import model as model_lib
 from ..models import sharding as shard_lib
 from ..models.transformer import rope_tables
+from ..obs.logging import EVENT_LOG
+from ..obs.registry import REGISTRY as obs_registry
 from ..parallel import mesh as mesh_lib
 from ..parallel.cross_entropy import cross_entropy, masked_mean_loss
 from ..resilience import chaos, guard_spec
@@ -437,6 +439,38 @@ def training_log(cfg: RuntimeConfig, log: _LogState, metrics: dict,
             f" moe load imbalance: "
             f"{float(metrics['moe_load_imbalance']):.3f} |")
     print_rank_0(line)
+    # shared obs registry (GET /metrics?format=prometheus serves these
+    # next to the serving and resilience metrics) + one structured JSON
+    # log line per window with the same fields the console line carries
+    obs_registry.gauge(
+        "training_iteration", "current training iteration").set(iteration)
+    obs_registry.gauge(
+        "training_tokens_per_sec",
+        "training throughput over the last log window").set(tokens_per_sec)
+    obs_registry.gauge(
+        "training_lm_loss", "window-averaged LM loss").set(avg_loss)
+    obs_registry.gauge(
+        "training_learning_rate", "current learning rate").set(lr)
+    obs_registry.gauge(
+        "training_grad_norm", "last step's gradient norm").set(grad_norm)
+    obs_registry.gauge(
+        "training_consumed_samples",
+        "samples consumed since the start of the run").set(consumed_samples)
+    obs_registry.gauge(
+        "training_anomalous_iterations",
+        "anomalous (skipped-loss) iterations so far").set(log.anomaly_total)
+    obs_registry.histogram(
+        "training_step_time_seconds",
+        "per-iteration wall time over log windows",
+        buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                 10.0, 30.0, 60.0)).observe(per_iter)
+    EVENT_LOG.emit(
+        "training", "log_window", iteration=iteration,
+        consumed_samples=consumed_samples, lm_loss=round(avg_loss, 6),
+        tokens_per_sec=round(tokens_per_sec, 3),
+        step_time_s=round(per_iter, 6), learning_rate=lr,
+        grad_norm=round(grad_norm, 6), skipped=log.skipped_total,
+        anomalies=log.anomaly_total)
     if writer is not None:
         if "moe_dropped_frac" in metrics:
             writer.add_scalar("train/moe_dropped_frac",
@@ -857,6 +891,8 @@ def rollback_to_last_checkpoint(cfg: RuntimeConfig, state, attempt: int = 1):
     state, tag = checkpointing.load_checkpoint(
         root, state, retries=cfg.train.checkpoint_retries)
     metrics_lib.RESILIENCE_EVENTS.inc("rollbacks")
+    EVENT_LOG.emit("training", "rollback", checkpoint_root=str(root),
+                   restored_tag=str(tag))
     return state, (0 if tag == checkpointing.RELEASE else int(tag))
 
 

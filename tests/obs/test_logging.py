@@ -1,0 +1,76 @@
+"""Structured event log: line shape, ring bounds, filtering, stream
+sink (including a dead sink), and reconfiguration."""
+
+import io
+import json
+
+from megatron_llm_tpu.obs.logging import StructuredLog
+
+
+def test_emit_line_shape():
+    log = StructuredLog()
+    line = log.emit("engine", "first_token", request_id="req-9",
+                    ttft_s=0.123)
+    assert line["component"] == "engine" and line["event"] == "first_token"
+    assert line["request_id"] == "req-9" and line["ttft_s"] == 0.123
+    assert isinstance(line["ts"], float)
+    assert isinstance(line["rank"], int)  # 0 on a single-host test run
+
+
+def test_ring_bound_and_recent_filters():
+    log = StructuredLog(capacity=4)
+    for i in range(6):
+        log.emit("engine", "submitted", request_id=f"req-{i}")
+    log.emit("queue", "queue_full", depth=3)
+    lines = log.recent()
+    assert len(lines) == 4  # capacity bound, oldest evicted
+    assert log.recent(request_id="req-5")[0]["request_id"] == "req-5"
+    assert log.recent(event="queue_full")[0]["depth"] == 3
+    assert log.recent(request_id="req-0") == []  # evicted
+    assert len(log.recent(limit=2)) == 2
+    log.clear()
+    assert log.recent() == []
+
+
+def test_stream_sink_writes_json_lines():
+    buf = io.StringIO()
+    log = StructuredLog(stream=buf)
+    log.emit("training", "log_window", iteration=5, lm_loss=2.5)
+    parsed = json.loads(buf.getvalue())
+    assert parsed["event"] == "log_window" and parsed["iteration"] == 5
+
+
+def test_dead_stream_is_swallowed():
+    class Dead:
+        def write(self, _):
+            raise OSError("broken pipe")
+
+        def flush(self):
+            raise OSError("broken pipe")
+
+    log = StructuredLog(stream=Dead())
+    line = log.emit("engine", "finished", request_id="req-1")
+    assert line["event"] == "finished"
+    assert log.recent()[-1]["event"] == "finished"  # ring still got it
+
+
+def test_configure_stream_and_capacity():
+    log = StructuredLog(capacity=8)
+    for i in range(8):
+        log.emit("x", "e", i=i)
+    log.configure(capacity=3)  # shrink keeps the newest lines
+    assert [l["i"] for l in log.recent()] == [5, 6, 7]
+    buf = io.StringIO()
+    log.configure(stream=buf)
+    log.emit("x", "late")
+    assert "late" in buf.getvalue()
+    log.configure(stream=None)
+    log.emit("x", "silent")
+    assert "silent" not in buf.getvalue()
+
+
+def test_non_serializable_fields_stringified():
+    buf = io.StringIO()
+    log = StructuredLog(stream=buf)
+    log.emit("x", "e", path=object())  # default=str must kick in
+    assert json.loads(buf.getvalue())["event"] == "e"

@@ -1,0 +1,194 @@
+"""Metrics registry: primitives, collectors, and a minimal Prometheus
+0.0.4 text parser round-trip (the same parser the serving endpoint test
+uses — if the exposition drifts from the format a real scraper expects,
+it breaks here first)."""
+
+import math
+import re
+
+import pytest
+
+from megatron_llm_tpu.obs.registry import (
+    MetricFamily,
+    MetricsRegistry,
+    _fmt_float,
+    summary_family,
+)
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text):
+    """Minimal 0.0.4 text-format parser → (types, samples).
+
+    ``types`` maps family name -> declared TYPE; ``samples`` maps
+    ``(sample_name, frozenset(labels.items()))`` -> float.  Asserts on
+    any line it cannot parse, so malformed exposition fails loudly.
+    """
+    types, samples = {}, {}
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("# HELP"):
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, mtype = line.split(maxsplit=3)
+            types[name] = mtype.strip()
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labelstr, value = m.groups()
+        labels = {}
+        if labelstr:
+            consumed = sum(len(p) for p in
+                           re.findall(r'[a-zA-Z_][a-zA-Z0-9_]*='
+                                      r'"(?:[^"\\]|\\.)*",?', labelstr))
+            assert consumed == len(labelstr), \
+                f"unparseable label block: {labelstr!r}"
+            for k, v in _LABEL_RE.findall(labelstr):
+                labels[k] = (v.replace(r"\"", '"').replace(r"\n", "\n")
+                             .replace("\\\\", "\\"))
+        samples[(name, frozenset(labels.items()))] = float(value)
+    return types, samples
+
+
+def test_counter_gauge_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("requests_total", "requests seen").inc(by=3)
+    reg.counter("requests_total").inc()  # get-or-create: same metric
+    reg.gauge("queue_depth").set(7)
+    reg.gauge("queue_depth").dec(2)
+    types, samples = parse_prometheus(reg.prometheus_text())
+    assert types["requests_total"] == "counter"
+    assert types["queue_depth"] == "gauge"
+    assert samples[("requests_total", frozenset())] == 4.0
+    assert samples[("queue_depth", frozenset())] == 5.0
+
+
+def test_labeled_counter_children():
+    reg = MetricsRegistry()
+    c = reg.counter("events_total", "by kind", labelnames=("kind",))
+    c.inc(kind="retry")
+    c.inc(by=2, kind="rollback")
+    assert c.value(kind="retry") == 1.0
+    _, samples = parse_prometheus(reg.prometheus_text())
+    assert samples[("events_total", frozenset({("kind", "retry")}))] == 1.0
+    assert samples[("events_total",
+                    frozenset({("kind", "rollback")}))] == 2.0
+    with pytest.raises(ValueError):
+        c.inc(wrong="x")  # undeclared label name
+    with pytest.raises(ValueError):
+        c.inc(by=-1, kind="retry")  # counters only increase
+
+
+def test_untouched_unlabeled_counter_exports_zero():
+    reg = MetricsRegistry()
+    reg.counter("never_incremented_total")
+    _, samples = parse_prometheus(reg.prometheus_text())
+    assert samples[("never_incremented_total", frozenset())] == 0.0
+
+
+def test_type_mismatch_raises():
+    reg = MetricsRegistry()
+    reg.counter("thing")
+    with pytest.raises(ValueError):
+        reg.gauge("thing")
+
+
+def test_invalid_names_rejected():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.counter("bad-name")
+    with pytest.raises(ValueError):
+        reg.counter("ok_name", labelnames=("bad-label",))
+
+
+def test_histogram_cumulative_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("step_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    types, samples = parse_prometheus(reg.prometheus_text())
+    assert types["step_seconds"] == "histogram"
+
+    def bucket(le):
+        return samples[("step_seconds_bucket", frozenset({("le", le)}))]
+
+    assert bucket("0.1") == 1.0
+    assert bucket("1") == 3.0   # cumulative: 0.05 + both 0.5s
+    assert bucket("10") == 4.0
+    assert bucket("+Inf") == 5.0
+    assert samples[("step_seconds_count", frozenset())] == 5.0
+    assert samples[("step_seconds_sum", frozenset())] == pytest.approx(56.05)
+
+
+def test_summary_family_quantiles():
+    fam = summary_family("ttft_seconds", "time to first token",
+                         count=10, total=4.2,
+                         quantiles={0.5: 0.3, 0.99: 1.7})
+    reg = MetricsRegistry()
+    reg.register_collector("x", lambda: [fam])
+    types, samples = parse_prometheus(reg.prometheus_text())
+    assert types["ttft_seconds"] == "summary"
+    assert samples[("ttft_seconds",
+                    frozenset({("quantile", "0.5")}))] == 0.3
+    assert samples[("ttft_seconds",
+                    frozenset({("quantile", "0.99")}))] == 1.7
+    assert samples[("ttft_seconds_count", frozenset())] == 10.0
+    assert samples[("ttft_seconds_sum", frozenset())] == 4.2
+
+
+def test_collector_replace_by_name():
+    """Re-registering under the same name replaces: fresh ServingMetrics
+    instances (tests, benches) must shadow stale ones at scrape time."""
+    reg = MetricsRegistry()
+    reg.register_collector(
+        "serving", lambda: [MetricFamily("v", "gauge").add(1.0)])
+    reg.register_collector(
+        "serving", lambda: [MetricFamily("v", "gauge").add(2.0)])
+    _, samples = parse_prometheus(reg.prometheus_text())
+    assert samples[("v", frozenset())] == 2.0
+    reg.unregister_collector("serving")
+    assert ("v", frozenset()) not in parse_prometheus(
+        reg.prometheus_text())[1]
+
+
+def test_broken_collector_does_not_kill_scrape():
+    reg = MetricsRegistry()
+    reg.gauge("fine").set(1)
+
+    def broken():
+        raise RuntimeError("boom")
+
+    reg.register_collector("bad", broken)
+    _, samples = parse_prometheus(reg.prometheus_text())
+    assert samples[("fine", frozenset())] == 1.0
+    err_keys = [k for k in samples if k[0] == "obs_collector_errors"]
+    assert len(err_keys) == 1
+    assert dict(err_keys[0][1])["collector"] == "bad"
+
+
+def test_label_value_escaping_round_trips():
+    reg = MetricsRegistry()
+    g = reg.gauge("weird", labelnames=("path",))
+    g.set(1.0, path='a"b\\c\nd')
+    _, samples = parse_prometheus(reg.prometheus_text())
+    assert samples[("weird",
+                    frozenset({("path", 'a"b\\c\nd')}))] == 1.0
+
+
+def test_fmt_float():
+    assert _fmt_float(3.0) == "3"
+    assert _fmt_float(0.25) == "0.25"
+    assert _fmt_float(float("inf")) == "+Inf"
+    assert _fmt_float(float("-inf")) == "-Inf"
+    assert _fmt_float(float("nan")) == "NaN"
+    assert math.isnan(float(_fmt_float(float("nan"))))
+
+
+def test_reset_clears_everything():
+    reg = MetricsRegistry()
+    reg.counter("a_total").inc()
+    reg.register_collector("c", lambda: [MetricFamily("b", "gauge")])
+    reg.reset()
+    assert reg.prometheus_text() == "\n"

@@ -1,0 +1,97 @@
+"""SLO tracker: windowed compliance, burn-rate math, pruning, and the
+registry-collector export.  A fake clock makes every window deterministic."""
+
+import pytest
+
+from megatron_llm_tpu.obs.slo import SLOConfig, SLOTracker
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _tracker(**cfg):
+    clock = FakeClock()
+    return SLOTracker(SLOConfig(**cfg), clock=clock), clock
+
+
+def test_empty_window_is_healthy():
+    t, _ = _tracker()
+    for dim in SLOTracker.DIMENSIONS:
+        assert t.compliance(dim) == 1.0
+        assert t.burn_rate(dim) == 0.0
+    assert t.healthy()
+    snap = t.snapshot()
+    assert snap["healthy"] and snap["ttft"]["total"] == 0
+
+
+def test_ttft_compliance_and_burn():
+    t, _ = _tracker(ttft_target_s=1.0, ttft_objective=0.9)
+    for s in (0.5, 0.5, 0.5, 2.0):  # 3/4 under target
+        t.record_ttft(s)
+    assert t.compliance("ttft") == 0.75
+    # burn = (1 - 0.75) / (1 - 0.9) = 2.5: violating if sustained
+    assert t.burn_rate("ttft") == pytest.approx(2.5)
+    assert not t.healthy()
+
+
+def test_itl_batch_weighting():
+    """One decode iteration serves n tokens; a slow iteration counts n
+    bad tokens, not one."""
+    t, _ = _tracker(itl_target_s=0.1, itl_objective=0.5)
+    t.record_itl(0.05, n=8)   # 8 good
+    t.record_itl(0.5, n=8)    # 8 bad
+    assert t.compliance("itl") == 0.5
+    assert t.burn_rate("itl") == 1.0
+    assert t.healthy()  # burn exactly 1.0 is the sustainable edge
+
+
+def test_availability():
+    t, _ = _tracker(availability_target=0.5)
+    t.record_request(True)
+    t.record_request(False)
+    assert t.compliance("availability") == 0.5
+    snap = t.snapshot()
+    assert snap["availability"]["good"] == 1
+    assert snap["availability"]["total"] == 2
+
+
+def test_window_pruning():
+    t, clock = _tracker(window_s=10.0)
+    t.record_ttft(9.0)   # a miss at t=0
+    clock.t = 5.0
+    assert t.compliance("ttft") == 0.0
+    clock.t = 11.0       # the miss ages out of the 10s window
+    assert t.compliance("ttft") == 1.0
+    t.record_ttft(0.1)
+    assert t.snapshot()["ttft"]["total"] == 1
+
+
+def test_snapshot_shape():
+    t, _ = _tracker()
+    t.record_ttft(0.1)
+    snap = t.snapshot()
+    assert snap["window_s"] == 300.0
+    assert snap["ttft"]["target_s"] == 1.0
+    assert snap["itl"]["target_s"] == 0.25
+    for dim in SLOTracker.DIMENSIONS:
+        assert {"compliance", "burn_rate", "objective",
+                "good", "total"} <= set(snap[dim])
+
+
+def test_collect_families():
+    t, _ = _tracker(ttft_objective=0.9)
+    t.record_ttft(5.0)  # all misses: burn = 1/0.1 = 10
+    fams = t.collect(prefix="serving_slo")
+    by_name = {f.name: f for f in fams}
+    assert set(by_name) == {"serving_slo_compliance",
+                            "serving_slo_burn_rate",
+                            "serving_slo_healthy"}
+    burn = {s.labels["slo"]: s.value
+            for s in by_name["serving_slo_burn_rate"].samples}
+    assert burn["ttft"] == pytest.approx(10.0) and burn["itl"] == 0.0
+    assert by_name["serving_slo_healthy"].samples[0].value == 0.0

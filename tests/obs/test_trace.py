@@ -1,0 +1,80 @@
+"""TraceRecorder: span/instant recording, ring-buffer bounds, Chrome
+trace-event JSON schema, and the disabled-recorder fast path."""
+
+import json
+
+from megatron_llm_tpu.obs.trace import TraceRecorder, device_annotation
+
+
+def test_span_records_complete_event():
+    tr = TraceRecorder()
+    with tr.span("prefill", request_id="req-1", tid=1,
+                 args={"prompt_len": 64}):
+        pass
+    trace = tr.chrome_trace()
+    assert trace["displayTimeUnit"] == "ms"
+    assert trace["otherData"]["dropped_events"] == 0
+    (ev,) = trace["traceEvents"]
+    assert ev["name"] == "prefill" and ev["ph"] == "X"
+    assert ev["tid"] == 1 and ev["pid"] > 0
+    assert ev["ts"] >= 0 and ev["dur"] >= 0  # µs relative to epoch
+    assert ev["args"] == {"prompt_len": 64, "request_id": "req-1"}
+    json.dumps(trace)  # the export must be JSON-serializable as-is
+
+
+def test_instant_event_schema():
+    tr = TraceRecorder()
+    tr.instant("retire", request_id="req-2", tid=2, args={"reason": "eos"})
+    (ev,) = tr.chrome_trace()["traceEvents"]
+    assert ev["ph"] == "i" and ev["s"] == "t"
+    assert "dur" not in ev
+    assert ev["args"]["reason"] == "eos"
+    assert ev["args"]["request_id"] == "req-2"
+
+
+def test_ring_drops_oldest_and_counts():
+    tr = TraceRecorder(capacity=3)
+    for i in range(5):
+        tr.add(f"s{i}", 0.0, 1.0)
+    trace = tr.chrome_trace()
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert names == ["s2", "s3", "s4"]  # oldest two evicted
+    assert trace["otherData"]["dropped_events"] == 2
+    tr.clear()
+    assert len(tr) == 0 and tr.dropped == 0
+
+
+def test_disabled_recorder_is_inert():
+    tr = TraceRecorder(enabled=False)
+    ran = []
+    with tr.span("x"):
+        ran.append(1)
+    tr.add("y", 0.0, 1.0)
+    tr.instant("z")
+    assert ran == [1]  # the guarded block still executes
+    assert tr.chrome_trace()["traceEvents"] == []
+
+
+def test_span_records_even_when_body_raises():
+    tr = TraceRecorder()
+    try:
+        with tr.span("failing", request_id="req-3"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    (ev,) = tr.chrome_trace()["traceEvents"]
+    assert ev["name"] == "failing"
+
+
+def test_device_annotation_is_a_context_manager():
+    # On CPU (or with jax absent) this must degrade to a no-op context —
+    # never raise at engine steady state.
+    with device_annotation("decode"):
+        pass
+
+
+def test_negative_duration_clamped():
+    tr = TraceRecorder()
+    tr.add("clock_skew", 2.0, 1.0)  # t1 < t0 must not export dur < 0
+    (ev,) = tr.chrome_trace()["traceEvents"]
+    assert ev["dur"] == 0

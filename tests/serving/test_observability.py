@@ -1,0 +1,233 @@
+"""Observability spine through the serving stack (docs/observability.md):
+
+- ``GET /metrics?format=prometheus`` serves every subsystem — serving
+  counters/summaries, SLO gauges, resilience events — from the one shared
+  registry, parseable by a minimal 0.0.4 text parser (round-trip).
+- ``GET /metrics`` (JSON) keeps its pre-existing shape.
+- ``GET /trace`` returns Chrome trace-event JSON where one request id
+  links its ``queued`` → prefill → ``decode`` → ``retire`` spans.
+- The structured event log, the trace spans, and the HTTP response all
+  carry the same ``request_id`` (end-to-end correlation).
+"""
+
+import json
+import re
+import urllib.request
+
+import jax
+import pytest
+
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.generation.server import (
+    GenerationService,
+    MegatronServer,
+)
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.obs.logging import EVENT_LOG
+from megatron_llm_tpu.tokenizer.tokenizer import NullTokenizer
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text):
+    """Minimal 0.0.4 parser → (types, samples); asserts on bad lines."""
+    types, samples = {}, {}
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("# HELP"):
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, mtype = line.split(maxsplit=3)
+            types[name] = mtype.strip()
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labelstr, value = m.groups()
+        labels = dict(_LABEL_RE.findall(labelstr)) if labelstr else {}
+        samples[(name, frozenset(labels.items()))] = float(value)
+    return types, samples
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_config(num_layers=1, vocab_size=256,
+                      make_vocab_size_divisible_by=8)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _generate(port, prompts, ttg=4):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api",
+        data=json.dumps({"prompts": prompts, "tokens_to_generate": ttg,
+                         "no_early_termination": True}).encode(),
+        method="PUT", headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=600) as resp:
+        return json.loads(resp.read())
+
+
+def test_prometheus_endpoint_round_trip(model):
+    """After real traffic, the text endpoint carries serving counters,
+    latency summaries, SLO gauges, and the resilience counter family —
+    all from one scrape of the shared registry."""
+    cfg, params = model
+    server = MegatronServer(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=2)
+    server.run("127.0.0.1", 0, block=False)
+    try:
+        _generate(server.port, ["5 9 3", "7 2"], ttg=4)
+        url = f"http://127.0.0.1:{server.port}/metrics?format=prometheus"
+        with urllib.request.urlopen(url, timeout=60) as resp:
+            assert resp.status == 200
+            ctype = resp.headers["Content-Type"]
+            assert ctype.startswith("text/plain")
+            assert "version=0.0.4" in ctype
+            text = resp.read().decode()
+    finally:
+        server.shutdown()
+
+    types, samples = parse_prometheus(text)
+    assert types["serving_completed_total"] == "counter"
+    assert samples[("serving_completed_total", frozenset())] == 2.0
+    assert samples[("serving_submitted_total", frozenset())] == 2.0
+    # host-computed reservoir percentiles export as a summary
+    assert types["serving_ttft_seconds"] == "summary"
+    assert samples[("serving_ttft_seconds_count", frozenset())] == 2.0
+    assert ("serving_ttft_seconds",
+            frozenset({("quantile", "0.5")})) in samples
+    # SLO gauges ride in the same scrape, one row per dimension
+    assert types["serving_slo_burn_rate"] == "gauge"
+    for dim in ("ttft", "itl", "availability"):
+        assert ("serving_slo_compliance",
+                frozenset({("slo", dim)})) in samples
+    assert samples[("serving_slo_healthy", frozenset())] in (0.0, 1.0)
+    # the resilience collector (metrics.py RESILIENCE_EVENTS) shares it
+    assert types["resilience_events_total"] == "counter"
+
+
+def test_json_metrics_shape_unchanged(model):
+    """The original JSON endpoint keeps its keys; Prometheus is opt-in
+    via the query parameter, not a format change."""
+    cfg, params = model
+    server = MegatronServer(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=2)
+    server.run("127.0.0.1", 0, block=False)
+    try:
+        _generate(server.port, ["5 9 3"], ttg=3)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics",
+                timeout=60) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            snap = json.loads(resp.read())
+    finally:
+        server.shutdown()
+    assert snap["completed"] == 1
+    for key in ("submitted", "decode_iterations", "ttft",
+                "per_token_latency", "device_idle_frac", "prefix_hit_rate"):
+        assert key in snap
+    assert snap["ttft"]["count"] == 1  # unified snapshot keys
+    assert "p99_s" in snap["ttft"] and "total_count" in snap["ttft"]
+    assert snap["slo"]["healthy"] in (True, False)
+
+
+def test_trace_endpoint_schema_and_request_lifecycle(model):
+    """GET /trace after a multi-request run: valid Chrome trace JSON, and
+    at least one request id whose queued → prefill → decode → retire
+    spans all share that id; engine_step spans carry batch + routing."""
+    cfg, params = model
+    server = MegatronServer(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=2)
+    server.run("127.0.0.1", 0, block=False)
+    try:
+        out = _generate(server.port, ["5 9 3", "7 2", "11 12"], ttg=4)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/trace",
+                timeout=60) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            trace = json.loads(resp.read())
+    finally:
+        server.shutdown()
+
+    assert trace["displayTimeUnit"] == "ms"
+    assert "dropped_events" in trace["otherData"]
+    events = trace["traceEvents"]
+    assert events, "multi-request run produced no trace events"
+    for ev in events:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(ev)
+        assert ev["ph"] in ("X", "i")
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+
+    rids = out["request_ids"]
+    assert len(rids) == 3 and len(set(rids)) == 3
+
+    def phases(rid):
+        return {e["name"] for e in events
+                if e.get("args", {}).get("request_id") == rid}
+
+    for rid in rids:
+        ph = phases(rid)
+        assert "queued" in ph, f"{rid}: {ph}"
+        assert any(p == "prefill" or p.startswith("prefill_chunk")
+                   for p in ph), f"{rid}: {ph}"
+        assert "decode" in ph and "retire" in ph, f"{rid}: {ph}"
+
+    steps = [e for e in events if e["name"] == "engine_step"]
+    assert steps, "no per-iteration engine_step spans"
+    assert all(e["args"]["batch"] >= 1 for e in steps)
+    assert all(e["args"]["route"] in ("fused", "fallback") for e in steps)
+
+
+def test_request_id_correlates_log_lines_and_spans(model):
+    """One id, three views: the HTTP response's request_ids, the
+    structured event log's lifecycle lines, and the trace spans."""
+    cfg, params = model
+    EVENT_LOG.clear()
+    svc = GenerationService(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=2)
+    try:
+        status, out = svc.handle({"prompts": ["5 9 3"],
+                                  "tokens_to_generate": 3,
+                                  "no_early_termination": True})
+        assert status == 200
+        (rid,) = out["request_ids"]
+        lines = EVENT_LOG.recent(request_id=rid)
+        seen = [l["event"] for l in lines]
+        for event in ("submitted", "first_token", "finished"):
+            assert event in seen, f"missing {event} in {seen}"
+        finished = next(l for l in lines if l["event"] == "finished")
+        assert finished["component"] == "engine"
+        assert finished["reason"] in ("length", "eos")
+        assert finished["generated"] == 3
+        first = next(l for l in lines if l["event"] == "first_token")
+        assert first["ttft_s"] > 0
+
+        span_rids = {e.get("args", {}).get("request_id")
+                     for e in svc.engine.trace.chrome_trace()["traceEvents"]}
+        assert rid in span_rids
+    finally:
+        svc.close()
+
+
+def test_no_trace_escape_hatch(model):
+    """trace=False (the --no_trace server flag): requests serve normally
+    and /trace returns an empty-but-valid document."""
+    cfg, params = model
+    svc = GenerationService(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=2, trace=False)
+    try:
+        status, out = svc.handle({"prompts": ["5 9"],
+                                  "tokens_to_generate": 3,
+                                  "no_early_termination": True})
+        assert status == 200 and len(out["text"]) == 1
+        trace = svc.trace_snapshot()
+        assert trace["traceEvents"] == []
+        assert not svc.engine.trace.enabled
+    finally:
+        svc.close()

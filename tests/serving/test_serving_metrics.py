@@ -21,17 +21,28 @@ def test_histogram_stats():
     assert h.mean() == 2.5
     assert h.percentile(0) == 1.0
     assert h.percentile(100) == 4.0
-    # the sample window is bounded; the running mean is not
+    # the sample window is bounded, and the mean covers the SAME window
+    # as the percentiles; all-time aggregates live in total_count/total
     h.observe(5.0)
-    assert h.count == 5 and h.mean() == 3.0
+    assert h.count == 5 and h.total_count == 5 and h.window_count == 4
+    assert h.mean() == 3.5  # mean over the retained window [2, 3, 4, 5]
+    assert h.total == 15.0
     assert h.percentile(0) == 2.0  # 1.0 evicted from the window
+    snap = h.snapshot()
+    assert snap["count"] == 4 and snap["total_count"] == 5
+    assert snap["mean_s"] == 3.5
 
 
 def test_empty_histogram():
     h = LatencyHistogram()
     assert h.count == 0 and h.mean() == 0.0 and h.percentile(95) == 0.0
-    assert h.snapshot() == {"count": 0, "mean_s": 0.0, "p50_s": 0.0,
-                            "p95_s": 0.0, "p99_s": 0.0}
+    assert h.snapshot() == {"count": 0, "total_count": 0, "mean_s": 0.0,
+                            "p50_s": 0.0, "p95_s": 0.0, "p99_s": 0.0}
+    # unitless reservoirs (prefix_hit_tokens) share the same helper with
+    # an empty suffix
+    assert h.snapshot(suffix="") == {"count": 0, "total_count": 0,
+                                     "mean": 0.0, "p50": 0.0, "p95": 0.0,
+                                     "p99": 0.0}
 
 
 def test_counters_gauges_and_decode_stats():

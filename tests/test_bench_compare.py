@@ -21,13 +21,18 @@ def _record(**overrides):
     rec = {
         "metric": "mfu", "value": 0.5, "unit": "fraction_of_peak",
         "vs_baseline": 4.167, "seq_length": 1024, "device": "TPU v5 lite",
+        "run_meta": {"schema_version": 2, "git_sha": "abc123def456",
+                     "jax_version": "0.9.9", "device_kind": "TPU v5 lite",
+                     "device_count": 1},
         "mfu_vs_seq": [{"seq_length": 1024, "mfu": 0.5}],
         "decode_tokens_per_sec": 3800.0,
         "decode_roofline_frac": 0.61,
         "decode_tokens_per_sec_int8": 4500.0,
         "decode_int8_roofline_frac": 0.45,
         "serving_mixed": {"serving_mixed_tokens_per_sec": 900.0,
-                          "serving_mixed_ttft_p50_s": 0.12},
+                          "serving_mixed_ttft_p50_s": 0.12,
+                          "serving_mixed_itl_ms_p50": 10.0,
+                          "serving_mixed_itl_ms_p50_untraced": 9.8},
         "serving_prefix": {"serving_prefix_ttft_speedup": 4.0,
                            "serving_prefix_hit_rate": 1.0,
                            "serving_prefix_ttft_ms_hit_p50": 3.0},
@@ -44,6 +49,9 @@ def test_flatten_surfaces_value_as_mfu_and_nests_dicts():
     assert flat["serving_mixed.serving_mixed_ttft_p50_s"] == 0.12
     assert not any(k.startswith("mfu_vs_seq") for k in flat)  # lists skip
     assert "device" not in flat  # strings skip
+    # run_meta is provenance, not measurement: a device_count or
+    # schema_version change must never read as a metric delta
+    assert not any(k.startswith("run_meta") for k in flat)
 
 
 def test_compare_no_regression():
@@ -99,6 +107,54 @@ def test_load_record_skips_progress_lines(tmp_path):
     p.write_text("# bench point decode ok (63s)\n"
                  + json.dumps(_record(value=0.31)) + "\n")
     assert bench._load_record(str(p))["value"] == 0.31
+
+
+def test_run_metadata_shape():
+    """_run_metadata stamps schema version + device geometry and (in a
+    git checkout with git available) a sha; jax version rides along when
+    importlib can see the distribution.  All failure paths degrade to
+    omission, never to an exception."""
+    meta = bench._run_metadata("TPU v5 lite", 4)
+    assert meta["schema_version"] == bench._BENCH_SCHEMA_VERSION
+    assert meta["device_kind"] == "TPU v5 lite"
+    assert meta["device_count"] == 4
+    if "git_sha" in meta:  # repo checkout: sha is a short hex string
+        assert len(meta["git_sha"]) >= 7
+        int(meta["git_sha"], 16)
+
+
+def test_trace_overhead_gate():
+    """serving_mixed ITL p50 traced vs untraced: within 10% passes, over
+    fails, and a record without the pair (old schema / int8-only run)
+    skips instead of gating."""
+    line, ok = bench.trace_overhead_check(_record())  # 10.0 vs 9.8: +2%
+    assert ok and "trace-overhead" in line
+    slow = _record(serving_mixed={
+        "serving_mixed_itl_ms_p50": 12.0,
+        "serving_mixed_itl_ms_p50_untraced": 9.8})  # +22% > 10%
+    line, ok = bench.trace_overhead_check(slow)
+    assert not ok and "REGRESSION" in line
+    line, ok = bench.trace_overhead_check(
+        _record(serving_mixed={"serving_mixed_tokens_per_sec": 900.0}))
+    assert ok and "skipped" in line
+
+
+def test_cli_compare_prints_run_meta_and_gates_trace_overhead(tmp_path):
+    """File-vs-file --compare surfaces both records' run_meta provenance
+    and fails when the current record's tracing overhead is over limit
+    even with every headline metric healthy."""
+    prev = tmp_path / "prev.json"
+    cur = tmp_path / "cur.json"
+    prev.write_text(json.dumps(_record()) + "\n")
+    cur.write_text(json.dumps(_record(serving_mixed={
+        "serving_mixed_itl_ms_p50": 20.0,
+        "serving_mixed_itl_ms_p50_untraced": 9.8})) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--compare",
+         str(prev), str(cur)], capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "run_meta" in out.stdout and "git_sha" in out.stdout
+    assert "tracing overhead over limit" in out.stdout
 
 
 def test_cli_compare_exit_codes(tmp_path):

@@ -56,7 +56,8 @@ def _ok_decode(spec):
 
 def _run_main(monkeypatch, **stubs):
     monkeypatch.setattr(bench, "_point", _stub_point(**stubs))
-    monkeypatch.setattr(bench, "_detect_device", lambda: "TPU v5 lite")
+    monkeypatch.setattr(bench, "_detect_device",
+                        lambda: ("TPU v5 lite", 1))
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
         bench.main()
