@@ -536,6 +536,30 @@ def _serving_prefix_point():
         unique_len=unique_len, gen_len=gen_len, slots=8, block=64)
 
 
+def _serving_paged_point():
+    """Paged-KV serving point (serving/block_pool.py): mixed
+    32/512/4096-token traffic at a FIXED HBM pool budget, paged 64-token
+    blocks vs the fixed-stride baseline (``kv_block_size = max_seq_len``,
+    the pre-paging one-row-per-slot layout) at the same pool bytes.
+    Fixed stride pins a full max-length row per request whatever its real
+    length, capping concurrency at the pool's whole-sequence count;
+    paging allocates per 64 tokens of actual fill.  Headline
+    ``serving_paged_max_concurrency`` gates in --compare; the acceptance
+    bar is ≥ 2x the fixed-stride concurrency at this geometry, with paged
+    ITL p50 riding along for the latency story."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.serving.bench import run_paged_serving_bench
+
+    gen_len = 64
+    cfg = _bench_model(4096 + gen_len, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return run_paged_serving_bench(
+        cfg, params, num_requests=12, prompt_lens=(32, 512, 4096),
+        gen_len=gen_len, kv_block_size=64, pool_seqs=4)
+
+
 def _transient_error_types():
     """The error classes worth retrying: the axon-tunneled compile service
     occasionally throws a transient remote-compile XlaRuntimeError.
@@ -575,7 +599,8 @@ def _retry(fn, *args, **kw):
 _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
                      "decode_int8_roofline_frac",
                      "serving_prefix.serving_prefix_ttft_speedup",
-                     "serving_prefix.serving_prefix_hit_rate")
+                     "serving_prefix.serving_prefix_hit_rate",
+                     "serving_paged.serving_paged_max_concurrency")
 _REGRESSION_TOLERANCE = 0.10
 # Tracing must stay effectively free on the serving hot path: the mixed
 # point's ITL p50 with the span recorder on may exceed the untraced rerun
@@ -769,6 +794,8 @@ def _child_main(spec_json: str) -> None:
         out = _retry(_serving_mixed_point, spec.get("quantize", False))
     elif kind == "serving_prefix":
         out = _retry(_serving_prefix_point)
+    elif kind == "serving_paged":
+        out = _retry(_serving_paged_point)
     else:  # pragma: no cover - parent and child ship together
         raise ValueError(f"unknown point kind {kind!r}")
     print(_CHILD_MARK + json.dumps(out), flush=True)
@@ -947,6 +974,10 @@ def main() -> None:
                             {"kind": "serving_prefix",
                              "platform": platform},
                             timeout_s=1200)
+    serving_paged = _point("serving/paged",
+                           {"kind": "serving_paged",
+                            "platform": platform},
+                           timeout_s=1800)
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     record = {
@@ -999,6 +1030,8 @@ def main() -> None:
         record["serving_mixed_int8"] = serving_mixed_q
     if serving_prefix is not None:
         record["serving_prefix"] = serving_prefix
+    if serving_paged is not None:
+        record["serving_paged"] = serving_paged
     if headline is not None:
         record.update({
             "value": round(mfu, 4),

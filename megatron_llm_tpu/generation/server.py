@@ -67,6 +67,8 @@ class GenerationService:
                  prefill_chunk: int | None = None,
                  pipeline_decode: bool = True,
                  prefix_cache_blocks: int | None = None,
+                 kv_block_size: int | None = None,
+                 kv_pool_blocks: int | None = None,
                  trace: bool = True):
         self.cfg = cfg
         self.params = params
@@ -98,6 +100,11 @@ class GenerationService:
         # automatic prefix caching (serving/prefix_cache.py): HBM budget
         # in blocks; 0 disables, None keeps the engine default
         self.prefix_cache_blocks = prefix_cache_blocks
+        # paged KV cache (serving/block_pool.py): block size in tokens and
+        # pool size in blocks; None keeps the engine defaults
+        # (docs/serving.md, 'Paged KV cache')
+        self.kv_block_size = kv_block_size
+        self.kv_pool_blocks = kv_pool_blocks
         # per-request span tracing (obs/trace.py, GET /trace); the CLI's
         # --no_trace escape hatch lands here
         self.trace_enabled = trace
@@ -119,6 +126,10 @@ class GenerationService:
                 extra = {}
                 if self.prefix_cache_blocks is not None:
                     extra["prefix_cache_blocks"] = self.prefix_cache_blocks
+                if self.kv_block_size is not None:
+                    extra["kv_block_size"] = self.kv_block_size
+                if self.kv_pool_blocks is not None:
+                    extra["kv_pool_blocks"] = self.kv_pool_blocks
                 self._engine = ServingEngine(
                     self.cfg, self.params,
                     EngineConfig(max_batch_size=self.max_batch_size,
@@ -167,6 +178,17 @@ class GenerationService:
             return {"traceEvents": [], "displayTimeUnit": "ms",
                     "otherData": {"dropped_events": 0}}
         return engine.trace.chrome_trace()
+
+    def kv_snapshot(self) -> dict:
+        """Debug view of the paged KV pool (GET /kv,
+        tools/dump_kv_pool.py): pool stats, per-slot block tables, ref
+        counts, fragmentation.  An engine that was never created reports
+        an empty pool."""
+        with self._engine_init_lock:
+            engine = self._engine
+        if engine is None:
+            return {"pool": None, "slots": {}}
+        return engine.kv_snapshot()
 
     def drain(self, timeout: float | None = 30.0) -> bool:
         """Stop accepting generation requests and wait for the in-flight
@@ -470,6 +492,11 @@ class _Handler(BaseHTTPRequestHandler):
             # Chrome trace-event JSON of the engine's span ring — load in
             # chrome://tracing or Perfetto (obs/trace.py)
             self._respond(200, self.service.trace_snapshot())
+            return
+        if route == "/kv":
+            # paged KV pool debug view: block tables, ref counts,
+            # fragmentation (serving/block_pool.py, tools/dump_kv_pool.py)
+            self._respond(200, self.service.kv_snapshot())
             return
         self._respond(404, "not found")
 

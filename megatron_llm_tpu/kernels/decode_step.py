@@ -314,6 +314,183 @@ def _decode_step_kernel(per_row: bool, wq8: bool, cq8: bool,
         xo_ref[...] = x_scr[...].astype(xo_ref.dtype)
 
 
+def _decode_step_kernel_paged(wq8: bool, cq8: bool,
+                              ntb: int, nm: int, block_k: int,
+                              b: int, nq: int, nkv: int, g: int, d: int,
+                              eps: float, scale: float, act,
+                              lens_ref, tbl_ref,
+                              x_ref, rot_ref, cos_ref, sin_ref, *refs):
+    # Paged twin of _decode_step_kernel, always per-row (the serving
+    # engine's slot batch).  ``lens_ref`` is [1 + b] (lens[0] = max fill,
+    # layout parity with the dense kernel; lens[1 + i] = row i's fill);
+    # ``tbl_ref`` [b, ntb] is consumed by the BlockSpec index maps only.
+    # The grid's second axis runs b*ntb attend ticks then nm MLP ticks:
+    # attend tick t streams ONE pool block — row r = t // ntb, logical
+    # block j = t % ntb — and updates ALL rows' online-softmax state
+    # under the mask (rows == r) & (cols < fill_r).  Non-r rows see only
+    # NEG_INF scores, which the recurrence treats as a no-op once the
+    # row has any real score (alpha = 1, p underflows to exactly 0.0);
+    # garbage accumulated while a row's m is still at the -1e30 start is
+    # annihilated by alpha = exp(-1e30 - s) = 0.0 at its first real
+    # score — and every row folds the new token's finite score in
+    # _finish_attn, so garbage never survives to the output.  The
+    # full-shape masked update avoids dynamic scratch indexing entirely.
+    (in_nw_ref, post_nw_ref,
+     wq_ref, wk_ref, wv_ref, wo_ref,
+     wg_ref, wu_ref, wd_ref, *refs) = refs
+    if wq8:
+        (qs_ref, ks_ref, vs_ref, os_ref,
+         gs_ref, us_ref, ds_ref, *refs) = refs
+    kc_ref, vc_ref, *refs = refs
+    if cq8:
+        kcs_ref, vcs_ref, *refs = refs
+    (xo_ref, kr_ref, vr_ref,
+     x_scr, q_scr, kn_scr, vn_scr, ctx_scr, xn2_scr,
+     m_scr, l_scr, acc_scr) = refs
+    li = pl.program_id(0)
+    ki = pl.program_id(1)
+    n_layers = pl.num_programs(0)
+    nk = b * ntb                                        # attend ticks
+    f32 = jnp.float32
+    cdt = x_ref.dtype if wq8 else wq_ref.dtype
+
+    @pl.when(jnp.logical_and(li == 0, ki == 0))
+    def _first():
+        x_scr[...] = x_ref[...].astype(f32)
+        ctx_scr[...] = jnp.zeros(ctx_scr.shape, f32)
+
+    phases = _phases()
+
+    @pl.when(jnp.logical_and(ki == 0, "project" in phases))
+    def _project():
+        x = x_scr[...]                                   # (b_pad, h) f32
+        nw = in_nw_ref[0].astype(f32)                    # (1, h)
+        xn = x * jax.lax.rsqrt(
+            jnp.mean(x * x, axis=-1, keepdims=True) + eps) * nw
+        xnc = xn.astype(cdt)
+        rot = rot_ref[...]                               # (d, d) pair swap
+        dims = (((1,), (0,)), ((), ()))
+
+        def rope_head(y):  # (b_pad, d) f32 → rotated at each row's pos
+            z = jax.lax.dot_general(y, rot, dims, preferred_element_type=f32)
+            return y * cos_ref[...] + z * sin_ref[...]
+
+        def wmat(ref):  # int8 tiles convert in-register; HBM stays int8
+            return ref[0].astype(cdt) if wq8 else ref[0]
+
+        q = jax.lax.dot_general(xnc, wmat(wq_ref), dims,
+                                preferred_element_type=f32)
+        k = jax.lax.dot_general(xnc, wmat(wk_ref), dims,
+                                preferred_element_type=f32)
+        v = jax.lax.dot_general(xnc, wmat(wv_ref), dims,
+                                preferred_element_type=f32)
+        if wq8:
+            q = q * qs_ref[0]
+            k = k * ks_ref[0]
+            v = v * vs_ref[0]
+        for j in range(nkv):
+            kj = rope_head(k[:, j * d:(j + 1) * d])
+            vj = v[:, j * d:(j + 1) * d]
+            if cq8:
+                kj = fake_quantize_rows(kj)
+                vj = fake_quantize_rows(vj)
+            kr_ref[0, :, j, :] = kj[:b].astype(kr_ref.dtype)
+            vr_ref[0, :, j, :] = vj[:b].astype(vr_ref.dtype)
+            kn_scr[:, j, :] = kj[:b]
+            vn_scr[:, j, :] = vj[:b]
+        for hq in range(nq):
+            qh = rope_head(q[:, hq * d:(hq + 1) * d])
+            q_scr[hq % g, :, hq // g, :] = qh[:b]
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, f32)
+        l_scr[...] = jnp.zeros(l_scr.shape, f32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, f32)
+
+    @pl.when(jnp.logical_and(ki < nk, "attn" in phases))
+    def _attend():
+        r = ki // ntb
+        j = ki - r * ntb
+        k4 = kc_ref[0, 0].astype(f32)                    # (nkv, bk, d)
+        v4 = vc_ref[0, 0].astype(f32)
+        if cq8:
+            k4 = k4 * kcs_ref[0, 0]                      # ×(nkv, bk, 1)
+            v4 = v4 * vcs_ref[0, 0]
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_k), 2)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (b, 1, 1), 0)
+        in_range = jnp.logical_and(rows == r, cols < lens_ref[1 + r])
+        for gg in range(g):
+            qv = q_scr[gg]                               # (b, nkv, d) f32
+            s = jnp.sum(qv[:, :, None, :] * k4[None], axis=-1) * scale
+            s = jnp.where(in_range, s, NEG_INF)          # (b, nkv, bk)
+            m_prev = m_scr[gg][:, :, :1]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_scr[gg] = jnp.broadcast_to(
+                alpha * l_scr[gg][:, :, :1]
+                + jnp.sum(p, axis=-1, keepdims=True), l_scr[gg].shape)
+            acc_scr[gg] = (acc_scr[gg] * alpha
+                           + jnp.sum(p[..., None] * v4[None], axis=2))
+            m_scr[gg] = jnp.broadcast_to(m_new, m_scr[gg].shape)
+
+    @pl.when(jnp.logical_and(ki == nk, "finish" in phases))
+    def _finish_attn():
+        kn = kn_scr[...]                                 # (b, nkv, d)
+        vn = vn_scr[...]
+        for gg in range(g):
+            qv = q_scr[gg]
+            s_new = jnp.sum(qv * kn, axis=-1, keepdims=True) * scale
+            m_prev = m_scr[gg][:, :, :1]
+            m_fin = jnp.maximum(m_prev, s_new)
+            alpha = jnp.exp(m_prev - m_fin)
+            p_new = jnp.exp(s_new - m_fin)
+            l_fin = alpha * l_scr[gg][:, :, :1] + p_new
+            ctx = ((acc_scr[gg] * alpha + p_new * vn)
+                   / jnp.where(l_fin == 0.0, 1.0, l_fin))  # (b, nkv, d)
+            for j in range(nkv):
+                hq = j * g + gg
+                ctx_scr[:b, hq * d:(hq + 1) * d] = ctx[:, j, :]
+
+        dims = (((1,), (0,)), ((), ()))
+        w_o = wo_ref[0].astype(cdt) if wq8 else wo_ref[0]
+        attn = jax.lax.dot_general(
+            ctx_scr[...].astype(cdt), w_o, dims,
+            preferred_element_type=f32)                   # (b_pad, h)
+        if wq8:
+            attn = attn * os_ref[0]
+        x1 = x_scr[...] + attn
+        nw2 = post_nw_ref[0].astype(f32)
+        xn2_scr[...] = x1 * jax.lax.rsqrt(
+            jnp.mean(x1 * x1, axis=-1, keepdims=True) + eps) * nw2
+        x_scr[...] = x1
+
+    @pl.when(jnp.logical_and(ki >= nk, "finish" in phases))
+    def _mlp_chunk():
+        dims = (((1,), (0,)), ((), ()))
+        xn2c = xn2_scr[...].astype(cdt)
+        w_g = wg_ref[0].astype(cdt) if wq8 else wg_ref[0]
+        w_u = wu_ref[0].astype(cdt) if wq8 else wu_ref[0]
+        w_d = wd_ref[0].astype(cdt) if wq8 else wd_ref[0]
+        gate = jax.lax.dot_general(xn2c, w_g, dims,
+                                   preferred_element_type=f32)
+        up = jax.lax.dot_general(xn2c, w_u, dims,
+                                 preferred_element_type=f32)
+        if wq8:
+            gate = gate * gs_ref[0]
+            up = up * us_ref[0]
+        hid = (act(gate) * up).astype(cdt)
+        part = jax.lax.dot_general(hid, w_d, dims,
+                                   preferred_element_type=f32)
+        if wq8:
+            part = part * ds_ref[0]
+        x_scr[...] = x_scr[...] + part
+
+    @pl.when(jnp.logical_and(li == n_layers - 1, ki == nk + nm - 1))
+    def _emit():
+        xo_ref[...] = x_scr[...].astype(xo_ref.dtype)
+
+
 def rope_rotation_matrix(cos: jax.Array, sin: jax.Array,
                          pos: jax.Array, d: int) -> jax.Array:
     """[d, d] linear map equal to interleaved-pair RoPE at ``pos``.
@@ -349,39 +526,33 @@ def _pair_swap_matrix(d: int) -> jax.Array:
     return p
 
 
-def fused_decode_eligible(cfg, params, k_cache, s: int,
-                          platform: str) -> bool:
-    """Static predicate for the fused path (see module docstring scope).
-
-    Factored out (same pattern as ops/attention.decode_kernel_eligible)
-    so CPU tests can assert both the accept and every reject arm.
-    """
+def _stack_eligible(cfg, params, platform: str):
+    """Config/params portion of the fused-decode predicates, shared by the
+    dense and paged variants.  Returns None when the stack cannot fuse,
+    else the ``wq8`` flag (all seven projections int8-quantized)."""
     from ..config import PositionEmbeddingType
     from ..ops.activations import is_glu
     from ..ops.attention import _mesh_active
-    from ..ops.kv_quant import is_quantized_cache
     from ..ops.quant import is_quantized
 
     if not getattr(cfg, "fused_decode", True) or platform != "tpu":
-        return False
+        return None
     if _mesh_active():
         # sharded caches/params: the kernel is single-device; the mesh
         # paths keep the composed stack (ops/attention shard_map kernels)
-        return False
-    if s != 1:
-        return False
+        return None
     if (cfg.norm_type != "rmsnorm" or cfg.parallel_attn
             or cfg.num_experts > 0 or cfg.use_bias or cfg.qkv_bias
             or not is_glu(cfg.activation)
             or cfg.activation not in _GLU_BASE
             or cfg.quantize_matmuls != "none"
             or cfg.position_embedding_type != PositionEmbeddingType.ROTARY):
-        return False
+        return None
     layers = params["layers"]
     if "mlp_norm" in layers:
-        return False
+        return None
     if not (is_glu(cfg.activation) and "w_gate" in layers["mlp"]):
-        return False
+        return None
     # int8 weights fuse when ALL seven projections are quantized — a
     # partially-quantized stack (quantize_params never produces one)
     # would need per-projection kernel variants, so it keeps the
@@ -392,21 +563,67 @@ def fused_decode_eligible(cfg, params, k_cache, s: int,
                    layers["mlp"]["w_down"])
     quant_flags = {is_quantized(w) for w in projections}
     if len(quant_flags) != 1:
-        return False
+        return None
     wq8 = quant_flags.pop()
-    cq8 = is_quantized_cache(k_cache)
-    kc = k_cache["q"] if cq8 else k_cache
     d = cfg.head_dim
     h = cfg.hidden_size
-    max_len = kc.shape[3]
-    b = kc.shape[1]
     if not (d % 128 == 0 and h % 128 == 0 and cfg.ffn_size % 128 == 0
             and (cfg.num_attention_heads * d) % 128 == 0
-            and (cfg.kv_heads * d) % 128 == 0
-            and max_len % 128 == 0):
+            and (cfg.kv_heads * d) % 128 == 0):
+        return None
+    return wq8
+
+
+def fused_decode_eligible(cfg, params, k_cache, s: int,
+                          platform: str) -> bool:
+    """Static predicate for the fused path (see module docstring scope).
+
+    Factored out (same pattern as ops/attention.decode_kernel_eligible)
+    so CPU tests can assert both the accept and every reject arm.
+    """
+    from ..ops.kv_quant import is_quantized_cache
+
+    if s != 1:
         return False
-    w_item = 1 if wq8 else layers["attn"]["wq"].dtype.itemsize
+    wq8 = _stack_eligible(cfg, params, platform)
+    if wq8 is None:
+        return False
+    cq8 = is_quantized_cache(k_cache)
+    kc = k_cache["q"] if cq8 else k_cache
+    max_len = kc.shape[3]
+    b = kc.shape[1]
+    if max_len % 128 != 0:
+        return False
+    w_item = 1 if wq8 else params["layers"]["attn"]["wq"].dtype.itemsize
     return _pick_block_k(cfg, b, max_len, w_item, kc.dtype.itemsize) >= 128
+
+
+def fused_paged_decode_eligible(cfg, params, k_pool, n_slots: int,
+                                table_blocks: int, platform: str) -> bool:
+    """Static predicate for the PAGED fused path (fused_decode_step_paged).
+
+    Same stack scope as fused_decode_eligible, with the shape checks on
+    the pool geometry: the kernel's cache tile IS the pool block, so the
+    block size must be a legal (>= 128, lane-aligned) Mosaic tile and one
+    block per (batch-row, layer) must fit the VMEM estimate."""
+    from ..ops.kv_quant import is_quantized_cache
+
+    if n_slots < 1 or table_blocks < 1:
+        return False
+    wq8 = _stack_eligible(cfg, params, platform)
+    if wq8 is None:
+        return False
+    cq8 = is_quantized_cache(k_pool)
+    kc = k_pool["q"] if cq8 else k_pool
+    block_k = kc.shape[3]
+    if block_k % 128 != 0:
+        return False
+    w_item = 1 if wq8 else params["layers"]["attn"]["wq"].dtype.itemsize
+    # one row's single block streams per tick (cache_rows=1): the cache
+    # VMEM term loses its batch factor, but the broadcast-reduce scratch
+    # is still over all b rows (the masked no-op trick computes them all)
+    return _vmem_fit(cfg, n_slots, block_k, w_item,
+                     1 if cq8 else kc.dtype.itemsize, cache_rows=1)
 
 
 def _mlp_chunks(ffn: int, cap: int = 4) -> int:
@@ -445,7 +662,8 @@ def _pick_block_k(cfg, b: int, max_len: int, weight_itemsize: int,
 
 def _vmem_fit(cfg, b: int, block_k: int, weight_itemsize: int,
               cache_itemsize: int,
-              budget: int = 100 * 1024 * 1024) -> bool:
+              budget: int = 100 * 1024 * 1024,
+              cache_rows: int | None = None) -> bool:
     """Whole-layer-resident VMEM estimate: the kernel holds one layer's
     weights + two KV blocks, double-buffered, plus fp32 scratch.  Layers
     wider than the budget (e.g. 7B-width: ~354 MB/layer bf16) must keep
@@ -460,7 +678,10 @@ def _vmem_fit(cfg, b: int, block_k: int, weight_itemsize: int,
     nq, nkv, ffn = cfg.num_attention_heads, cfg.kv_heads, cfg.ffn_size
     weight_elts = (h * nq * d + 2 * h * nkv * d + nq * d * h
                    + (3 if cfg.is_glu else 2) * h * ffn // _mlp_chunks(ffn))
-    cache_elts = 2 * b * nkv * block_k * d
+    # paged mode streams one row's block per tick (cache_rows=1); dense
+    # mode streams all b rows' blocks together
+    cache_elts = 2 * (b if cache_rows is None else cache_rows) \
+        * nkv * block_k * d
     blocks = (weight_elts * weight_itemsize
               + cache_elts * cache_itemsize) * 2  # double-buffered
     b_pad = max(8, -(-b // 8) * 8)
@@ -702,4 +923,194 @@ def fused_decode_step(
         ),
         interpret=interpret,
     )(lens, *operands)
+    return hidden[:b], k_rows[:, :, :, None, :], v_rows[:, :, :, None, :]
+
+
+def fused_decode_step_paged(
+    cfg,
+    stacked,             # params["layers"]: stacked [L, ...] pytree
+    x: jax.Array,        # [b, h] — embedded hidden of the ONE new token
+    k_pool,              # [L, n_blocks, kv_heads, block, d] pool pytree,
+    #                      or the int8 {"q", "scale"} dict form
+    v_pool,
+    tables: jax.Array,   # [b, T] int32 per-slot block tables
+    fills: jax.Array,    # [b] int32 per-row fills (free slots at 0)
+    rope: tuple,         # (cos, sin) tables from rope_tables(cfg)
+    *,
+    interpret: bool | None = None,
+):
+    """Paged fused decode step: the dense kernel's contract — returns
+    ``(hidden [b, h], k_rows [L, b, kv, 1, d], v_rows ...)`` — with the
+    KV cache read DIRECTLY from the serving block pool via per-slot
+    block tables; no dense [b, width] cache is ever materialized.
+
+    The cache tile is one pool block, so HBM cache traffic is the sum of
+    each row's live blocks (a 32-token neighbour costs one block while a
+    4k-token row costs its 32) instead of b x the deepest row.  The
+    caller writes the returned rows into the pool with
+    models/model.py:cache_append_rows (quantizing first for an int8
+    pool) — the same single-write-point contract as the dense kernel.
+    """
+    from ..ops.kv_quant import is_quantized_cache
+    from ..ops.quant import is_quantized
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    cq8 = is_quantized_cache(k_pool)
+    k_arr = k_pool["q"] if cq8 else k_pool
+    v_arr = v_pool["q"] if cq8 else v_pool
+    b, h = x.shape
+    L, _, nkv, block_k, d = k_arr.shape
+    ntb = tables.shape[1]
+    nq = cfg.num_attention_heads
+    g = nq // nkv
+    ffn = cfg.ffn_size
+    eps = float(cfg.norm_eps)
+    scale = 1.0 / float(np.sqrt(d))
+    act = _GLU_BASE[cfg.activation]
+    nk = b * ntb                       # one attend tick per (row, block)
+    nm = _mlp_chunks(ffn)
+    f_chunk = ffn // nm
+
+    b_pad = max(8, -(-b // 8) * 8)
+    x_p = x if b_pad == b else jnp.pad(x, ((0, b_pad - b), (0, 0)))
+    fills = jnp.asarray(fills, jnp.int32)
+    tables = jnp.asarray(tables, jnp.int32)
+    lens = jnp.concatenate([jnp.max(fills)[None], fills])
+    # interleaved-pair RoPE at each row's own position, factored as
+    # x·C + (x·P)·S so the kernel needs no per-row matrices
+    c_half = rope[0][fills, :d // 2].astype(jnp.float32)  # (b, d/2)
+    s_half = rope[1][fills, :d // 2].astype(jnp.float32)
+    sign = jnp.where(jnp.arange(d) % 2 == 0, -1.0, 1.0)
+    c_rows = jnp.repeat(c_half, 2, axis=-1)
+    s_rows = jnp.repeat(s_half, 2, axis=-1) * sign[None, :]
+    if b_pad != b:
+        c_rows = jnp.pad(c_rows, ((0, b_pad - b), (0, 0)))
+        s_rows = jnp.pad(s_rows, ((0, b_pad - b), (0, 0)))
+    rot = _pair_swap_matrix(d)
+
+    attn_p, mlp_p = stacked["attn"], stacked["mlp"]
+    wq8 = is_quantized(attn_p["wq"])
+
+    def wm(w):
+        return w["q"] if wq8 else w
+
+    weight_scales = (
+        attn_p["wq"]["scale"][:, None, :], attn_p["wk"]["scale"][:, None, :],
+        attn_p["wv"]["scale"][:, None, :], attn_p["wo"]["scale"][:, None, :],
+        mlp_p["w_gate"]["scale"][:, None, :],
+        mlp_p["w_up"]["scale"][:, None, :],
+        mlp_p["w_down"]["scale"][:, None, :],
+    ) if wq8 else ()
+    # int8 pool scales are [L, nb, kv, block] fp32 → trailing unit dim
+    # keeps the (block_k, 1) block legal (flash_decode _scale_block_spec)
+    cache_scales = (k_pool["scale"][..., None],
+                    v_pool["scale"][..., None]) if cq8 else ()
+    operands = (
+        x_p, rot, c_rows, s_rows,
+        stacked["input_norm"]["scale"][:, None, :],
+        stacked["post_attn_norm"]["scale"][:, None, :],
+        wm(attn_p["wq"]), wm(attn_p["wk"]), wm(attn_p["wv"]),
+        wm(attn_p["wo"]),
+        wm(mlp_p["w_gate"]), wm(mlp_p["w_up"]), wm(mlp_p["w_down"]),
+        *weight_scales,
+        k_arr, v_arr, *cache_scales,
+    )
+
+    # index maps take BOTH prefetched scalars (lens, tables) — varargs
+    # keeps the fixed/per-layer specs agnostic to how many ride along
+    def fixed(shape):
+        return pl.BlockSpec(shape, lambda li, ki, *s: (0,) * len(shape))
+
+    def per_layer(shape):
+        return pl.BlockSpec(
+            (1,) + shape, lambda li, ki, *s: (li,) + (0,) * len(shape))
+
+    def cache_spec(trailing):
+        # attend tick t = r*ntb + j fetches row r's logical block j via
+        # its table, clamped at the row's own last live block — so HBM
+        # traffic is the sum of per-row fills; an empty row's walk lands
+        # on the trash block (one fetch, fully masked).  MLP ticks clamp
+        # to the final attend tick, adding no traffic.
+        def idx(li, ki, lens, tbl):
+            t = jnp.minimum(ki, nk - 1)
+            r = t // ntb
+            j = t - r * ntb
+            last = jnp.maximum(lens[1 + r] - 1, 0) // block_k
+            return (li, tbl[r, jnp.minimum(j, last)], 0, 0, 0)
+        return pl.BlockSpec((1, 1, nkv, block_k, trailing), idx)
+
+    def mlp_col_spec():
+        def idx(li, ki, *s):
+            return (li, 0, jnp.clip(ki - nk, 0, nm - 1))
+        return pl.BlockSpec((1, h, f_chunk), idx)
+
+    def mlp_row_spec():
+        def idx(li, ki, *s):
+            return (li, jnp.clip(ki - nk, 0, nm - 1), 0)
+        return pl.BlockSpec((1, f_chunk, h), idx)
+
+    def mlp_scale_spec():
+        def idx(li, ki, *s):
+            return (li, 0, jnp.clip(ki - nk, 0, nm - 1))
+        return pl.BlockSpec((1, 1, f_chunk), idx)
+
+    weight_scale_specs = [
+        per_layer((1, nq * d)), per_layer((1, nkv * d)),
+        per_layer((1, nkv * d)), per_layer((1, h)),
+        mlp_scale_spec(), mlp_scale_spec(), per_layer((1, h)),
+    ] if wq8 else []
+    in_specs = [
+        fixed((b_pad, h)), fixed((d, d)),
+        fixed((b_pad, d)), fixed((b_pad, d)),
+        per_layer((1, h)), per_layer((1, h)),
+        per_layer((h, nq * d)), per_layer((h, nkv * d)),
+        per_layer((h, nkv * d)), per_layer((nq * d, h)),
+        mlp_col_spec(), mlp_col_spec(), mlp_row_spec(),
+        *weight_scale_specs,
+        cache_spec(d), cache_spec(d),
+        *([cache_spec(1), cache_spec(1)] if cq8 else []),
+    ]
+    out_specs = [
+        fixed((b_pad, h)),
+        per_layer((b, nkv, d)), per_layer((b, nkv, d)),
+    ]
+    row_dt = jnp.float32 if cq8 else k_arr.dtype
+    out_shape = [
+        jax.ShapeDtypeStruct((b_pad, h), x.dtype),
+        jax.ShapeDtypeStruct((L, b, nkv, d), row_dt),
+        jax.ShapeDtypeStruct((L, b, nkv, d), row_dt),
+    ]
+    scratch = [
+        pltpu.VMEM((b_pad, h), jnp.float32),           # residual stream
+        pltpu.VMEM((g, b, nkv, d), jnp.float32),       # rotated q
+        pltpu.VMEM((b, nkv, d), jnp.float32),          # new-token k
+        pltpu.VMEM((b, nkv, d), jnp.float32),          # new-token v
+        pltpu.VMEM((b_pad, nq * d), jnp.float32),      # attention context
+        pltpu.VMEM((b_pad, h), jnp.float32),           # staged MLP input
+        pltpu.VMEM((g, b, nkv, 128), jnp.float32),     # online-softmax m
+        pltpu.VMEM((g, b, nkv, 128), jnp.float32),     # online-softmax l
+        pltpu.VMEM((g, b, nkv, d), jnp.float32),       # online-softmax acc
+    ]
+
+    compiler_params_cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    hidden, k_rows, v_rows = pl.pallas_call(
+        functools.partial(_decode_step_kernel_paged, wq8, cq8,
+                          ntb, nm, block_k,
+                          b, nq, nkv, g, d, eps, scale, act),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(L, nk + nm),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch,
+        ),
+        out_shape=out_shape,
+        compiler_params=compiler_params_cls(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(lens, tables, *operands)
     return hidden[:b], k_rows[:, :, :, None, :], v_rows[:, :, :, None, :]

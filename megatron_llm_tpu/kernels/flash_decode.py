@@ -10,6 +10,19 @@ the GQA group) and reads the dynamic fill level from SMEM, so work beyond
 
 Layout contract (models/model.py:init_kv_cache): cache [b, kv, max_len, d],
 q [b, kv·group, d] for a single new token.
+
+Paged mode (``flash_decode_paged*``): the cache operands are one layer's
+view of the serving block pool — ``[n_blocks, kv, block, d]`` — plus a
+per-row int32 block table ``[b, T]`` mapping each row's logical block j
+to a physical pool block.  The kernel bodies are IDENTICAL (the mask is
+over logical columns ``j*block + lane`` exactly as in the dense walk);
+only the BlockSpec index maps change: the cache block for grid tick
+``ki`` is ``table[bi, min(ki, last_bi)]``, where ``last_bi`` clamps at
+row bi's own fill — so HBM traffic is the sum of per-row fills, not
+``b * max_len``.  Entries past a row's fill point at the pool's trash
+block; their scores are replaced with NEG_INF before the softmax, so
+trash contents can never reach the output (exp underflows to exactly
+0.0 and 0.0 x finite = 0.0).
 """
 
 from __future__ import annotations
@@ -190,6 +203,128 @@ def _scale_block_spec(block_k):
     # block with a size-1 sublane dim is rejected by the Mosaic lowering).
     return pl.BlockSpec((1, 1, block_k, 1),
                         lambda bi, hi, ki, lens: (bi, hi, ki, 0))
+
+
+def _paged_body(kernel_fn):
+    """Adapter for the paged harness: the block-table scalar operand is
+    consumed only by the BlockSpec index maps, so it is dropped before
+    the refs reach the shared kernel body."""
+    def body(scale, nk, block_k, len_ref, tbl_ref, *refs):
+        return kernel_fn(scale, nk, block_k, len_ref, *refs)
+    return body
+
+
+def _paged_cache_spec(block_k, d):
+    # tick ki fetches row bi's logical block ki via its table, clamped at
+    # the row's own last live block — blocks past the fill (and the whole
+    # walk of an empty row, which lands on the trash block) cost no extra
+    # bytes beyond one block and are fully masked in the kernel
+    def idx(bi, hi, ki, lens, tbl):
+        last = jnp.maximum(lens[bi] - 1, 0) // block_k
+        return (tbl[bi, jnp.minimum(ki, last)], hi, 0, 0)
+    return pl.BlockSpec((1, 1, block_k, d), idx)
+
+
+def _paged_scale_spec(block_k):
+    # same walk as _paged_cache_spec; trailing unit dim as _scale_block_spec
+    def idx(bi, hi, ki, lens, tbl):
+        last = jnp.maximum(lens[bi] - 1, 0) // block_k
+        return (tbl[bi, jnp.minimum(ki, last)], hi, 0, 0)
+    return pl.BlockSpec((1, 1, block_k, 1), idx)
+
+
+def _paged_decode_call(kernel_fn, q, caches, tables, cache_len,
+                       softmax_scale, interpret, extra_in_specs):
+    """Paged twin of _decode_call: cache operands are pool-layer views
+    ``[n_blocks, kv, block_k, d]``, the grid's k axis walks the ``T``
+    block-table columns, and both scalars (per-row fills AND the block
+    tables) prefetch so the index maps can resolve physical blocks."""
+    b, n_heads, d = q.shape
+    kv_heads = caches[0].shape[1]
+    block_k = caches[0].shape[2]
+    group = n_heads // kv_heads
+    if softmax_scale is None:
+        softmax_scale = 1.0 / float(np.sqrt(d))
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if not interpret:
+        assert block_k % 128 == 0, block_k
+    nk = tables.shape[1]
+
+    g_pad = max(8, -(-group // 8) * 8)
+    qg = q.reshape(b, kv_heads, group, d)
+    if g_pad != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - group), (0, 0)))
+
+    lens = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(cache_len, jnp.int32), (-1,)), (b,))
+    tbl = jnp.asarray(tables, jnp.int32)
+
+    grid = (b, kv_heads, nk)
+    out = pl.pallas_call(
+        functools.partial(_paged_body(kernel_fn), float(softmax_scale),
+                          nk, block_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, g_pad, d),
+                             lambda bi, hi, ki, *s: (bi, hi, 0, 0)),
+            ] + extra_in_specs(block_k, d),
+            out_specs=pl.BlockSpec((1, 1, g_pad, d),
+                                   lambda bi, hi, ki, *s: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((g_pad, 128), jnp.float32),
+                pltpu.VMEM((g_pad, 128), jnp.float32),
+                pltpu.VMEM((g_pad, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv_heads, g_pad, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lens, tbl, qg, *caches)
+    return out[:, :, :group].reshape(b, n_heads, d)
+
+
+def flash_decode_paged(
+    q: jax.Array,        # [b, n_heads, d] — ONE new token's queries
+    k_pool: jax.Array,   # [n_blocks, kv_heads, block, d] — one layer's pool
+    v_pool: jax.Array,
+    tables: jax.Array,   # [b, T] int32 block tables (pad entries = trash)
+    cache_len: jax.Array,  # [b] (or scalar) valid rows incl. the new token
+    *,
+    softmax_scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """→ [b, n_heads, d]: decode attention gathered straight from the
+    paged block pool — no dense [b, max_len] cache is ever materialized."""
+    return _paged_decode_call(
+        _decode_kernel, q, [k_pool, v_pool], tables, cache_len,
+        softmax_scale, interpret,
+        lambda bk, d: [_paged_cache_spec(bk, d), _paged_cache_spec(bk, d)])
+
+
+def flash_decode_paged_int8(
+    q: jax.Array,          # [b, n_heads, d]
+    k_q: jax.Array,        # [n_blocks, kv_heads, block, d] int8 pool leaf
+    k_scale: jax.Array,    # [n_blocks, kv_heads, block] fp32 row scales
+    v_q: jax.Array,
+    v_scale: jax.Array,
+    tables: jax.Array,     # [b, T] int32
+    cache_len: jax.Array,
+    *,
+    softmax_scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Paged decode attention over the int8 ``{q, scale}`` pool form."""
+    return _paged_decode_call(
+        _decode_kernel_int8, q,
+        [k_q, k_scale[..., None], v_q, v_scale[..., None]], tables,
+        cache_len, softmax_scale, interpret,
+        lambda bk, d: [_paged_cache_spec(bk, d), _paged_scale_spec(bk),
+                       _paged_cache_spec(bk, d), _paged_scale_spec(bk)])
 
 
 def flash_decode(

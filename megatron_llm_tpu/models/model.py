@@ -270,6 +270,77 @@ def forward_cached(
     return logits.astype(jnp.float32), new_k, new_v
 
 
+def forward_cached_paged(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,   # [b, 1] int32 — one pending token per slot
+    k_pool: jax.Array,   # [L, n_blocks, kv_heads, block, head_dim] (pytree)
+    v_pool: jax.Array,
+    tables: jax.Array,   # [b, T] int32 per-slot block tables
+    fills: jax.Array,    # [b] int32 per-slot fill levels
+    *,
+    rope: Optional[tuple] = None,
+    use_fused: bool = False,
+):
+    """Single-token decode over the paged block pool.
+
+    The paged analogue of ``forward_cached`` for the serving engine's
+    slot batch: each slot's token attends the blocks its table names and
+    its new K/V row is scattered into block ``tables[s, fill//bk]`` at
+    offset ``fill % bk``.  Two routes, one caller-visible contract:
+
+    * ``use_fused=True`` — the whole-stack Pallas kernel's paged gather
+      mode (kernels/decode_step.py:fused_decode_step_paged): per-row
+      block walks read only each slot's live blocks from HBM, so decode
+      cache traffic scales with the sum of fills instead of
+      ``b * max_seq_len``.  For an int8 pool the kernel's
+      pre-requantized fp rows are re-quantized losslessly before the
+      scatter (``fake_quantize_rows`` idempotence).
+    * ``use_fused=False`` — gather the tables into a dense working view
+      (``cache_gather_blocks``) and run the ordinary ``forward_cached``
+      path over it, then scatter back only the appended rows.  Gathered
+      garbage beyond a slot's fill is masked by score replacement, so
+      both routes are bitwise-identical to a contiguously grown cache.
+
+    Returns ``(logits [b, 1, vocab] fp32, new_k_pool, new_v_pool)``.
+    """
+    if rope is None:
+        rope = rope_tables(cfg)
+    cos, sin = rope
+    fills = jnp.asarray(fills, jnp.int32)
+    tables = jnp.asarray(tables, jnp.int32)
+    bk = jax.tree.leaves(k_pool)[0].shape[3]
+    bids = jnp.take_along_axis(tables, (fills // bk)[:, None], axis=1)[:, 0]
+    offs = fills % bk
+    if use_fused:
+        from ..kernels.decode_step import fused_decode_step_paged
+        from ..ops.kv_quant import is_quantized_cache, quantize_rows
+
+        x = embed(cfg, params, tokens, fills[:, None])
+        hidden, k_rows, v_rows = fused_decode_step_paged(
+            cfg, params["layers"], x[:, 0], k_pool, v_pool, tables, fills,
+            (cos, sin))
+        if is_quantized_cache(k_pool):
+            k_rows = quantize_rows(k_rows)
+            v_rows = quantize_rows(v_rows)
+        k_pool = cache_append_rows(k_pool, k_rows, bids, offs)
+        v_pool = cache_append_rows(v_pool, v_rows, bids, offs)
+        x = norm_apply(cfg.norm_type, hidden[:, None, :],
+                       params["final_norm"], cfg.norm_eps,
+                       impl=cfg.norm_impl)
+        logits = unembed(cfg, params, x)
+        return logits.astype(jnp.float32), k_pool, v_pool
+    k_dense = cache_gather_blocks(k_pool, tables)
+    v_dense = cache_gather_blocks(v_pool, tables)
+    logits, k_dense, v_dense = forward_cached(
+        cfg, params, tokens, k_dense, v_dense, fills, rope=rope)
+    k_pool = cache_append_rows(
+        k_pool, cache_rows_at(k_dense, fills), bids, offs)
+    v_pool = cache_append_rows(
+        v_pool, cache_rows_at(v_dense, fills), bids, offs)
+    return logits, k_pool, v_pool
+
+
 def init_kv_cache(cfg: ModelConfig, batch_size: int, max_len: int,
                   dtype=None):
     """Allocate an empty stacked KV cache ([L, b, kv_heads, max_len, d] ×2).
@@ -292,6 +363,102 @@ def init_kv_cache(cfg: ModelConfig, batch_size: int, max_len: int,
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, batch_size, cfg.kv_heads, max_len, cfg.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def init_kv_pool(cfg: ModelConfig, n_blocks: int, block_size: int,
+                 dtype=None):
+    """Allocate an empty paged KV block pool ([L, n_blocks, kv_heads,
+    block_size, d] ×2) — the same layout family as ``init_kv_cache`` with
+    the batch axis reinterpreted as the block axis, so every cache-family
+    helper (and the int8 ``{"q", "scale"}`` pytree form) applies verbatim.
+
+    The paged serving engine (serving/block_pool.py) owns one pool and
+    hands out blocks by integer id; block 0 is reserved as the trash
+    block so fixed-arity gathers/scatters can point unused table entries
+    somewhere harmless."""
+    return init_kv_cache(cfg, n_blocks, block_size, dtype)
+
+
+def cache_gather_blocks(pool, tables):
+    """Gather per-slot block tables into a dense working cache.
+
+    ``pool`` leaves are [L, n_blocks, kv, bk(, d)]; ``tables`` is an
+    [S, T] int32 block-id matrix (entries past a slot's fill point at the
+    trash block).  Returns leaves [L, S, kv, T·bk(, d)] — the dense
+    layout every existing attention/decode path consumes.  Rows gathered
+    from trash or beyond-fill blocks hold finite garbage that the decode
+    attention masks by *replacing* scores with NEG_INF, so the gathered
+    view is bitwise-equivalent to a contiguously grown cache.
+    """
+    S, T = tables.shape
+    flat = tables.reshape(-1)
+
+    def g(a):
+        L, _, kv, bk = a.shape[:4]
+        tail = tuple(a.shape[4:])
+        x = jnp.take(a, flat, axis=1)                # [L, S·T, kv, bk(,d)]
+        x = x.reshape((L, S, T, kv, bk) + tail)
+        x = jnp.moveaxis(x, 2, 3)                    # [L, S, kv, T, bk(,d)]
+        return x.reshape((L, S, kv, T * bk) + tail)
+
+    return jax.tree.map(g, pool)
+
+
+def cache_scatter_blocks(pool, dense, bids):
+    """Publish a batch-1 dense cache's blocks into pool blocks ``bids``.
+
+    ``dense`` leaves are [L, 1, kv, T·bk(, d)] (an admission prefill
+    cache); block i of the dense sequence axis lands in pool block
+    ``bids[i]``.  Entries pointing at the trash block (id 0) are how the
+    caller skips publishing a block (shared prefix blocks, padding past
+    the prompt) while keeping ONE fixed-arity compiled scatter; duplicate
+    trash writes are harmless because trash contents are never unmasked.
+    """
+    bids = jnp.asarray(bids, jnp.int32)
+
+    def sc(p, d_):
+        L, _, kv, W = d_.shape[:4]
+        tail = tuple(d_.shape[4:])
+        bk = p.shape[3]
+        T = W // bk
+        x = d_[:, 0].reshape((L, kv, T, bk) + tail)
+        x = jnp.moveaxis(x, 2, 1)                    # [L, T, kv, bk(,d)]
+        return p.at[:, bids].set(x.astype(p.dtype))
+
+    return jax.tree.map(sc, pool, dense)
+
+
+def cache_append_rows(pool, rows, bids, offs):
+    """Scatter one new K/V row per slot into the pool.
+
+    ``rows`` leaves are [L, S, kv, 1(, d)] (the rows a decode step
+    appended, extracted from the dense working view or returned by the
+    fused kernel); slot s's row lands at offset ``offs[s]`` of pool block
+    ``bids[s]``.  Inactive slots target (trash, 0).  The int8 {q, scale}
+    pytree scatters leaf-wise, so quantized rows move verbatim."""
+    bids = jnp.asarray(bids, jnp.int32)
+    offs = jnp.asarray(offs, jnp.int32)
+
+    def ap(p, r):
+        # p[:, bids, :, offs]: non-adjacent advanced indices put the
+        # broadcast (slot) axis first — update shape [S, L, kv(, d)]
+        upd = jnp.moveaxis(r[:, :, :, 0], 1, 0)
+        return p.at[:, bids, :, offs].set(upd.astype(p.dtype))
+
+    return jax.tree.map(ap, pool, rows)
+
+
+def cache_rows_at(dense, fills):
+    """Extract each slot's row at its own fill level from a dense cache
+    ([L, S, kv, W(, d)] leaves → [L, S, kv, 1(, d)]) — the rows the
+    decode step just appended, ready for ``cache_append_rows``."""
+    fills = jnp.asarray(fills, jnp.int32)
+
+    def f(a):
+        idx = fills.reshape((1, -1) + (1,) * (a.ndim - 2))
+        return jnp.take_along_axis(a, idx, axis=3)
+
+    return jax.tree.map(f, dense)
 
 
 def cache_slot_update(cache, slot_cache, slot):

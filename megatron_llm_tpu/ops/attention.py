@@ -290,6 +290,77 @@ def decode_attention(
     return out.reshape(b, s, n_heads, d)
 
 
+def paged_decode_kernel_eligible(s: int, d: int, block: int,
+                                 platform: str) -> bool:
+    """Shape/platform predicate for the paged Pallas decode path: the
+    kernel's cache tile is one pool block, so the block itself must be a
+    legal Mosaic tile."""
+    return (s == 1 and d % 128 == 0 and block % 128 == 0
+            and platform == "tpu")
+
+
+def paged_decode_attention(
+    q: jax.Array,        # [b, s, n_heads, d] — the new tokens' queries
+    k_pool,              # [n_blocks, kv_heads, block, d] — ONE layer's
+    v_pool,              # pool view, or int8 {"q", "scale"} dicts
+    tables: jax.Array,   # [b, T] int32 block tables (pad entries = trash)
+    cache_len,           # int32 scalar or [b]: position of q's first token
+    *,
+    softmax_scale: float | None = None,
+) -> jax.Array:
+    """Decode attention over a paged KV pool via per-slot block tables.
+
+    On an eligible TPU shape this dispatches the paged Pallas kernels
+    (kernels/flash_decode.py:flash_decode_paged*), which resolve blocks
+    inside the BlockSpec index maps — no dense cache is materialized and
+    HBM traffic is the sum of per-row fills.  Everywhere else it gathers
+    the tables into the dense ``[b, kv, T*block, d]`` view (one take per
+    leaf) and reuses ``decode_attention`` verbatim, so both routes share
+    the masking/softmax math bit-for-bit.  Entries past a row's fill
+    point at the pool's trash block; the masks replace their scores
+    before the softmax, so trash contents can never reach the output.
+    """
+    from .kv_quant import is_quantized_cache
+
+    kv_q = is_quantized_cache(k_pool)
+    k_arr = k_pool["q"] if kv_q else k_pool
+    b, s, n_heads, d = q.shape
+    _, kv_heads, block, _ = k_arr.shape
+
+    if paged_decode_kernel_eligible(s, d, block, _backend()) \
+            and not _mesh_active():
+        if kv_q:
+            from ..kernels.flash_decode import flash_decode_paged_int8
+
+            out = flash_decode_paged_int8(
+                q[:, 0], k_pool["q"], k_pool["scale"],
+                v_pool["q"], v_pool["scale"], tables,
+                jnp.asarray(cache_len, jnp.int32) + 1,
+                softmax_scale=softmax_scale)
+            return out[:, None]
+        from ..kernels.flash_decode import flash_decode_paged
+
+        out = flash_decode_paged(
+            q[:, 0], k_pool, v_pool, tables,
+            jnp.asarray(cache_len, jnp.int32) + 1,
+            softmax_scale=softmax_scale)
+        return out[:, None]
+
+    # fallback: gather the dense per-row view and reuse decode_attention
+    t = tables.shape[1]
+
+    def gather(a):  # [nb, kv, block(,d)] → [b, kv, t*block(,d)]
+        x = jnp.take(a, tables.reshape(-1), axis=0)
+        x = x.reshape((b, t) + a.shape[1:])
+        x = jnp.moveaxis(x, 1, 2)
+        return x.reshape((b, a.shape[1], t * block) + a.shape[3:])
+
+    k_dense = jax.tree.map(gather, k_pool)
+    v_dense = jax.tree.map(gather, v_pool)
+    return decode_attention(q, k_dense, v_dense, cache_len,
+                            softmax_scale=softmax_scale)
+
+
 def dot_product_attention(
     q: jax.Array,  # [b, sq, n_heads, d]
     k: jax.Array,  # [b, sk, kv_heads, d]

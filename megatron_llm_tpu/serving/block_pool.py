@@ -1,0 +1,203 @@
+"""Device-resident paged KV block pool with host-side bookkeeping.
+
+The pool owns TWO pytrees (K and V) of shape ``[L, n_blocks, kv_heads,
+block_size, head_dim]`` — the ``init_kv_cache`` layout family with the
+batch axis reinterpreted as a block axis, so the int8 ``{"q", "scale"}``
+quantized-cache form works verbatim.  All allocation state (free list,
+ref counts, reservations) lives on the host as plain numpy; the device
+arrays never change shape, so every consumer compiles exactly once and
+only the integer block tables vary between steps.
+
+Conventions:
+
+* Block id 0 is the **trash block**.  It is permanently allocated and
+  every unused table entry points at it, which lets gathers and scatters
+  run at a fixed arity (pad entries read/write trash) without masking.
+  Trash contents are finite garbage; the decode attention masks by
+  REPLACING scores beyond a row's fill with -1e30, so trash rows can
+  never perturb outputs (exp underflows to exactly 0.0 in fp32 and
+  0.0 x finite = 0.0 bitwise).
+* Blocks are ref-counted.  The prefix cache pins shared prefix blocks by
+  holding a ref; a slot's table holds one ref per entry.  ``decref``
+  returns a block to the free list when the count hits zero.
+* ``ensure_writable`` implements copy-on-write at a slot's boundary
+  block: if the block about to receive appended rows is shared
+  (ref > 1), its contents are copied into a fresh block on device and
+  the table retargets — counted in the ``cow_copies_total`` metric.
+* Reservations make admission sound: the engine reserves the worst-case
+  block count for a request up front (``reserve``) and lazy per-step
+  allocation draws from that reservation (``alloc_reserved``), so a
+  decode step can never fail to find a block mid-flight.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import model as model_lib
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=())
+def _copy_block_donated(pool, src, dst):
+    def cp(a):
+        blk = jax.lax.dynamic_index_in_dim(a, src, axis=1, keepdims=True)
+        return jax.lax.dynamic_update_slice_in_dim(a, blk, dst, axis=1)
+
+    return jax.tree.map(cp, pool)
+
+
+@jax.jit
+def _copy_block_plain(pool, src, dst):
+    def cp(a):
+        blk = jax.lax.dynamic_index_in_dim(a, src, axis=1, keepdims=True)
+        return jax.lax.dynamic_update_slice_in_dim(a, blk, dst, axis=1)
+
+    return jax.tree.map(cp, pool)
+
+
+class BlockPool:
+    """Fixed pool of KV blocks + free-list / ref-count / reservation state.
+
+    ``n_blocks`` includes the reserved trash block 0, so ``n_blocks - 1``
+    blocks are actually allocatable.
+    """
+
+    TRASH = 0
+
+    def __init__(self, cfg, n_blocks: int, block_size: int,
+                 on_cow: Optional[Callable[[], None]] = None):
+        if n_blocks < 2:
+            raise ValueError("BlockPool needs at least 2 blocks "
+                             "(one is the reserved trash block)")
+        self.cfg = cfg
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self.k_pool, self.v_pool = model_lib.init_kv_pool(
+            cfg, n_blocks, block_size)
+        self._ref = np.zeros(n_blocks, dtype=np.int32)
+        self._ref[self.TRASH] = 1  # permanently pinned
+        self._free: List[int] = list(range(n_blocks - 1, 0, -1))
+        self._reserved = 0
+        self._on_cow = on_cow
+        # CPU donation aliases freed buffers in place; on accelerators we
+        # keep the plain path for the rare COW copy (simple + safe).
+        self._copy = (_copy_block_plain
+                      if jax.default_backend() == "cpu"
+                      else _copy_block_donated)
+        self.cow_copies = 0
+
+    # ------------------------------------------------------------------
+    # capacity / reservations
+    # ------------------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.n_blocks - 1 - len(self._free)
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.n_blocks - 1
+
+    @property
+    def reserved_blocks(self) -> int:
+        return self._reserved
+
+    def can_reserve(self, n: int) -> bool:
+        return len(self._free) - self._reserved >= n
+
+    def reserve(self, n: int) -> bool:
+        """Set aside ``n`` blocks for future allocation; False if the pool
+        cannot guarantee them right now."""
+        if not self.can_reserve(n):
+            return False
+        self._reserved += n
+        return True
+
+    def unreserve(self, n: int) -> None:
+        assert self._reserved >= n, "unreserve() exceeds reservation"
+        self._reserved -= n
+
+    # ------------------------------------------------------------------
+    # alloc / ref counting
+    # ------------------------------------------------------------------
+    def alloc_reserved(self) -> int:
+        """Allocate one block against an existing reservation."""
+        assert self._reserved > 0, "alloc_reserved() without reservation"
+        self._reserved -= 1
+        return self._pop_free()
+
+    def _pop_free(self) -> int:
+        assert self._free, "BlockPool exhausted despite reservation"
+        bid = self._free.pop()
+        assert self._ref[bid] == 0
+        self._ref[bid] = 1
+        return bid
+
+    def incref(self, bid: int) -> None:
+        assert bid != self.TRASH and self._ref[bid] > 0, \
+            f"incref on unallocated block {bid}"
+        self._ref[bid] += 1
+
+    def decref(self, bid: int) -> None:
+        if bid == self.TRASH:
+            return
+        assert self._ref[bid] > 0, f"double free of block {bid}"
+        self._ref[bid] -= 1
+        if self._ref[bid] == 0:
+            self._free.append(bid)
+
+    def ref(self, bid: int) -> int:
+        return int(self._ref[bid])
+
+    # ------------------------------------------------------------------
+    # copy-on-write
+    # ------------------------------------------------------------------
+    def ensure_writable(self, bid: int) -> int:
+        """Return a block id safe to append rows into.
+
+        If ``bid`` is exclusively owned it is returned as-is.  If it is
+        shared (ref > 1) — or is the trash block — a fresh block is
+        allocated against the caller's reservation, the shared contents
+        are copied on device, the caller's ref on ``bid`` is dropped, and
+        the new id is returned.
+        """
+        if bid != self.TRASH and self._ref[bid] == 1:
+            return bid
+        new = self.alloc_reserved()
+        if bid != self.TRASH:
+            self.k_pool = self._copy(self.k_pool, bid, new)
+            self.v_pool = self._copy(self.v_pool, bid, new)
+            self.decref(bid)
+            self.cow_copies += 1
+            if self._on_cow is not None:
+                self._on_cow()
+        return new
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        used = self.used_blocks
+        usable = self.usable_blocks
+        return {
+            "n_blocks": self.n_blocks,
+            "block_size": self.block_size,
+            "blocks_free": self.free_blocks,
+            "blocks_used": used,
+            "blocks_reserved": self._reserved,
+            "kv_cache_util": (used / usable) if usable else 0.0,
+            "cow_copies": self.cow_copies,
+        }
+
+    def ref_counts(self) -> dict:
+        """Non-zero ref counts by block id (trash excluded)."""
+        return {int(b): int(self._ref[b])
+                for b in np.nonzero(self._ref)[0] if b != self.TRASH}

@@ -7,11 +7,12 @@ users.  This engine replaces that: a single scheduler thread owns a
 long-lived batch KV cache (``slots.py``) and interleaves, at iteration
 granularity,
 
-1. **admission** — while a KV slot is free and the bounded queue
-   (``queue.py``) has work, prefill the next request's prompt into its own
+1. **admission** — while a KV slot is free, the bounded queue
+   (``queue.py``) has work, and the block pool can reserve the request's
+   worst-case block count, prefill the next request's prompt into its own
    batch-1 cache (one jitted forward, prompt length padded up to
-   ``prefill_bucket`` so compilations stay bounded) and splice it into the
-   free slot;
+   ``prefill_bucket`` so compilations stay bounded) and publish it into
+   freshly allocated pool blocks;
 2. **one batched decode step** — a single jitted forward over ALL active
    slots with the per-sample fill vector ``forward_cached`` already
    supports (the ragged machinery built for prompt-lookup speculative
@@ -22,11 +23,19 @@ granularity,
    batch;
 3. **retirement** — requests leave the moment they hit EOS or their token
    budget (or are cancelled); the slot returns to the free list with no
-   device work, because rows past a slot's fill level are already masked.
+   device work — every table entry just drops one ref count.
 
-Free slots still ride through the decode step (fixed shapes keep ONE
-compiled executable); their writes land at row fill=0 of a free slot and
-are fully overwritten by the next admission's whole-slot insert.
+KV memory is **paged** (``slots.py`` / ``block_pool.py``): a slot owns a
+block table over a fixed device-resident pool rather than a contiguous
+``max_seq_len`` cache row, so HBM scales with actual fill and the pool —
+not the slot count — bounds concurrency for mixed-length traffic.
+Admission reserves a request's worst-case block count up front (evicting
+unpinned prefix-cache blocks if the pool is tight, else parking the
+request until a retirement frees blocks), so the lazy per-step block
+allocation during decode can never fail.  Free slots still ride through
+the decode step (fixed shapes keep ONE compiled executable); their
+writes land in the pool's trash block, whose contents are never
+unmasked.
 
 The steady-state decode loop is **pipelined** (``EngineConfig.
 pipeline_decode``, default on): step N's sampled tokens stay on the
@@ -54,12 +63,12 @@ models/model.py:forward_cached, which routes it automatically.
 
 Admission also consults the **automatic prefix cache**
 (``EngineConfig.prefix_cache_blocks``, prefix_cache.py): a request whose
-prompt shares a block-aligned prefix with an earlier request's gets the
-cached K/V rows spliced into its admission cache and prefills only the
-uncached suffix; retiring requests donate their prefix blocks back.
-Because the spliced rows are exactly what a cold prefill would write,
-the cache is purely a prefill shortcut — TTFT drops, trajectories don't
-move.
+prompt shares a block-aligned prefix with an earlier request's takes the
+cached POOL BLOCKS into its own table by ref-count bump — zero K/V
+copies — and prefills only the uncached suffix; retiring requests donate
+their prefix blocks back the same way.  Because the shared blocks hold
+exactly what a cold prefill would write, the cache is purely a prefill
+shortcut — TTFT drops, trajectories don't move.
 
 Greedy requests reproduce the one-shot ``generation.generate_tokens``
 trajectory token-for-token (tested bitwise on CPU fp32, the same
@@ -84,6 +93,7 @@ from ..generation.sampling import NEG_INF
 from ..models import model as model_lib
 from ..obs.logging import EVENT_LOG
 from ..obs.trace import TraceRecorder, device_annotation
+from .block_pool import BlockPool
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .queue import QueueFull, RequestQueue  # noqa: F401  (re-exported)
@@ -140,6 +150,20 @@ class EngineConfig:
     #                               trace JSON (GET /trace).  Off = every
     #                               record path returns before locking.
     trace_capacity: int = 8192    # span ring size (oldest spans drop)
+    kv_block_size: int = 0        # paged KV cache block size in tokens
+    #                               (block_pool.py).  0 = follow the
+    #                               admission granularity (prefill_chunk,
+    #                               else prefill_bucket), capped at
+    #                               max_seq_len, so prefix-cache blocks ==
+    #                               pool blocks and sharing stays zero-copy.
+    kv_pool_blocks: int = 0       # total pool blocks incl. the reserved
+    #                               trash block.  0 = auto-size so every
+    #                               slot can grow to max_seq_len plus the
+    #                               prefix-cache budget (capacity-neutral
+    #                               vs the old fixed-stride cache); set it
+    #                               lower to trade worst-case headroom for
+    #                               more concurrent mixed-length requests
+    #                               at the same HBM (bench serving_paged).
 
 
 @dataclasses.dataclass
@@ -315,25 +339,40 @@ def _first_token_impl(cfg: ModelConfig, last_logits, seeds, counters,
                          top_ks, top_ps, cfg.vocab_size)
 
 
-def _decode_impl(cfg: ModelConfig, params, k_cache, v_cache, pending,
-                 fills, seeds, counters, greedy, temps, top_ks, top_ps):
+def _decode_impl(cfg: ModelConfig, params, k_pool, v_pool, tables, pending,
+                 fills, seeds, counters, greedy, temps, top_ks, top_ps, *,
+                 use_fused: bool):
     """One batched decode step over every slot: feed each slot's pending
-    token at its own fill position, append its K/V row, sample the next
-    token per slot.  Free slots ride along (fixed shapes = one compiled
-    executable); their row-0 writes are masked and replaced at the next
-    admission."""
+    token at its own fill position, scatter its K/V row into the pool
+    block its table names, sample the next token per slot.  Free slots
+    ride along (fixed shapes = one compiled executable); their reads and
+    writes target the trash block and are masked.  Only the integer
+    ``tables``/``fills`` change between steps — the pool shape is static,
+    so this stays ONE compiled executable."""
     rope = model_lib.rope_tables(cfg)
-    logits, k_cache, v_cache = model_lib.forward_cached(
-        cfg, params, pending[:, None], k_cache, v_cache, fills, rope=rope)
+    logits, k_pool, v_pool = model_lib.forward_cached_paged(
+        cfg, params, pending[:, None], k_pool, v_pool, tables, fills,
+        rope=rope, use_fused=use_fused)
     tok, tok_lp = _sample_slots(logits[:, 0], seeds, counters, greedy,
                                 temps, top_ks, top_ps, cfg.vocab_size)
-    return tok, tok_lp, k_cache, v_cache
+    return tok, tok_lp, k_pool, v_pool
 
 
 _decode_donated = functools.partial(
-    jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3))(_decode_impl)
+    jax.jit, static_argnames=("cfg", "use_fused"),
+    donate_argnums=(2, 3))(_decode_impl)
 _decode_plain = functools.partial(
-    jax.jit, static_argnames=("cfg",))(_decode_impl)
+    jax.jit, static_argnames=("cfg", "use_fused"))(_decode_impl)
+
+
+@jax.jit
+def _gather_lease_impl(k_pool, v_pool, table):
+    """Materialize a prefix lease's shared blocks as a batch-1 dense
+    admission cache (leaves ``[L, 1, kv, width(, d)]``) in one fixed-arity
+    gather — the suffix prefill attends the shared rows through this view;
+    rows past the match are trash garbage no causal position ever sees."""
+    return (model_lib.cache_gather_blocks(k_pool, table),
+            model_lib.cache_gather_blocks(v_pool, table))
 
 
 @jax.jit
@@ -472,6 +511,10 @@ class ServingEngine:
             else _prefill_chunk_donated)
         self._thread: Optional[threading.Thread] = None
         self._admitting: Optional[_Request] = None  # popped, not yet slotted
+        self._held: Optional[_Request] = None  # popped but parked: the pool
+        #                               could not reserve its worst-case
+        #                               block count; retried (FIFO order
+        #                               preserved) as retirements free blocks
         self._prefilling: Optional[_PrefillState] = None  # chunked prefill
         self._inflight: Optional[_Inflight] = None  # dispatched decode step
         self._scheduler_error: Optional[BaseException] = None
@@ -496,24 +539,36 @@ class ServingEngine:
     def start(self) -> "ServingEngine":
         with self._lock:
             if self._thread is None:
+                cfg_e = self.config
+                # block size follows the admission granularity by default
+                # so prefix-cache blocks == pool blocks (zero-copy sharing)
+                # and hit suffixes reuse the cold path's compiled shapes
+                bk = int(cfg_e.kv_block_size
+                         or cfg_e.prefill_chunk
+                         or max(1, cfg_e.prefill_bucket))
+                bk = max(1, min(bk, cfg_e.max_seq_len))
+                table_blocks = -(-cfg_e.max_seq_len // bk)
+                n_blocks = int(cfg_e.kv_pool_blocks) or (
+                    1 + cfg_e.max_batch_size * table_blocks
+                    + (cfg_e.prefix_cache_blocks or 0))
+                pool = BlockPool(
+                    self.cfg, n_blocks, bk,
+                    on_cow=lambda: self.metrics.inc("cow_copies_total"))
                 self.slots = SlotAllocator(self.cfg,
-                                           self.config.max_batch_size,
-                                           self.config.max_seq_len)
-                if self.config.prefix_cache_blocks:
-                    # block size follows the admission granularity so hit
-                    # suffixes reuse the cold path's compiled shapes
-                    block = int(self.config.prefill_chunk
-                                or max(1, self.config.prefill_bucket))
+                                           cfg_e.max_batch_size,
+                                           cfg_e.max_seq_len, pool)
+                if cfg_e.prefix_cache_blocks:
                     self.prefix_cache = PrefixCache(
-                        self.cfg,
-                        block_tokens=min(block, self.config.max_seq_len),
-                        max_blocks=self.config.prefix_cache_blocks,
-                        max_seq_len=self.config.max_seq_len,
+                        self.cfg, pool=pool,
+                        max_blocks=cfg_e.prefix_cache_blocks,
+                        max_seq_len=cfg_e.max_seq_len,
                         metrics=lambda: self.metrics)
-                from ..kernels.decode_step import fused_decode_eligible
-                self._fused_decode = fused_decode_eligible(
-                    self.cfg, self.params, self.slots.k_cache, 1,
+                from ..kernels.decode_step import fused_paged_decode_eligible
+                self._fused_decode = fused_paged_decode_eligible(
+                    self.cfg, self.params, pool.k_pool,
+                    cfg_e.max_batch_size, self.slots.table_blocks,
                     jax.default_backend())
+                self._update_pool_gauges()
                 self._thread = threading.Thread(
                     target=self._loop, name="serving-engine", daemon=True)
                 self._thread.start()
@@ -572,7 +627,7 @@ class ServingEngine:
     def _is_idle(self) -> bool:
         return (not self._active and self._admitting is None
                 and self._prefilling is None and self._inflight is None
-                and len(self.queue) == 0)
+                and self._held is None and len(self.queue) == 0)
 
     def _notify_drain(self) -> None:
         with self._drain_cond:
@@ -622,6 +677,15 @@ class ServingEngine:
                     f"prompt ({len(req.prompt)} tokens) + max_new_tokens "
                     f"({req.max_new_tokens}) exceeds the per-slot sequence "
                     f"budget ({self.config.max_seq_len})")
+            pool = self.slots.pool
+            need = -(-(len(req.prompt) + req.max_new_tokens)
+                     // pool.block_size)
+            if need > pool.usable_blocks:
+                self.metrics.inc("rejected_invalid")
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool only has "
+                    f"{pool.usable_blocks} (kv_pool_blocks too small for "
+                    f"this sequence budget)")
             reqs.append(req)
         try:
             self.queue.put_many(reqs)
@@ -688,6 +752,9 @@ class ServingEngine:
             if self._prefilling is not None:  # mid chunked prefill
                 self._finish(self._prefilling.req, "error")
                 self._prefilling = None
+            if self._held is not None:  # parked on pool pressure
+                self._finish(self._held, "error")
+                self._held = None
             for slot in list(self._active):
                 st = self._active.pop(slot)
                 self._finish(st.req, "error")
@@ -706,6 +773,9 @@ class ServingEngine:
         if (self._prefilling is not None
                 and self._prefilling.req.cancel_flag.is_set()):
             self._abort_prefill("cancelled")
+        if self._held is not None and self._held.cancel_flag.is_set():
+            req, self._held = self._held, None
+            self._finish(req, "cancelled")
 
     def _abort_prefill(self, reason: str) -> None:
         ps, self._prefilling = self._prefilling, None
@@ -730,6 +800,9 @@ class ServingEngine:
             self._retire(slot, "timeout")
         if self._prefilling is not None and expired(self._prefilling.req):
             self._abort_prefill("timeout")
+        if self._held is not None and expired(self._held):
+            req, self._held = self._held, None
+            self._finish(req, "timeout")
         for req in self.queue.remove_if(expired):
             self._finish(req, "timeout")
         self.metrics.set_gauges(queue_depth=len(self.queue))
@@ -740,25 +813,54 @@ class ServingEngine:
                        request_id=req.rid, tid=req.id,
                        args={"prompt_len": len(req.prompt)})
 
+    def _try_reserve(self, need: int) -> bool:
+        """Reserve ``need`` pool blocks for an admission, squeezing the
+        prefix cache's unpinned blocks first if the pool is tight."""
+        pool = self.slots.pool
+        if pool.reserve(need):
+            return True
+        if self.prefix_cache is not None:
+            short = need - (pool.free_blocks - pool.reserved_blocks)
+            if short > 0:
+                self.prefix_cache.evict_blocks(short)
+                self.metrics.set_gauges(
+                    prefix_blocks=self.prefix_cache.blocks)
+            if pool.reserve(need):
+                return True
+        return False
+
+    def _next_admission(self) -> Optional[_Request]:
+        """The next request to admit: the parked one first (FIFO order is
+        preserved under pool pressure), else a fresh queue pop."""
+        if self._held is not None:
+            req, self._held = self._held, None
+            return req
+        req = self.queue.pop()
+        if req is not None:
+            self._note_dequeued(req)
+            self.metrics.set_gauges(queue_depth=len(self.queue))
+        return req
+
     def _admit(self) -> None:
         assert self.slots is not None
         if self.config.prefill_chunk:
             self._admit_chunked()
             return
         while self.slots.free_slots:
-            req = self.queue.pop()
+            req = self._next_admission()
             if req is None:
                 break
-            self._note_dequeued(req)
-            self.metrics.set_gauges(queue_depth=len(self.queue))
             if req.cancel_flag.is_set():
                 self._finish(req, "cancelled")
                 continue
             # between pop and slot the request is in neither the queue nor
             # _active; remember it so a prefill crash still fails it loudly
             self._admitting = req
-            self._prefill_into_slot(req)
+            admitted = self._prefill_into_slot(req)
             self._admitting = None
+            if not admitted:  # parked in _held: pool pressure, stop here
+                break
+        self._update_pool_gauges()
         self.metrics.set_gauges(slots_active=self.slots.active_slots,
                                 queue_depth=len(self.queue))
 
@@ -767,13 +869,11 @@ class ServingEngine:
         iteration, so active streams get a decode step between chunks
         instead of stalling for a whole long prompt."""
         if self._prefilling is None and self.slots.free_slots:
-            req = self.queue.pop()
+            req = self._next_admission()
             while req is not None and req.cancel_flag.is_set():
                 self._finish(req, "cancelled")
-                req = self.queue.pop()
-            self.metrics.set_gauges(queue_depth=len(self.queue))
+                req = self._next_admission()
             if req is not None:
-                self._note_dequeued(req)
                 if req.return_logprobs:
                     # prompt logprobs need every prompt logit in one pass;
                     # rare admin path — take the whole-prompt prefill
@@ -781,36 +881,50 @@ class ServingEngine:
                     self._prefill_into_slot(req)
                     self._admitting = None
                 else:
-                    chunk = max(1, int(self.config.prefill_chunk))
-                    plen = len(req.prompt)
-                    padded = min(-(-plen // chunk) * chunk,
-                                 self.config.max_seq_len)
-                    slot = self.slots.alloc()
-                    assert slot is not None
-                    ps = _PrefillState(req, slot, padded)
-                    if self.prefix_cache is not None:
-                        t_pm = time.perf_counter()
-                        lease = self.prefix_cache.match_and_acquire(
-                            req.prompt)
-                        self.trace.add(
-                            "prefix_match", t_pm, time.perf_counter(),
-                            request_id=req.rid, tid=req.id,
-                            args={"hit": lease is not None,
-                                  "matched_tokens":
-                                      lease.tokens if lease else 0})
-                        if lease is not None:
-                            # prefix hit: the cached blocks (block size ==
-                            # chunk) land pre-spliced and the chunk cursor
-                            # starts past them; only the suffix chunks run
-                            ps.lease = lease
-                            ps.done = lease.tokens
-                            ps.k_small, ps.v_small = (
-                                self.prefix_cache.assemble(lease))
-                    self._prefilling = ps
+                    self._begin_chunked_prefill(req)
         if self._prefilling is not None:
             self._advance_prefill()
+        self._update_pool_gauges()
         self.metrics.set_gauges(slots_active=self.slots.active_slots,
                                 queue_depth=len(self.queue))
+
+    def _begin_chunked_prefill(self, req: _Request) -> None:
+        chunk = max(1, int(self.config.prefill_chunk))
+        plen = len(req.prompt)
+        padded = min(-(-plen // chunk) * chunk, self.config.max_seq_len)
+        slot = self.slots.alloc()
+        assert slot is not None
+        lease = None
+        if self.prefix_cache is not None:
+            t_pm = time.perf_counter()
+            lease = self.prefix_cache.match_and_acquire(req.prompt)
+            self.trace.add(
+                "prefix_match", t_pm, time.perf_counter(),
+                request_id=req.rid, tid=req.id,
+                args={"hit": lease is not None,
+                      "matched_tokens": lease.tokens if lease else 0})
+        bk = self.slots.pool.block_size
+        n_shared = len(lease.bids) if lease is not None else 0
+        need = -(-(plen + req.max_new_tokens) // bk) - n_shared
+        if not self._try_reserve(need):
+            # pool pressure: park the request (FIFO head) and retry once
+            # retirements free blocks; nothing was allocated yet
+            if self.prefix_cache is not None:
+                self.prefix_cache.release(lease)
+            self.slots.release(slot)
+            self._held = req
+            return
+        self.slots.set_reservation(slot, need)
+        ps = _PrefillState(req, slot, padded)
+        ps.lease = lease
+        if lease is not None:
+            # prefix hit: gather the shared blocks into the batch-1
+            # working cache (their pool blocks themselves are shared by
+            # ref bump at insert — no K/V copies into the pool) and start
+            # the chunk cursor past them; only the suffix chunks run
+            ps.done = lease.tokens
+            ps.k_small, ps.v_small = self._gather_lease(lease)
+        self._prefilling = ps
 
     def _advance_prefill(self) -> None:
         ps = self._prefilling
@@ -835,7 +949,7 @@ class ServingEngine:
                 self.cfg, self.params, jnp.asarray(tokens), jnp.int32(off),
                 jnp.asarray([len(req.prompt) - 1 - off], jnp.int32),
                 ps.k_small, ps.v_small,
-                max_seq_len=self.config.max_seq_len,
+                max_seq_len=self.slots.width,
                 first=(off == 0), last=last)
         ps.done = off + c
         self.metrics.inc("prefill_chunks")
@@ -846,7 +960,9 @@ class ServingEngine:
         # chunk-padded tail rows, like bucket padding, hold pad-token K/V
         # masked by the slot's fill level)
         self._prefilling = None
-        self.slots.insert(ps.slot, ps.k_small, ps.v_small)
+        self.slots.insert(ps.slot, ps.k_small, ps.v_small,
+                          len(req.prompt),
+                          ps.lease.bids if ps.lease is not None else ())
         tok, tok_lp = _first_token_impl(
             self.cfg, logits,
             jnp.asarray([req.seed], jnp.uint32),
@@ -868,11 +984,20 @@ class ServingEngine:
         self._active[ps.slot] = st
         self._commit_token(ps.slot, first_tok, float(np.asarray(tok_lp)[0]))
 
-    def _prefill_into_slot(self, req: _Request) -> None:
+    def _gather_lease(self, lease):
+        """One fixed-arity gather of a lease's shared blocks into a fresh
+        batch-1 working cache (trash-padded past the match)."""
+        table = np.zeros((1, self.slots.table_blocks), np.int32)
+        table[0, :len(lease.bids)] = lease.bids
+        return _gather_lease_impl(self.slots.k_pool, self.slots.v_pool,
+                                  jnp.asarray(table))
+
+    def _prefill_into_slot(self, req: _Request) -> bool:
+        """Whole-prompt admission.  Returns False (request parked in
+        ``_held``, nothing allocated) when the pool cannot reserve the
+        request's worst-case block count."""
         slot = self.slots.alloc()
         assert slot is not None
-        t = self.metrics.timers("serving-prefill", 2)
-        t.start()
         plen = len(req.prompt)
         bucket = max(1, self.config.prefill_bucket)
         # prompt-logprob requests need every prompt logit in one pass, so
@@ -886,15 +1011,29 @@ class ServingEngine:
                 request_id=req.rid, tid=req.id,
                 args={"hit": lease is not None,
                       "matched_tokens": lease.tokens if lease else 0})
+        bk = self.slots.pool.block_size
+        n_shared = len(lease.bids) if lease is not None else 0
+        need = -(-(plen + req.max_new_tokens) // bk) - n_shared
+        if not self._try_reserve(need):
+            if self.prefix_cache is not None:
+                self.prefix_cache.release(lease)
+            self.slots.release(slot)
+            self._held = req
+            return False
+        self.slots.set_reservation(slot, need)
+        t = self.metrics.timers("serving-prefill", 2)
+        t.start()
         t_pf = time.perf_counter()
         if lease is not None:
-            # prefix hit: splice the cached blocks into a fresh batch-1
-            # cache and prefill only the uncached suffix.  The spliced
-            # rows are the ones a cold prefill would have written, so the
-            # logits at the prompt's last token — and every sampled token
-            # after — are bitwise identical (prefix_cache.py)
+            # prefix hit: gather the shared blocks into a fresh batch-1
+            # working cache and prefill only the uncached suffix.  The
+            # shared rows are the ones a cold prefill would have written,
+            # so the logits at the prompt's last token — and every sampled
+            # token after — are bitwise identical (prefix_cache.py); the
+            # pool blocks themselves are shared by ref bump at insert —
+            # a hit copies zero K/V
             matched = lease.tokens
-            k_small, v_small = self.prefix_cache.assemble(lease)
+            k_small, v_small = self._gather_lease(lease)
             suffix = plen - matched
             width = min(-(-suffix // bucket) * bucket,
                         self.config.max_seq_len - matched)
@@ -905,7 +1044,7 @@ class ServingEngine:
                     self.cfg, self.params, jnp.asarray(tokens),
                     jnp.int32(matched),
                     jnp.asarray([suffix - 1], jnp.int32), k_small, v_small,
-                    max_seq_len=self.config.max_seq_len, first=False,
+                    max_seq_len=self.slots.width, first=False,
                     last=True)
         else:
             padded = -(-plen // bucket) * bucket
@@ -916,12 +1055,13 @@ class ServingEngine:
                 last_logits, picked, k_small, v_small = _prefill_impl(
                     self.cfg, self.params, jnp.asarray(tokens),
                     jnp.asarray([plen], jnp.int32),
-                    max_seq_len=self.config.max_seq_len,
+                    max_seq_len=self.slots.width,
                     want_logprobs=req.return_logprobs)
             if req.return_logprobs:
                 req.logprobs.extend(
                     np.asarray(picked)[0, :plen - 1].tolist())
-        self.slots.insert(slot, k_small, v_small)
+        self.slots.insert(slot, k_small, v_small, plen,
+                          lease.bids if lease is not None else ())
 
         # first generated token: same per-request sampling rule as decode
         tok, tok_lp = _first_token_impl(
@@ -949,6 +1089,7 @@ class ServingEngine:
         st.lease = lease
         self._active[slot] = st
         self._commit_token(slot, first, float(np.asarray(tok_lp)[0]))
+        return True
 
     def _step(self) -> None:
         """One scheduler iteration of the decode fast path: dispatch step
@@ -1005,6 +1146,11 @@ class ServingEngine:
             if st.fresh:
                 override_mask[slot] = True
                 st.fresh = False
+            # lazy paged growth: make sure the block receiving this step's
+            # K/V row exists before the tables snapshot (reservation-backed,
+            # so this cannot fail mid-flight)
+            self.slots.append_block_id(slot, st.fill)
+        tables = jnp.asarray(self.slots.tables)
         if self._inflight is None:
             # no device-resident tokens: every active slot's pending value
             # is host-known (fresh admission, post-pause/post-sync commit)
@@ -1032,14 +1178,15 @@ class ServingEngine:
         self.metrics.inc(
             "fused_steps" if self._fused_decode else "fallback_steps")
         with device_annotation("decode"):
-            tok, tok_lp, k_cache, v_cache = self._decode(
-                self.cfg, self.params, self.slots.k_cache,
-                self.slots.v_cache,
+            tok, tok_lp, k_pool, v_pool = self._decode(
+                self.cfg, self.params, self.slots.k_pool,
+                self.slots.v_pool, tables,
                 pending, jnp.asarray(fills), jnp.asarray(seeds),
                 jnp.asarray(counters), jnp.asarray(greedy),
                 jnp.asarray(temps),
-                jnp.asarray(top_ks), jnp.asarray(top_ps))
-        self.slots.set_caches(k_cache, v_cache)
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                use_fused=self._fused_decode)
+        self.slots.set_pools(k_pool, v_pool)
         try:  # start the host copy now so it overlaps the next dispatch
             tok.copy_to_host_async()
             tok_lp.copy_to_host_async()
@@ -1121,17 +1268,35 @@ class ServingEngine:
         self.trace.instant("retire", request_id=st.req.rid, tid=st.req.id,
                            args={"slot": slot, "reason": reason})
         if self.prefix_cache is not None:
-            # donate the slot's block-aligned prompt prefix back before
-            # the slot can be reused, then unpin the admission lease (so
+            # donate the slot's block-aligned prompt prefix back (a pure
+            # ref-count adoption of blocks the slot already owns) before
+            # the slot releases them, then unpin the admission lease (so
             # the request's own prefix blocks were protected throughout)
-            self.prefix_cache.offer(st.req.prompt, self.slots.k_cache,
-                                    self.slots.v_cache, slot)
+            self.prefix_cache.offer(st.req.prompt, self.slots.tables[slot])
             self.prefix_cache.release(st.lease)
             self.metrics.set_gauges(
                 prefix_blocks=self.prefix_cache.blocks)
         self.slots.release(slot)
         self._finish(st.req, reason)
+        self._update_pool_gauges()
         self.metrics.set_gauges(slots_active=self.slots.active_slots)
+
+    def _update_pool_gauges(self) -> None:
+        s = self.slots.pool.stats()
+        self.metrics.set_gauges(blocks_free=s["blocks_free"],
+                                blocks_used=s["blocks_used"],
+                                kv_cache_util=s["kv_cache_util"])
+
+    def kv_snapshot(self) -> dict:
+        """Debug view of the paged KV state (GET /kv,
+        tools/dump_kv_pool.py): pool stats, per-slot block tables + fills,
+        ref counts, and fragmentation (live tokens / allocated tokens
+        slack).  Best-effort under concurrent scheduling — served from
+        any thread without locking, like /metrics and /trace."""
+        if self.slots is None:
+            return {"pool": None, "slots": {}}
+        fills = {s: st.fill for s, st in dict(self._active).items()}
+        return self.slots.snapshot(fills)
 
     def _finish(self, req: _Request, reason: str) -> None:
         req.result = FinishedRequest(

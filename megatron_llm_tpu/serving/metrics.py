@@ -123,6 +123,11 @@ _COUNTERS = (
     # to share system prompts but showing prefix_misses climbing means
     # prompts diverge inside the first block (check block alignment).
     "prefix_hits", "prefix_misses", "prefix_evicted_blocks",
+    # paged KV cache (serving/block_pool.py): copy-on-write block copies.
+    # Normal engine flow never COWs (appends always target exclusively
+    # owned blocks); anything nonzero on a pure prefix-hit workload means
+    # zero-copy sharing broke (tests/serving/test_prefix_cache.py).
+    "cow_copies_total",
 )
 
 # (attribute, prometheus family name, help) for the latency reservoirs
@@ -174,6 +179,11 @@ class ServingMetrics:
         # generic; samples here are token counts, not seconds)
         self.prefix_hit_tokens = LatencyHistogram()
         self.prefix_blocks = 0   # gauge: blocks resident in the cache
+        # paged KV pool gauges (engine._update_pool_gauges): free/used
+        # block counts and the allocated-token / pool-token fraction
+        self.blocks_free = 0
+        self.blocks_used = 0
+        self.kv_cache_util = 0.0
         self.timers = Timers(log_level=2)
         self.slo = SLOTracker(slo or SLOConfig())
         if register:
@@ -185,7 +195,10 @@ class ServingMetrics:
 
     def set_gauges(self, *, slots_active: Optional[int] = None,
                    queue_depth: Optional[int] = None,
-                   prefix_blocks: Optional[int] = None) -> None:
+                   prefix_blocks: Optional[int] = None,
+                   blocks_free: Optional[int] = None,
+                   blocks_used: Optional[int] = None,
+                   kv_cache_util: Optional[float] = None) -> None:
         with self._lock:
             if slots_active is not None:
                 self.slots_active = slots_active
@@ -193,6 +206,12 @@ class ServingMetrics:
                 self.queue_depth = queue_depth
             if prefix_blocks is not None:
                 self.prefix_blocks = prefix_blocks
+            if blocks_free is not None:
+                self.blocks_free = blocks_free
+            if blocks_used is not None:
+                self.blocks_used = blocks_used
+            if kv_cache_util is not None:
+                self.kv_cache_util = kv_cache_util
 
     def observe_decode_iteration(self, batch: int, seconds: float) -> None:
         """One scheduler decode step over ``batch`` active slots."""
@@ -265,6 +284,10 @@ class ServingMetrics:
                 "prefix_blocks": self.prefix_blocks,
                 "prefix_hit_tokens": self.prefix_hit_tokens.snapshot(
                     suffix=""),
+                # paged KV pool occupancy
+                "blocks_free": self.blocks_free,
+                "blocks_used": self.blocks_used,
+                "kv_cache_util": self.kv_cache_util,
             })
         out["slo"] = self.slo.snapshot()
         return out
@@ -275,8 +298,12 @@ class ServingMetrics:
         fams: List[MetricFamily] = []
         with self._lock:
             for name in _COUNTERS:
+                # counters already carrying the Prometheus "_total" suffix
+                # (cow_copies_total) must not have it doubled
+                pname = (f"serving_{name}" if name.endswith("_total")
+                         else f"serving_{name}_total")
                 fams.append(MetricFamily(
-                    f"serving_{name}_total", "counter",
+                    pname, "counter",
                     f"serving lifecycle counter: {name}").add(
                         self.counters[name]))
             hits = self.counters["prefix_hits"]
@@ -298,7 +325,15 @@ class ServingMetrics:
                      self.prefix_blocks),
                     ("serving_prefix_hit_rate",
                      "prefix-cache admission hit rate",
-                     hits / max(1, hits + misses))):
+                     hits / max(1, hits + misses)),
+                    ("serving_blocks_free",
+                     "KV pool blocks on the free list", self.blocks_free),
+                    ("serving_blocks_used",
+                     "KV pool blocks allocated to slots or the prefix cache",
+                     self.blocks_used),
+                    ("serving_kv_cache_util",
+                     "allocated-token fraction of the KV pool",
+                     self.kv_cache_util)):
                 fams.append(MetricFamily(gname, "gauge", help_).add(value))
             for attr, pname, help_ in _PROM_SUMMARIES:
                 hist: LatencyHistogram = getattr(self, attr)
@@ -327,6 +362,12 @@ class ServingMetrics:
                           snap["prefix_hit_rate"], iteration)
         writer.add_scalar("serving/prefix_blocks",
                           snap["prefix_blocks"], iteration)
+        writer.add_scalar("serving/blocks_free", snap["blocks_free"],
+                          iteration)
+        writer.add_scalar("serving/blocks_used", snap["blocks_used"],
+                          iteration)
+        writer.add_scalar("serving/kv_cache_util", snap["kv_cache_util"],
+                          iteration)
         writer.add_scalar("serving/prefix_hit_tokens_mean",
                           snap["prefix_hit_tokens"]["mean"], iteration)
         for hist, key in ((self.ttft, "ttft"),

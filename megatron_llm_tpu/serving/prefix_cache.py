@@ -1,129 +1,89 @@
-"""Automatic prefix caching: shared-prefix KV reuse across requests.
+"""Automatic prefix caching: zero-copy shared-prefix KV reuse.
 
 Every admission used to recompute its prompt from token 0 even when the
 first few hundred tokens were the same system prompt every other request
 carried — and BENCH_r05 puts long-prompt prefill at 0.174 MFU, so that
 recompute dominates TTFT for exactly the traffic the engine targets.
 This module is the RadixAttention / vLLM-automatic-prefix-caching idea
-adapted to the fixed-slot TPU cache: a host-side trie over **block
-aligned** token-id prefixes whose nodes own device-resident K/V rows,
-consulted at admission and fed at retirement.
+over the paged block pool: a host-side trie over **block-aligned**
+token-id prefixes whose nodes hold *pool block ids*, consulted at
+admission and fed at retirement.  Since the pool rebase the cache moves
+ZERO K/V bytes: a hit is a ref-count bump that places the shared block
+ids directly into the admitted slot's block table, and an offer is a
+ref-count bump on blocks the retiring slot already owns.  (The old
+design extracted rows at retirement and concatenated-and-padded a fresh
+admission cache per hit — one device dispatch each way; both are gone.)
 
-Block granularity.  A node holds exactly ``block_tokens`` sequence rows
-(one per side) shaped ``[L, 1, kv_heads, block, ...]``.  The engine picks
-``block_tokens = prefill_chunk`` when chunked admission is on (so a hit
-just advances the chunk cursor and suffix chunks keep the one compiled
-chunk width) and ``prefill_bucket`` otherwise (so suffix padding keeps
-the same bounded set of compiled prefill shapes the cold path has).
-RoPE is applied at a token's absolute position before K enters the
-cache, and a prefix occupies the same absolute positions in every
-sequence that shares it — cached rows are valid verbatim, no re-rotation.
+Block granularity.  A trie node covers exactly ``block_tokens`` token
+positions, and ``block_tokens`` MUST equal the pool's ``block_size`` so
+a cached block IS a pool block — that identity is what makes sharing
+free.  The engine therefore derives both from the same
+``kv_block_size``.  RoPE is applied at a token's absolute position
+before K enters the pool, and a prefix occupies the same absolute
+positions in every sequence that shares it — shared blocks are valid
+verbatim, no re-rotation, and int8 ``{q, scale}`` leaves are never
+touched at all.
 
-Admission (``match_and_acquire`` + ``assemble``).  The longest cached
-block-aligned prefix STRICTLY shorter than the prompt is matched (at
-least one real token must run through the suffix prefill to produce the
-logits the first sampled token needs).  Matched nodes are **ref-count
-pinned** for the life of the request, then their rows are spliced into a
-fresh batch-1 admission cache in ONE fused dispatch
-(concatenate-and-pad; per-dispatch tunnel latency, not row traffic, is
-the marginal cost) — for int8 caches the {q, scale} pair moves
-verbatim, so quantized rows stay bit-identical to the rows the donor
-request wrote.  The engine then prefills only the uncached suffix.
-Because prefill writes the exact same K/V rows the cache returns,
-sampling, logprobs, and the pipelined decode path are bitwise identical
-to a cold admission (asserted against ``generate_tokens`` in
+Admission (``match_and_acquire``).  The longest cached block-aligned
+prefix STRICTLY shorter than the prompt is matched (at least one real
+token must run through the suffix prefill to produce the logits the
+first sampled token needs).  Matched nodes are **trie-pinned**
+(``ref``-counted against eviction) for the life of the request, and the
+lease's ``bids`` go to ``SlotAllocator.insert`` which bumps the pool
+ref of each shared block as it enters the slot's table.  The engine
+prefills only the uncached suffix into the gathered working view.
+Because the shared blocks hold the exact rows a cold prefill would
+write, sampling, logprobs, and the pipelined decode path are bitwise
+identical to a cold admission (asserted against ``generate_tokens`` in
 tests/serving/test_prefix_cache.py, fp32 + int8).
-(``models/model.py:cache_slot_copy`` is the general slot-to-slot row
-splice of the same shape family, kept as the model-level primitive.)
 
 Retirement (``offer``).  The slot's block-aligned prompt prefix is
 walked into the trie; blocks already present are LRU-touched, missing
-ones — always one contiguous tail of the walk — are extracted from the
-big batch cache in one device dispatch (a gather of rows the decode
-loop never overwrites: decode appends at fill >= plen).
+ones — always one contiguous tail of the walk — are adopted from the
+slot's own table by pool ``incref``: the trie simply becomes one more
+owner of blocks that already exist.  Decode appends at fill >= plen, so
+offered prefix blocks are never written after retirement (the boundary
+block a successor might append into is copy-on-write in the pool).
 
-Eviction.  A soft HBM budget of ``max_blocks`` blocks: when an offer
+Eviction.  A soft budget of ``max_blocks`` trie blocks: when an offer
 pushes past it, least-recently-used nodes with ``ref == 0`` and no
 children are dropped (evicting a middle node would orphan its
-descendants' match path).  Pinned chains can transiently exceed the
-budget — correctness over strict accounting — and get trimmed on the
-next release/offer.
+descendants' match path) and their pool ref released.  Pinned chains can
+transiently exceed the budget — correctness over strict accounting.
+``evict_blocks`` additionally lets the engine force eviction when the
+*pool* (not the trie budget) is the scarce resource at admission.
 
-Host cost is O(prompt/block) dict lookups per admission; all row traffic
-stays on device.
+Host cost is O(prompt/block) dict lookups per admission; no device work.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, List, Optional, Sequence, Tuple, Union
 
-import jax
-import jax.numpy as jnp
-
 from ..config import ModelConfig
+from .block_pool import BlockPool
 from .metrics import ServingMetrics
 
 
-@functools.partial(jax.jit, static_argnames=("n_blocks", "block"))
-def _read_blocks(cache, slot, pos, *, n_blocks: int, block: int):
-    """Extract ``n_blocks`` consecutive ``block``-row blocks of batch row
-    ``slot`` starting at sequence position ``pos``, as a tuple of batch-1
-    block pytrees (every leaf: seq axis 3 of [L, b, kv, max_len(, d)]).
-    ONE dispatch regardless of block count — per-dispatch latency through
-    the device tunnel (~1 ms) is the dominant cost at serving scale, not
-    the row traffic."""
-    slot = jnp.asarray(slot, jnp.int32)
-    pos = jnp.asarray(pos, jnp.int32)
-
-    def rd(a):
-        zeros = (jnp.int32(0),) * (a.ndim - 4)
-        return jax.lax.dynamic_slice(
-            a, (jnp.int32(0), slot, jnp.int32(0), pos) + zeros,
-            (a.shape[0], 1, a.shape[2], n_blocks * block)
-            + tuple(a.shape[4:]))
-
-    rows = jax.tree.map(rd, cache)
-    return tuple(
-        jax.tree.map(lambda a: a[:, :, :, i * block:(i + 1) * block], rows)
-        for i in range(n_blocks))
-
-
-@functools.partial(jax.jit, static_argnames=("max_len",))
-def _assemble_impl(*blocks, max_len: int):
-    """Concatenate a lease's blocks along the sequence axis and pad out
-    to a full ``max_len``-wide batch-1 admission cache — again ONE
-    dispatch per hit (one compiled executable per distinct block count;
-    counts are small and recur).  ``jnp.pad`` zeros match
-    ``init_kv_cache``'s zero fill, so the assembled cache is bit-equal
-    to a cold admission cache after its prefix prefill."""
-    def cat(*leaves):
-        full = jnp.concatenate(leaves, axis=3)
-        pad = [(0, 0)] * full.ndim
-        pad[3] = (0, max_len - full.shape[3])
-        return jnp.pad(full, pad)
-
-    return jax.tree.map(cat, *blocks)
-
-
 class _Node:
-    """One cached block: ``key`` is its block_tokens token ids, ``kv``
-    its device-resident (k_rows, v_rows) pair."""
+    """One cached block: ``key`` is its block_tokens token ids, ``bid``
+    the pool block holding its K/V rows (the trie owns one pool ref)."""
 
-    __slots__ = ("key", "parent", "children", "kv", "ref", "tick")
+    __slots__ = ("key", "parent", "children", "bid", "ref", "tick")
 
     def __init__(self, key: Tuple[int, ...], parent: "_Node"):
         self.key = key
         self.parent = parent
         self.children: dict = {}
-        self.kv = None
+        self.bid = BlockPool.TRASH
         self.ref = 0        # live leases pinning this block
         self.tick = 0       # LRU clock at last touch
 
 
 class PrefixLease:
     """A matched chain of blocks, pinned against eviction until
-    ``PrefixCache.release``.  ``tokens`` is the matched prefix length."""
+    ``PrefixCache.release``.  ``tokens`` is the matched prefix length;
+    ``bids`` the pool block ids to place in the slot's table."""
 
     __slots__ = ("nodes", "tokens")
 
@@ -131,16 +91,21 @@ class PrefixLease:
         self.nodes = nodes
         self.tokens = tokens
 
+    @property
+    def bids(self) -> List[int]:
+        return [n.bid for n in self.nodes]
+
 
 class PrefixCache:
     """Block-granular radix cache over token-id prefixes (module doc)."""
 
-    def __init__(self, cfg: ModelConfig, *, block_tokens: int,
+    def __init__(self, cfg: ModelConfig, *, pool: BlockPool,
                  max_blocks: int, max_seq_len: int,
                  metrics: Union[ServingMetrics, Callable, None] = None):
-        assert block_tokens >= 1 and max_blocks >= 1
+        assert max_blocks >= 1
         self.cfg = cfg
-        self.block_tokens = int(block_tokens)
+        self.pool = pool
+        self.block_tokens = int(pool.block_size)
         self.max_blocks = int(max_blocks)
         self.max_seq_len = int(max_seq_len)
         # the engine replaces its metrics object between warmup and
@@ -150,7 +115,6 @@ class PrefixCache:
         self._root = _Node((), None)
         self._blocks = 0
         self._tick = 0
-        self._zero_block = None  # lazy zeros block, pads assemble's arity
 
     @property
     def blocks(self) -> int:
@@ -204,21 +168,6 @@ class PrefixCache:
             m.observe_prefix_hit_tokens(matched)
         return PrefixLease(nodes, matched)
 
-    def assemble(self, lease: PrefixLease):
-        """Materialize a lease as a fresh batch-1 admission cache
-        ``[L, 1, kv, max_seq_len, ...]`` with the leased rows spliced in
-        — one fused device dispatch (int8 {q, scale} blocks land
-        bit-identical; concatenation never dequantizes).  The block list
-        pads to a FIXED arity with a shared zeros block so every hit,
-        whatever its matched length, runs the one compiled executable
-        (zeros beyond the match equal ``init_kv_cache``'s fill)."""
-        blocks = [n.kv for n in lease.nodes]
-        if self._zero_block is None:
-            self._zero_block = jax.tree.map(jnp.zeros_like, blocks[0])
-        n_total = self.max_seq_len // self.block_tokens
-        blocks.extend([self._zero_block] * (n_total - len(blocks)))
-        return _assemble_impl(*blocks, max_len=self.max_seq_len)
-
     def release(self, lease: Optional[PrefixLease]) -> None:
         """Unpin a lease (request retired or aborted); then trim any
         over-budget blocks the pin was protecting."""
@@ -232,18 +181,17 @@ class PrefixCache:
 
     # -- retirement side ---------------------------------------------------
 
-    def offer(self, tokens: Sequence[int], k_cache, v_cache,
-              slot: int) -> int:
-        """Insert the block-aligned prefix of ``tokens`` from batch row
-        ``slot`` of the engine's big cache.  Blocks already cached are
-        LRU-touched; missing ones are extracted device-side.  Returns the
-        number of newly inserted blocks."""
+    def offer(self, tokens: Sequence[int], table: Sequence[int]) -> int:
+        """Adopt the block-aligned prefix of ``tokens`` from a retiring
+        slot's block ``table``.  Blocks already cached are LRU-touched;
+        missing ones — one contiguous tail of the walk — enter the trie
+        by pool ``incref`` on the ids the slot already owns.  No device
+        work.  Returns the number of newly adopted blocks."""
         n_blocks = len(tokens) // self.block_tokens
         keys = list(self._keys(tokens, n_blocks))
-        # Walk the existing chain first.  A missing block can only be
-        # followed by missing blocks (a node's descendants exist only
-        # under a present node), so the blocks to extract are one
-        # contiguous tail — read them in a single fused dispatch.
+        # A missing block can only be followed by missing blocks (a
+        # node's descendants exist only under a present node), so the
+        # blocks to adopt are one contiguous tail of the walk.
         cur = self._root
         first_missing = n_blocks
         for i, key in enumerate(keys):
@@ -254,28 +202,36 @@ class PrefixCache:
             self._touch(child)
             cur = child
         added = n_blocks - first_missing
+        for i in range(first_missing, n_blocks):
+            bid = int(table[i])
+            assert bid != BlockPool.TRASH, \
+                "offered prompt prefix has an unallocated block"
+            self.pool.incref(bid)
+            child = _Node(keys[i], cur)
+            child.bid = bid
+            cur.children[keys[i]] = child
+            self._touch(child)
+            self._blocks += 1
+            cur = child
         if added:
-            blocks = _read_blocks(
-                (k_cache, v_cache), slot,
-                first_missing * self.block_tokens,
-                n_blocks=added, block=self.block_tokens)
-            for key, kv in zip(keys[first_missing:], blocks):
-                child = _Node(key, cur)
-                child.kv = kv
-                cur.children[key] = child
-                self._touch(child)
-                self._blocks += 1
-                cur = child
             self._evict()
         return added
 
     # -- eviction ----------------------------------------------------------
 
-    def _evict(self) -> int:
-        """LRU-evict unpinned childless blocks until within budget (or
-        everything left over budget is pinned — soft budget)."""
+    def evict_blocks(self, n: int) -> int:
+        """Force-evict up to ``n`` unpinned blocks regardless of the trie
+        budget — the engine calls this when the POOL is the scarce
+        resource at admission.  Returns the number actually evicted."""
+        return self._evict(want=n)
+
+    def _evict(self, want: int = 0) -> int:
+        """LRU-evict unpinned childless blocks until within budget (or,
+        with ``want``, until that many are gone), stopping early when
+        everything left is pinned — soft budget."""
         evicted = 0
-        while self._blocks > self.max_blocks:
+        while (self._blocks > self.max_blocks) or (evicted < want
+                                                   and self._blocks > 0):
             victim = None
             stack = list(self._root.children.values())
             while stack:
@@ -287,7 +243,8 @@ class PrefixCache:
             if victim is None:
                 break
             del victim.parent.children[victim.key]
-            victim.kv = None     # drop the device buffers now
+            self.pool.decref(victim.bid)
+            victim.bid = BlockPool.TRASH
             victim.parent = None
             self._blocks -= 1
             evicted += 1

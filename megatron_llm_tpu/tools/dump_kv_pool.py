@@ -1,0 +1,78 @@
+"""Fetch a running server's paged-KV pool state and print a summary.
+
+Usage::
+
+    python -m megatron_llm_tpu.tools.dump_kv_pool \
+        --url http://127.0.0.1:5000 --out kv.json
+
+The GET /kv endpoint (generation/server.py) returns the engine's
+``kv_snapshot()``: pool stats (free/used/reserved blocks, utilization,
+copy-on-write count), per-slot block tables with fill levels, ref counts
+(shared prefix blocks show ref > 1), and the fragmentation fraction
+(allocated-but-unfilled slack inside partially-filled boundary blocks).
+See docs/serving.md, "Paged KV cache".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from urllib.error import URLError
+from urllib.request import urlopen
+
+
+def fetch_kv(url: str, timeout: float = 10.0) -> dict:
+    endpoint = url.rstrip("/") + "/kv"
+    with urlopen(endpoint, timeout=timeout) as resp:  # noqa: S310
+        return json.loads(resp.read().decode())
+
+
+def summarize(snap: dict) -> str:
+    pool = snap.get("pool")
+    if not pool:
+        return "kv pool: engine not started (no pool allocated)"
+    lines = [
+        f"kv pool: {pool['n_blocks']} blocks x {pool['block_size']} tokens "
+        f"({pool['blocks_used']} used, {pool['blocks_free']} free, "
+        f"{pool['blocks_reserved']} reserved; "
+        f"util {pool['kv_cache_util']:.1%}, "
+        f"cow copies {pool['cow_copies']})",
+        f"fragmentation: {snap.get('fragmentation', 0.0):.1%} of allocated "
+        "tokens are boundary-block slack",
+    ]
+    shared = {b: r for b, r in snap.get("ref_counts", {}).items() if r > 1}
+    if shared:
+        lines.append(f"shared blocks (ref > 1): {shared}")
+    for sid, st in sorted(snap.get("slots", {}).items(), key=lambda x: int(x[0])):
+        lines.append(f"slot {sid}: fill={st['fill']} "
+                     f"blocks={st['blocks']} table={st['table']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", default="http://127.0.0.1:5000",
+                    help="base URL of a running generation server")
+    ap.add_argument("--out", default=None,
+                    help="also write the raw snapshot JSON here "
+                         "('-' = stdout, suppresses the summary)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    try:
+        snap = fetch_kv(args.url, timeout=args.timeout)
+    except (URLError, OSError, ValueError) as e:
+        print(f"error fetching {args.url}/kv: {e}", file=sys.stderr)
+        return 1
+    if args.out == "-":
+        json.dump(snap, sys.stdout)
+        return 0
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(snap, f)
+    print(summarize(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -89,6 +89,21 @@ def main(argv=None) -> int:
                          "tokens are bitwise unaffected")
     ap.add_argument("--no_prefix_cache", action="store_true",
                     help="disable automatic prefix caching (diagnostic)")
+    ap.add_argument("--kv_block_size", type=int, default=None,
+                    help="paged KV cache block size in tokens "
+                         "(serving/block_pool.py): slots hold per-block "
+                         "tables into a shared pool instead of a fixed "
+                         "max_seq_len stride, so mixed-length traffic "
+                         "packs more concurrent requests into the same "
+                         "HBM (docs/serving.md, 'Paged KV cache'); "
+                         "default: engine default (prefill chunk/bucket "
+                         "rounded to the kernel lane width)")
+    ap.add_argument("--kv_pool_blocks", type=int, default=None,
+                    help="paged KV pool size in blocks of --kv_block_size "
+                         "tokens (plus the reserved trash block); sets "
+                         "the total KV HBM budget independently of "
+                         "--max_batch_size; default: engine default "
+                         "(max_batch_size full-length sequences)")
     ap.add_argument("--metrics_interval_s", type=float, default=60.0,
                     help="periodically print a one-line JSON serving-"
                          "metrics summary (prefix-cache hit rate "
@@ -199,6 +214,8 @@ def main(argv=None) -> int:
         prefill_chunk=args.prefill_chunk,
         pipeline_decode=not args.no_pipeline_decode,
         prefix_cache_blocks=prefix_blocks,
+        kv_block_size=args.kv_block_size,
+        kv_pool_blocks=args.kv_pool_blocks,
         trace=not args.no_trace)
     if prefix_blocks:
         block_tokens = args.prefill_chunk or max(1, args.prefill_bucket)
@@ -207,6 +224,10 @@ def main(argv=None) -> int:
               "prompt tokens; docs/serving.md 'Prefix caching')")
     else:
         print("prefix cache: disabled")
+    if args.kv_block_size or args.kv_pool_blocks:
+        print(f"paged KV: block_size={args.kv_block_size or 'auto'} "
+              f"pool_blocks={args.kv_pool_blocks or 'auto'} "
+              "(GET /kv; tools/dump_kv_pool.py)")
     print("tracing: " + ("disabled (--no_trace)" if args.no_trace
                          else "on (GET /trace; tools/dump_trace.py)"))
     if args.metrics_interval_s > 0:

@@ -384,3 +384,116 @@ def test_full_forward_cached_parity_when_forced_vector_fills():
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v),
                                rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Paged pool mode: fused_decode_step_paged vs the dense fused kernel
+# ---------------------------------------------------------------------------
+
+from megatron_llm_tpu.kernels.decode_step import (  # noqa: E402
+    fused_decode_step_paged,
+)
+from megatron_llm_tpu.models.model import (  # noqa: E402
+    cache_append_rows,
+    cache_gather_blocks,
+)
+
+
+def _shuffled_tables(b, T, rng):
+    """Per-slot tables over shuffled physical ids 1..b*T (0 is trash)."""
+    return (rng.permutation(b * T) + 1).reshape(b, T).astype(np.int32)
+
+
+def _pool_from_cache(cache, bk, tables):
+    """Re-lay a dense cache (leaves [L, b, kv, max_len(, d)]) as a block
+    pool (leaves [L, 1 + b*T, kv, bk(, d)]) at the physical ids named by
+    ``tables``; the trash block and nothing else holds large garbage."""
+    b, T = tables.shape
+
+    def to_pool(leaf):
+        arr = np.asarray(leaf)
+        L, _, kv = arr.shape[:3]
+        garbage = 127 if np.issubdtype(arr.dtype, np.integer) else 1e4
+        pool = np.full((L, 1 + b * T, kv, bk) + arr.shape[4:], garbage,
+                       arr.dtype)
+        for bi in range(b):
+            for j in range(T):
+                pool[:, tables[bi, j]] = arr[:, bi, :, j * bk:(j + 1) * bk]
+        return jnp.asarray(pool)
+
+    return jax.tree.map(to_pool, cache)
+
+
+def test_fused_paged_matches_dense_fused():
+    """fused_decode_step_paged over a shuffled pool == fused_decode_step
+    over the dense cache, BITWISE, at block_k == pool block (the online
+    softmax is partition-sensitive, so the dense run must use the same
+    partition) — hidden, appended rows, and the post-append gathered
+    cache all byte-identical, GQA heads, mixed fills."""
+    cfg = _cfg(num_attention_heads=4, num_kv_heads=2)
+    b, max_len, bk = 3, 256, 128
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    k_cache, v_cache, rope = _prefill_cache(
+        cfg, params, b, max_len, 128, jax.random.key(1))
+    fills = jnp.asarray([37, 128, 1], jnp.int32)
+    x = jax.random.normal(jax.random.key(2), (b, cfg.hidden_size),
+                          jnp.float32)
+
+    want_h, want_k, want_v = fused_decode_step(
+        cfg, params["layers"], x, k_cache, v_cache, fills, rope,
+        block_k=bk, interpret=True)
+
+    rng = np.random.default_rng(7)
+    tables = _shuffled_tables(b, max_len // bk, rng)
+    k_pool = _pool_from_cache(k_cache, bk, tables)
+    v_pool = _pool_from_cache(v_cache, bk, tables)
+    got_h, k_rows, v_rows = fused_decode_step_paged(
+        cfg, params["layers"], x, k_pool, v_pool, jnp.asarray(tables),
+        fills, rope, interpret=True)
+
+    np.testing.assert_array_equal(np.asarray(got_h), np.asarray(want_h))
+    jax.tree.map(lambda g, w: np.testing.assert_array_equal(
+        np.asarray(g), np.asarray(w)), (k_rows, v_rows), (want_k, want_v))
+
+    # the row append (cache_append_rows at table[fill // bk], fill % bk)
+    # lands where the dense cache_update lands, block-gathered view equal
+    fills_np = np.asarray(fills)
+    bids = jnp.asarray(tables[np.arange(b), fills_np // bk], jnp.int32)
+    offs = jnp.asarray(fills_np % bk, jnp.int32)
+    k_pool = cache_append_rows(k_pool, k_rows, bids, offs)
+    v_pool = cache_append_rows(v_pool, v_rows, bids, offs)
+    jtables = jnp.asarray(tables)
+    jax.tree.map(lambda g, w: np.testing.assert_array_equal(
+        np.asarray(g), np.asarray(w)),
+        (cache_gather_blocks(k_pool, jtables),
+         cache_gather_blocks(v_pool, jtables)),
+        (cache_update(k_cache, want_k, fills),
+         cache_update(v_cache, want_v, fills)))
+
+
+def test_fused_paged_matches_dense_fused_int8():
+    """Same bitwise bar, fully int8-resident: int8 weights and the
+    {q, scale} pool pytree — quantized codes gathered through the table
+    must reproduce the dense kernel's output and rows byte-for-byte."""
+    cfg, params, k_cache, v_cache, rope = _int8_setup(
+        True, True, b=3, fill=128)
+    bk, max_len = 128, 256
+    fills = jnp.asarray([37, 128, 1], jnp.int32)
+    x = jax.random.normal(jax.random.key(2), (3, cfg.hidden_size),
+                          jnp.float32)
+
+    want_h, want_k, want_v = fused_decode_step(
+        cfg, params["layers"], x, k_cache, v_cache, fills, rope,
+        block_k=bk, interpret=True)
+
+    rng = np.random.default_rng(11)
+    tables = _shuffled_tables(3, max_len // bk, rng)
+    k_pool = _pool_from_cache(k_cache, bk, tables)
+    v_pool = _pool_from_cache(v_cache, bk, tables)
+    got_h, k_rows, v_rows = fused_decode_step_paged(
+        cfg, params["layers"], x, k_pool, v_pool, jnp.asarray(tables),
+        fills, rope, interpret=True)
+
+    np.testing.assert_array_equal(np.asarray(got_h), np.asarray(want_h))
+    jax.tree.map(lambda g, w: np.testing.assert_array_equal(
+        np.asarray(g), np.asarray(w)), (k_rows, v_rows), (want_k, want_v))

@@ -57,3 +57,85 @@ def test_per_sample_fill_levels_match_einsum():
     got = flash_decode(q[:, 0], k, v, lens + 1, interpret=True)[:, None]
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Paged gather mode (block-table pool): bitwise vs the dense kernel
+# ---------------------------------------------------------------------------
+
+from megatron_llm_tpu.kernels.flash_decode import (  # noqa: E402
+    flash_decode_int8,
+    flash_decode_paged,
+    flash_decode_paged_int8,
+)
+
+
+def _shuffled_tables(b, T, rng):
+    """Per-row block tables with deliberately non-contiguous physical
+    ids (1..b*T shuffled; id 0 is the trash block)."""
+    return (rng.permutation(b * T) + 1).reshape(b, T).astype(np.int32)
+
+
+def _paged_layout(dense_leaves, bk, tables, garbage):
+    """Scatter dense [b, kv, max_len, *] leaves into pool blocks at the
+    physical ids named by ``tables``, trash block 0 filled with large
+    finite garbage — the invariant under test is that table indirection
+    plus fill masking reproduces the dense kernel bitwise no matter the
+    physical layout."""
+    b, kv = dense_leaves[0].shape[:2]
+    T = tables.shape[1]
+    pools = []
+    for leaf in dense_leaves:
+        pool = np.full((1 + b * T, kv, bk) + leaf.shape[3:], garbage,
+                       leaf.dtype)
+        for bi in range(b):
+            for j in range(T):
+                pool[tables[bi, j]] = leaf[bi, :, j * bk:(j + 1) * bk]
+        pools.append(jnp.asarray(pool))
+    return pools
+
+
+def test_paged_bitwise_equals_dense_fp32():
+    """flash_decode_paged over a shuffled pool == flash_decode over the
+    dense cache, BITWISE, at the same block partition (online softmax is
+    not partition-invariant, so block_k must match the pool block)."""
+    b, heads, kv_heads, max_len, d, bk = 3, 8, 2, 512, 128, 128
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(b, heads, d)), jnp.float32)
+    k = rng.normal(size=(b, kv_heads, max_len, d)).astype(np.float32)
+    v = rng.normal(size=(b, kv_heads, max_len, d)).astype(np.float32)
+    lens = jnp.asarray([1, 200, 512], jnp.int32)
+
+    want = flash_decode(q, jnp.asarray(k), jnp.asarray(v), lens,
+                        block_k=bk, interpret=True)
+    tables = _shuffled_tables(b, max_len // bk, rng)
+    k_pool, v_pool = _paged_layout([k, v], bk, tables, 1e4)
+    got = flash_decode_paged(q, k_pool, v_pool, jnp.asarray(tables), lens,
+                             interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_paged_bitwise_equals_dense_int8():
+    """Same bar for the int8 {q, scale} pool form: quantized codes and
+    per-row scales gathered through the table, bitwise-equal output."""
+    b, heads, kv_heads, max_len, d, bk = 3, 4, 2, 512, 128, 128
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(b, heads, d)), jnp.float32)
+    k_q = rng.integers(-127, 128, (b, kv_heads, max_len, d)).astype(np.int8)
+    v_q = rng.integers(-127, 128, (b, kv_heads, max_len, d)).astype(np.int8)
+    k_s = rng.uniform(0.01, 0.1,
+                      (b, kv_heads, max_len)).astype(np.float32)
+    v_s = rng.uniform(0.01, 0.1,
+                      (b, kv_heads, max_len)).astype(np.float32)
+    lens = jnp.asarray([17, 384, 511], jnp.int32)
+
+    want = flash_decode_int8(q, *(jnp.asarray(a) for a in
+                                  (k_q, k_s, v_q, v_s)),
+                             lens, block_k=bk, interpret=True)
+    tables = _shuffled_tables(b, max_len // bk, rng)
+    kq_p, vq_p = _paged_layout([k_q, v_q], bk, tables, 127)
+    ks_p, vs_p = _paged_layout([k_s, v_s], bk, tables, 1e4)
+    got = flash_decode_paged_int8(q, kq_p, ks_p, vq_p, vs_p,
+                                  jnp.asarray(tables), lens,
+                                  interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

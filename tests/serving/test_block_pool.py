@@ -1,0 +1,179 @@
+"""BlockPool allocator discipline (serving/block_pool.py).
+
+Deterministic units for the invariants the engine leans on — trash block
+pinning, reservation soundness, ref counting, copy-on-write — plus a
+seeded randomized storm: thousands of interleaved reserve / alloc /
+share / release / COW operations across simulated requests must never
+double-free, never leak a block, and keep the free list + ref counts +
+reservation ledger mutually consistent at every step.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.serving.block_pool import BlockPool
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_config(num_layers=2, vocab_size=64,
+                       make_vocab_size_divisible_by=8)
+
+
+def test_trash_block_is_permanently_pinned(cfg):
+    pool = BlockPool(cfg, 4, 8)
+    assert pool.TRASH == 0
+    assert pool.ref(0) == 1
+    assert pool.usable_blocks == 3          # n_blocks minus trash
+    pool.decref(0)                          # explicit no-op
+    assert pool.ref(0) == 1
+    with pytest.raises(AssertionError):
+        pool.incref(0)                      # trash is never shared
+
+
+def test_reservation_guarantees_allocation(cfg):
+    pool = BlockPool(cfg, 5, 8)             # 4 usable
+    assert pool.can_reserve(4) and not pool.can_reserve(5)
+    assert pool.reserve(3)
+    assert not pool.reserve(2)              # only 1 unreserved left
+    assert pool.reserve(1)
+    bids = [pool.alloc_reserved() for _ in range(4)]
+    assert sorted(bids) == [1, 2, 3, 4]
+    assert pool.free_blocks == 0 and pool.reserved_blocks == 0
+    pool.decref(bids[0])
+    assert pool.free_blocks == 1
+
+
+def test_decref_double_free_is_caught(cfg):
+    pool = BlockPool(cfg, 3, 8)
+    pool.reserve(1)
+    bid = pool.alloc_reserved()
+    pool.decref(bid)
+    with pytest.raises(AssertionError):
+        pool.decref(bid)
+
+
+@pytest.mark.parametrize("quant", ["fp32", "int8"])
+def test_cow_copies_shared_block_contents(cfg, quant):
+    """ensure_writable on a shared block allocates a fresh block whose
+    device contents equal the original's — for int8 pools both the q and
+    scale leaves — and drops the caller's ref on the shared one."""
+    if quant == "int8":
+        cfg = dataclasses.replace(cfg, kv_cache_quant="int8")
+    cow_calls = []
+    pool = BlockPool(cfg, 4, 8, on_cow=lambda: cow_calls.append(1))
+    pool.reserve(1)
+    bid = pool.alloc_reserved()
+    # write recognizable rows into the block on device
+    pool.k_pool = jax.tree.map(
+        lambda a: a.at[:, bid].set(jnp.ones_like(a[:, bid])), pool.k_pool)
+    pool.incref(bid)                        # a second owner (prefix trie)
+    pool.reserve(1)
+    new = pool.ensure_writable(bid)
+    assert new != bid
+    assert pool.ref(bid) == 1 and pool.ref(new) == 1
+    assert pool.cow_copies == 1 and cow_calls == [1]
+    for leaf in jax.tree.leaves(pool.k_pool):
+        np.testing.assert_array_equal(np.asarray(leaf[:, new]),
+                                      np.asarray(leaf[:, bid]))
+    # exclusively owned: no copy
+    assert pool.ensure_writable(new) == new
+    assert pool.cow_copies == 1
+
+
+def test_ensure_writable_on_trash_allocates_fresh(cfg):
+    """A lazily-growing slot whose table entry is still the trash block
+    gets a fresh block without counting a COW copy."""
+    pool = BlockPool(cfg, 3, 8)
+    pool.reserve(1)
+    bid = pool.ensure_writable(BlockPool.TRASH)
+    assert bid != BlockPool.TRASH and pool.ref(bid) == 1
+    assert pool.cow_copies == 0
+
+
+def test_randomized_storm_never_leaks_or_double_frees(cfg):
+    """Seeded allocator storm: simulated requests reserve worst-case
+    blocks, lazily allocate, share blocks with a simulated trie, COW on
+    shared boundaries, and release in random order.  After every
+    operation the ledger must balance:
+
+        free + sum(live refs' blocks) == usable
+        reserved <= free
+
+    and at the end — all requests retired, trie drained — every block is
+    back on the free list.
+    """
+    rng = np.random.default_rng(42)
+    pool = BlockPool(cfg, 34, 4)            # 33 usable
+    live = {}                               # request id -> {"res": n, "bids": []}
+    trie = []                               # (bid) refs held by the "trie"
+    next_rid = 0
+
+    def check_ledger():
+        # every allocated block has ref >= 1; freed blocks have ref 0
+        held = {b for st in live.values() for b in st["bids"]} | set(trie)
+        assert pool.used_blocks >= len(held)  # sharing collapses ids
+        assert pool.free_blocks + pool.used_blocks == pool.usable_blocks
+        assert pool.reserved_blocks <= pool.free_blocks
+        for b in held:
+            assert pool.ref(b) >= 1
+
+    for step in range(4000):
+        op = rng.integers(0, 5)
+        if op == 0:                          # admit: reserve worst case
+            want = int(rng.integers(1, 5))
+            if pool.can_reserve(want):
+                live[next_rid] = {"res": want, "bids": []}
+                assert pool.reserve(want)
+                next_rid += 1
+        elif op == 1 and live:               # grow: lazy alloc
+            rid = int(rng.choice(list(live)))
+            st = live[rid]
+            if st["res"] > 0:
+                st["bids"].append(pool.alloc_reserved())
+                st["res"] -= 1
+        elif op == 2 and live:               # share a block with the trie
+            rid = int(rng.choice(list(live)))
+            bids = live[rid]["bids"]
+            if bids:
+                b = int(rng.choice(bids))
+                pool.incref(b)
+                trie.append(b)
+        elif op == 3 and live:               # COW a shared boundary block
+            rid = int(rng.choice(list(live)))
+            st = live[rid]
+            shared = [b for b in st["bids"] if pool.ref(b) > 1]
+            if shared and st["res"] > 0:
+                b = int(rng.choice(shared))
+                new = pool.ensure_writable(b)
+                assert new != b
+                st["bids"][st["bids"].index(b)] = new
+                st["res"] -= 1
+        elif op == 4:                        # retire a request or evict
+            if live and rng.integers(0, 2):
+                rid = int(rng.choice(list(live)))
+                st = live.pop(rid)
+                for b in st["bids"]:
+                    pool.decref(b)
+                if st["res"]:
+                    pool.unreserve(st["res"])
+            elif trie:
+                pool.decref(trie.pop(int(rng.integers(0, len(trie)))))
+        check_ledger()
+
+    for st in live.values():                 # drain everything
+        for b in st["bids"]:
+            pool.decref(b)
+        if st["res"]:
+            pool.unreserve(st["res"])
+    for b in trie:
+        pool.decref(b)
+    assert pool.used_blocks == 0
+    assert pool.free_blocks == pool.usable_blocks
+    assert pool.reserved_blocks == 0
+    assert pool.ref_counts() == {}

@@ -294,3 +294,121 @@ def test_scheduler_failure_fails_requests_loudly(tiny):
         assert h.done()
     finally:
         engine.shutdown()
+
+
+class TestPagedEquivalence:
+    """The paged acceptance matrix (docs/serving.md, 'Paged KV cache'):
+    with a SMALL block size — mixed-length requests spanning many blocks,
+    lazy decode-time growth crossing block boundaries, zero-copy prefix
+    hits — every committed token must equal the one-shot
+    ``generate_tokens`` trajectory bitwise.  fp32 and fully-int8, whole-
+    prompt and chunked admission, pipelined decode on and off; plus the
+    degenerate fixed-stride configuration (``kv_block_size ==
+    max_seq_len``), which must be the same code path with one block per
+    slot."""
+
+    @pytest.fixture(scope="class")
+    def tiny_int8(self, tiny):
+        import dataclasses
+
+        from megatron_llm_tpu.ops.quant import quantize_params
+
+        cfg, params = tiny
+        return (dataclasses.replace(cfg, kv_cache_quant="int8"),
+                quantize_params(params))
+
+    def _drive(self, cfg, params, **overrides):
+        """Mixed-length ragged batch through a paged engine; returns the
+        results plus a metrics snapshot."""
+        rng = np.random.default_rng(23)
+        prompts = [rng.integers(1, cfg.vocab_size, n).tolist()
+                   for n in (3, 17, 30, 9)]  # 1..4 blocks at bk=8
+        max_news = [20, 9, 14, 5]            # growth crosses boundaries
+        kw = dict(max_batch_size=4, max_seq_len=64, max_queue_size=16,
+                  kv_block_size=8)
+        kw.update(overrides)
+        engine = ServingEngine(cfg, params, EngineConfig(**kw)).start()
+        try:
+            handles = [engine.submit(p, max_new_tokens=n,
+                                     use_eos_stop=False)
+                       for p, n in zip(prompts, max_news)]
+            results = [h.result(timeout=600) for h in handles]
+        finally:
+            engine.shutdown()
+        for p, n, r in zip(prompts, max_news, results):
+            assert r.finish_reason == "length"
+            assert r.tokens == _reference(cfg, params, p, n)
+        return engine.metrics.snapshot()
+
+    @pytest.mark.parametrize("pipeline", [True, False],
+                             ids=["pipelined", "sync"])
+    def test_fp32_whole_prompt(self, tiny, pipeline):
+        snap = self._drive(*tiny, pipeline_decode=pipeline)
+        assert snap["max_decode_batch"] >= 2
+        assert snap["blocks_used"] >= 0 and snap["blocks_free"] >= 0
+
+    @pytest.mark.parametrize("pipeline", [True, False],
+                             ids=["pipelined", "sync"])
+    def test_fp32_chunked_admission(self, tiny, pipeline):
+        snap = self._drive(*tiny, prefill_chunk=8,
+                           pipeline_decode=pipeline)
+        assert snap["prefill_chunks"] > 4  # really ran chunk-at-a-time
+
+    def test_int8_whole_prompt(self, tiny_int8):
+        self._drive(*tiny_int8)
+
+    def test_int8_chunked_pipelined(self, tiny_int8):
+        self._drive(*tiny_int8, prefill_chunk=8, pipeline_decode=True)
+
+    def test_fixed_stride_degenerate_block(self, tiny):
+        """kv_block_size == max_seq_len: one block per slot — the
+        pre-paging layout expressed in the same engine code path."""
+        snap = self._drive(*tiny, kv_block_size=64)
+        assert snap["blocks_used"] <= 4 + 1  # <= one block per slot
+
+    def test_prefix_hit_with_small_blocks(self, tiny):
+        """Zero-copy sharing under real paging: sequential shared-prefix
+        requests hit and stay bitwise equal, with no COW copies."""
+        cfg, params = tiny
+        rng = np.random.default_rng(29)
+        prompt = rng.integers(1, cfg.vocab_size, 21).tolist()
+        engine = ServingEngine(cfg, params, EngineConfig(
+            max_batch_size=2, max_seq_len=64, max_queue_size=8,
+            kv_block_size=8, prefix_cache_blocks=16)).start()
+        try:
+            a = engine.submit(prompt, max_new_tokens=10,
+                              use_eos_stop=False).result(timeout=600)
+            b = engine.submit(prompt, max_new_tokens=10,
+                              use_eos_stop=False).result(timeout=600)
+        finally:
+            engine.shutdown()
+        ref = _reference(cfg, params, prompt, 10)
+        assert a.tokens == ref and b.tokens == ref
+        snap = engine.metrics.snapshot()
+        assert snap["prefix_hits"] == 1
+        assert snap["cow_copies_total"] == 0
+
+    def test_pool_exhaustion_parks_and_recovers(self, tiny):
+        """A pool too small for all requests at once: admission parks at
+        the queue head until retirements free blocks — every request
+        still completes with the exact one-shot trajectory (FIFO, no
+        deadlock, no corruption)."""
+        cfg, params = tiny
+        rng = np.random.default_rng(31)
+        prompts = [rng.integers(1, cfg.vocab_size, 16).tolist()
+                   for _ in range(5)]
+        # 9 usable blocks of 8 = 72 tokens; each request needs
+        # ceil((16+8)/8) = 3 blocks, so at most 3 can run concurrently
+        engine = ServingEngine(cfg, params, EngineConfig(
+            max_batch_size=5, max_seq_len=32, max_queue_size=8,
+            kv_block_size=8, kv_pool_blocks=10)).start()
+        try:
+            handles = [engine.submit(p, max_new_tokens=8,
+                                     use_eos_stop=False) for p in prompts]
+            results = [h.result(timeout=600) for h in handles]
+        finally:
+            engine.shutdown()
+        for p, r in zip(prompts, results):
+            assert r.tokens == _reference(cfg, params, p, 8)
+        snap = engine.metrics.snapshot()
+        assert snap["max_decode_batch"] <= 3  # the pool really bounded it

@@ -131,17 +131,18 @@ def test_int8_slot_batch_routes_through_fused_kernel(tiny_int8):
                             int(rng.integers(3, 9))).tolist()
                for _ in range(4)]
     max_news = [int(rng.integers(4, 10)) for _ in range(4)]
-    orig_eligible = ds.fused_decode_eligible
+    orig_eligible = ds.fused_paged_decode_eligible
     try:
-        # force the fused path for single-token steps only (prefill has
-        # s>1); fused_decode_step defaults to interpret mode off-TPU
-        ds.fused_decode_eligible = lambda c, p, kc, s, plat: s == 1
+        # force the fused paged path (CPU would reject on platform alone;
+        # fused_decode_step_paged defaults to interpret mode off-TPU);
+        # kv_block_size keeps the interpret-mode attend grid small
+        ds.fused_paged_decode_eligible = lambda *a: True
 
         # one-slot engine: each request decodes alone through the fused
         # kernel — the committed-trajectory reference
         single = []
         engine = _engine(cfg, params, max_batch_size=1, max_seq_len=128,
-                         pipeline_decode=True).start()
+                         kv_block_size=32, pipeline_decode=True).start()
         try:
             for p, n in zip(prompts, max_news):
                 single.append(engine.submit(
@@ -150,11 +151,11 @@ def test_int8_slot_batch_routes_through_fused_kernel(tiny_int8):
         finally:
             engine.shutdown()
         engine = _engine(cfg, params, max_batch_size=4, max_seq_len=128,
-                         pipeline_decode=True).start()
+                         kv_block_size=32, pipeline_decode=True).start()
         batched = _run_batch(engine, prompts, max_news)
         snap = engine.metrics.snapshot()
     finally:
-        ds.fused_decode_eligible = orig_eligible
+        ds.fused_paged_decode_eligible = orig_eligible
     for i, (s, b) in enumerate(zip(single, batched)):
         assert b.finish_reason == "length"
         assert b.tokens == s.tokens, f"slot batching perturbed request {i}"

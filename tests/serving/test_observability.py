@@ -103,6 +103,14 @@ def test_prometheus_endpoint_round_trip(model):
         assert ("serving_slo_compliance",
                 frozenset({("slo", dim)})) in samples
     assert samples[("serving_slo_healthy", frozenset())] in (0.0, 1.0)
+    # paged KV pool gauges + the COW counter ride the same scrape; after
+    # traffic retires, used goes back to 0 but free reflects the pool
+    assert types["serving_blocks_free"] == "gauge"
+    assert types["serving_blocks_used"] == "gauge"
+    assert types["serving_kv_cache_util"] == "gauge"
+    assert types["serving_cow_copies_total"] == "counter"
+    assert samples[("serving_blocks_free", frozenset())] > 0
+    assert samples[("serving_cow_copies_total", frozenset())] == 0.0
     # the resilience collector (metrics.py RESILIENCE_EVENTS) shares it
     assert types["resilience_events_total"] == "counter"
 
@@ -126,7 +134,9 @@ def test_json_metrics_shape_unchanged(model):
         server.shutdown()
     assert snap["completed"] == 1
     for key in ("submitted", "decode_iterations", "ttft",
-                "per_token_latency", "device_idle_frac", "prefix_hit_rate"):
+                "per_token_latency", "device_idle_frac", "prefix_hit_rate",
+                "blocks_free", "blocks_used", "kv_cache_util",
+                "cow_copies_total"):
         assert key in snap
     assert snap["ttft"]["count"] == 1  # unified snapshot keys
     assert "p99_s" in snap["ttft"] and "total_count" in snap["ttft"]

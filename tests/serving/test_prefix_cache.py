@@ -2,15 +2,17 @@
 
 Two layers:
 
-- **unit** — the radix trie over real device blocks: offer/match/assemble
-  round-trips rows exactly (fp32 and int8 {q, scale} bit-identical), LRU
-  eviction respects the block budget, ref-count pinning protects a live
-  request's blocks under pressure, and a released lease becomes evictable;
-  plus ``models/model.py:cache_slot_copy`` row surgery directly.
+- **unit** — the radix trie over pool block ids: offers adopt a retiring
+  slot's blocks by ref bump (zero K/V copies), matches hand the ids back
+  as a pinned lease, LRU eviction respects the block budget and returns
+  pool refs, ref-count pinning protects a live request's blocks under
+  pressure, and a released lease becomes evictable; plus
+  ``models/model.py:cache_slot_copy`` row surgery directly.
 - **engine** — the load-bearing invariant: a prefix-HIT admission must
   commit bitwise the same tokens as the one-shot ``generate_tokens``
   trajectory (the same bar every fast-path PR met), whole-prompt and
-  chunked, fp32 and fully-int8, with the hit actually counted.
+  chunked, fp32 and fully-int8, with the hit actually counted and the
+  pure-hit path performing ZERO copy-on-write copies.
 """
 
 import dataclasses
@@ -96,39 +98,56 @@ def test_cache_slot_copy_moves_exact_rows(tiny, quant):
 
 
 # ---------------------------------------------------------------------------
-# Trie units (real device blocks, no engine)
+# Trie units (pool block ids, no engine)
 # ---------------------------------------------------------------------------
 
+from megatron_llm_tpu.serving.block_pool import BlockPool  # noqa: E402
 
-def _mk_cache(cfg, *, block=4, budget=8, max_seq=32, metrics=None):
-    return PrefixCache(cfg, block_tokens=block, max_blocks=budget,
-                       max_seq_len=max_seq, metrics=metrics)
+
+def _mk_cache(cfg, *, block=4, budget=8, max_seq=32, n_blocks=32,
+              metrics=None):
+    pool = BlockPool(cfg, n_blocks, block)
+    return pool, PrefixCache(cfg, pool=pool, max_blocks=budget,
+                             max_seq_len=max_seq, metrics=metrics)
+
+
+def _slot_table(pool, n):
+    """Emulate an admitted slot: allocate ``n`` blocks (the slot holds
+    one pool ref each, as SlotAllocator.insert would)."""
+    assert pool.reserve(n)
+    return [pool.alloc_reserved() for _ in range(n)]
+
+
+def _retire(pool, table):
+    """Emulate slot release after an offer: the slot's own refs drop;
+    only refs the trie (or another sharer) took keep blocks alive."""
+    for bid in table:
+        pool.decref(bid)
 
 
 @pytest.mark.parametrize("quant", ["fp32", "int8"])
-def test_offer_match_assemble_roundtrip(tiny, quant):
-    """offer() from slot 1 of a big cache, then match + assemble: the
-    assembled batch-1 cache must hold those exact rows — for int8, the
-    {q, scale} leaves bit-identical (never dequantized)."""
+def test_offer_match_is_zero_copy_ref_bump(tiny, quant):
+    """offer() adopts a retiring slot's blocks by pool incref — no K/V
+    bytes move (fp32 and int8 pools alike) — and a later match hands the
+    SAME pool block ids back as a pinned lease."""
     cfg, _ = tiny
     if quant == "int8":
         cfg = dataclasses.replace(cfg, kv_cache_quant="int8")
     m = ServingMetrics()
-    cache = _mk_cache(cfg, metrics=m)
-    k_big, v_big = (jax.tree.map(jnp.asarray, c) for c in
-                    (_rand_like(model_lib.init_kv_cache(cfg, 2, 32)[0], 2),
-                     _rand_like(model_lib.init_kv_cache(cfg, 2, 32)[1], 3)))
+    pool, cache = _mk_cache(cfg, metrics=m)
     tokens = list(range(1, 11))  # 10 tokens -> 2 full blocks of 4
-    assert cache.offer(tokens, k_big, v_big, slot=1) == 2
+    table = _slot_table(pool, 3)  # ceil(10/4): 2 full + boundary block
+    assert cache.offer(tokens, table) == 2
     assert cache.blocks == 2
+    assert all(pool.ref(b) == 2 for b in table[:2])  # slot + trie
+    _retire(pool, table)
+    assert all(pool.ref(b) == 1 for b in table[:2])  # trie keeps them
+    assert pool.used_blocks == 2                     # boundary block freed
 
     lease = cache.match_and_acquire(tokens)
     assert lease is not None and lease.tokens == 8
-    k_small, v_small = cache.assemble(lease)
-    for got, want in zip(_rows(k_small, 0, 0, 8), _rows(k_big, 1, 0, 8)):
-        np.testing.assert_array_equal(got, want)
-    for got, want in zip(_rows(v_small, 0, 0, 8), _rows(v_big, 1, 0, 8)):
-        np.testing.assert_array_equal(got, want)
+    assert lease.bids == table[:2]   # the very same pool blocks
+    assert pool.cow_copies == 0      # adoption + match moved zero bytes
     cache.release(lease)
     snap = m.snapshot()
     assert snap["prefix_hits"] == 1
@@ -139,10 +158,11 @@ def test_match_is_strictly_shorter_than_prompt(tiny):
     """A fully-cached prompt must still leave >= 1 token for the suffix
     prefill: an exactly-2-block prompt matches only 1 block."""
     cfg, _ = tiny
-    cache = _mk_cache(cfg)
-    k, v = model_lib.init_kv_cache(cfg, 1, 32)
+    pool, cache = _mk_cache(cfg)
     tokens = list(range(1, 9))  # exactly 2 blocks
-    cache.offer(tokens, k, v, slot=0)
+    table = _slot_table(pool, 2)
+    cache.offer(tokens, table)
+    _retire(pool, table)
     lease = cache.match_and_acquire(tokens)
     assert lease is not None and lease.tokens == 4
     cache.release(lease)
@@ -153,9 +173,10 @@ def test_match_is_strictly_shorter_than_prompt(tiny):
 def test_match_miss_diverging_block(tiny):
     cfg, _ = tiny
     m = ServingMetrics()
-    cache = _mk_cache(cfg, metrics=m)
-    k, v = model_lib.init_kv_cache(cfg, 1, 32)
-    cache.offer([1, 2, 3, 4, 5, 6, 7, 8], k, v, slot=0)
+    pool, cache = _mk_cache(cfg, metrics=m)
+    table = _slot_table(pool, 2)
+    cache.offer([1, 2, 3, 4, 5, 6, 7, 8], table)
+    _retire(pool, table)
     assert cache.match_and_acquire([9, 9, 9, 9, 5, 6]) is None
     # divergence in the SECOND block still matches the first
     lease = cache.match_and_acquire([1, 2, 3, 4, 9, 9, 9, 9, 1])
@@ -166,17 +187,22 @@ def test_match_miss_diverging_block(tiny):
 
 def test_lru_eviction_under_budget_pressure(tiny):
     """Budget 2: offering a third distinct prefix evicts the least
-    recently USED block (A was touched after B's insert, so B goes)."""
+    recently USED block (A was touched after B's insert, so B goes) —
+    and eviction returns the block's pool ref to the free list."""
     cfg, _ = tiny
     m = ServingMetrics()
-    cache = _mk_cache(cfg, budget=2, metrics=m)
-    k, v = model_lib.init_kv_cache(cfg, 1, 32)
+    pool, cache = _mk_cache(cfg, budget=2, metrics=m)
     A, B, C = [10] * 5, [20 + i for i in range(5)], [30] * 5
-    cache.offer(A, k, v, slot=0)
-    cache.offer(B, k, v, slot=0)
+    for toks in (A, B):
+        t = _slot_table(pool, 2)
+        cache.offer(toks, t)
+        _retire(pool, t)
     cache.release(cache.match_and_acquire(A))  # LRU-touch A
-    cache.offer(C, k, v, slot=0)
+    t = _slot_table(pool, 2)
+    cache.offer(C, t)
+    _retire(pool, t)
     assert cache.blocks == 2
+    assert pool.used_blocks == 2               # B's block is FREE again
     assert cache.match_and_acquire(B) is None          # evicted
     lease = cache.match_and_acquire(A)                 # survived
     assert lease is not None
@@ -189,24 +215,30 @@ def test_ref_pinning_blocks_eviction_until_release(tiny):
     """A block pinned by a live lease must survive any budget pressure;
     once released it becomes the eviction victim."""
     cfg, _ = tiny
-    cache = _mk_cache(cfg, budget=1)
-    k, v = model_lib.init_kv_cache(cfg, 1, 32)
+    pool, cache = _mk_cache(cfg, budget=1)
     A, B = [1, 2, 3, 4, 5], [6, 7, 8, 9, 10]
-    cache.offer(A, k, v, slot=0)
+    t = _slot_table(pool, 2)
+    cache.offer(A, t)
+    _retire(pool, t)
     lease = cache.match_and_acquire(A)   # pin A (a live request)
     assert lease is not None
-    cache.offer(B, k, v, slot=0)         # over budget; A is pinned
+    t = _slot_table(pool, 2)
+    cache.offer(B, t)                    # over budget; A is pinned
+    _retire(pool, t)
     assert cache.match_and_acquire(B) is None   # B was the only victim
     held = cache.match_and_acquire(A)
     assert held is not None                     # A survived the pressure
     cache.release(held)
     cache.release(lease)                 # unpin: A is now fair game
-    cache.offer(B, k, v, slot=0)
+    t = _slot_table(pool, 2)
+    cache.offer(B, t)
+    _retire(pool, t)
     assert cache.match_and_acquire(A) is None   # evicted post-release
     got = cache.match_and_acquire(B)
     assert got is not None
     cache.release(got)
     assert cache.blocks == 1
+    assert pool.used_blocks == 1         # every evicted ref came back
 
 
 def test_eviction_never_orphans_a_chain_middle(tiny):
@@ -214,17 +246,41 @@ def test_eviction_never_orphans_a_chain_middle(tiny):
     with the deep chain's tail pinned, budget pressure may only evict
     OTHER unpinned leaves, never the chain's interior."""
     cfg, _ = tiny
-    cache = _mk_cache(cfg, budget=3)
-    k, v = model_lib.init_kv_cache(cfg, 1, 32)
+    pool, cache = _mk_cache(cfg, budget=3)
     chain = list(range(1, 13))           # 3 blocks: parent->child->leaf
-    cache.offer(chain, k, v, slot=0)     # exactly fills budget 3
+    t = _slot_table(pool, 3)
+    cache.offer(chain, t)                # exactly fills budget 3
+    _retire(pool, t)
     lease = cache.match_and_acquire(chain + [99])  # pin all 3
     assert lease is not None and lease.tokens == 12
-    cache.offer([50] * 6, k, v, slot=0)  # unpinned single block: evicted
+    t = _slot_table(pool, 2)
+    cache.offer([50] * 6, t)             # unpinned single block: evicted
+    _retire(pool, t)
     assert cache.match_and_acquire([50] * 6) is None
     # the pinned chain is intact end to end
     again = cache.match_and_acquire(chain + [99])
     assert again is not None and again.tokens == 12
+    cache.release(again)
+    cache.release(lease)
+
+
+def test_forced_eviction_under_pool_pressure(tiny):
+    """evict_blocks(): the engine squeezes the trie when the POOL (not
+    the trie budget) is scarce — unpinned blocks go even though the trie
+    is within budget, pinned ones never do."""
+    cfg, _ = tiny
+    pool, cache = _mk_cache(cfg, budget=8)
+    A, B = [1, 2, 3, 4, 5], [6, 7, 8, 9, 10]
+    for toks in (A, B):
+        t = _slot_table(pool, 2)
+        cache.offer(toks, t)
+        _retire(pool, t)
+    lease = cache.match_and_acquire(A)   # pin A
+    freed = cache.evict_blocks(2)
+    assert freed == 1                    # only B was evictable
+    assert cache.match_and_acquire(B) is None
+    again = cache.match_and_acquire(A + [0])
+    assert again is not None             # pinned A survived the squeeze
     cache.release(again)
     cache.release(lease)
 
@@ -283,6 +339,25 @@ def test_prefix_hit_bitwise_equals_cold(fixture, request):
     # both hits matched the 8-token (2-block) shared prefix
     assert snap["prefix_hit_tokens"]["mean"] == 8.0
     assert snap["prefix_blocks"] > 0
+
+
+def test_pure_hit_admission_performs_zero_copies(tiny):
+    """The zero-copy acceptance bar: shared-prefix admissions are ref
+    bumps into the slot table — ``cow_copies_total`` stays 0 across a
+    whole hit-heavy sequence (decode appends land in fresh, unshared
+    boundary blocks), while the pool gauges show real occupancy."""
+    cfg, params = tiny
+    rng = np.random.default_rng(16)
+    prompt = rng.integers(1, cfg.vocab_size, 13).tolist()
+    engine = _engine(cfg, params).start()
+    got = _run_seq(engine, [(prompt, 6)] * 3)
+    ref = _reference(cfg, params, prompt, 6)
+    assert got == [ref] * 3
+    snap = engine.metrics.snapshot()
+    assert snap["prefix_hits"] == 2
+    assert snap["cow_copies_total"] == 0
+    assert snap["blocks_used"] > 0          # trie still holds the prefix
+    assert 0.0 < snap["kv_cache_util"] <= 1.0
 
 
 def test_prefix_hit_bitwise_chunked(tiny):

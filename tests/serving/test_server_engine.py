@@ -190,3 +190,45 @@ def test_http_metrics_endpoint(model):
     assert snap["decode_iterations"] > 0
     assert snap["device_step_time"]["count"] > 0
     assert "device_idle_frac" in snap and "sched_host_time" in snap
+
+
+def test_kv_endpoint_and_dump_tool(model):
+    """GET /kv: ``pool: null`` before the lazy engine exists, live pool
+    stats + per-slot tables after traffic; tools/dump_kv_pool.py renders
+    the same snapshot end-to-end against the HTTP endpoint."""
+    from megatron_llm_tpu.tools import dump_kv_pool
+
+    cfg, params = model
+    server = MegatronServer(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=2, kv_block_size=8)
+    server.run("127.0.0.1", 0, block=False)
+    try:
+        kv_url = f"http://127.0.0.1:{server.port}/kv"
+        with urllib.request.urlopen(kv_url, timeout=60) as resp:
+            assert resp.status == 200
+            pre = json.loads(resp.read())
+        assert pre == {"pool": None, "slots": {}}  # engine not started yet
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/api",
+            data=json.dumps({"prompts": ["5 9 3 7"],
+                             "tokens_to_generate": 4,
+                             "no_early_termination": True}).encode(),
+            method="PUT", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            assert resp.status == 200
+
+        with urllib.request.urlopen(kv_url, timeout=60) as resp:
+            snap = json.loads(resp.read())
+        pool = snap["pool"]
+        assert pool["block_size"] == 8
+        assert pool["blocks_used"] + pool["blocks_free"] \
+            + 1 == pool["n_blocks"]  # trash block is neither used nor free
+        assert pool["cow_copies"] == 0
+        assert snap["table_blocks"] >= 1
+        assert isinstance(snap["slots"], dict)  # request retired -> empty
+
+        assert dump_kv_pool.main(["--url", kv_url.removesuffix("/kv")]) == 0
+    finally:
+        server.shutdown()

@@ -1,5 +1,6 @@
 """KV-slot surgery (models/model.py cache_slot_update/read) and the
-SlotAllocator free-list discipline."""
+paged SlotAllocator: free-list discipline, block-table inserts over a
+shared BlockPool, and zero-copy prefix sharing via ref bumps."""
 
 import jax
 import jax.numpy as jnp
@@ -9,6 +10,7 @@ import pytest
 from megatron_llm_tpu.config import tiny_config
 from megatron_llm_tpu.models import model as model_lib
 from megatron_llm_tpu.serving import SlotAllocator
+from megatron_llm_tpu.serving.block_pool import BlockPool
 
 
 @pytest.fixture(scope="module")
@@ -58,7 +60,7 @@ def test_cache_slot_update_pytree_aware():
 
 
 def test_slot_allocator_free_list(cfg):
-    alloc = SlotAllocator(cfg, 3, 8)
+    alloc = SlotAllocator(cfg, 3, 8, BlockPool(cfg, 8, 4))
     assert alloc.free_slots == 3 and alloc.active_slots == 0
     taken = [alloc.alloc() for _ in range(3)]
     assert sorted(taken) == [0, 1, 2]
@@ -75,17 +77,55 @@ def test_slot_allocator_free_list(cfg):
 
 
 def test_slot_allocator_insert_roundtrip(cfg):
-    alloc = SlotAllocator(cfg, 2, 8)
-    k1, v1 = model_lib.init_kv_cache(cfg, 1, 8)
+    pool = BlockPool(cfg, 9, 4)
+    alloc = SlotAllocator(cfg, 2, 8, pool)  # table_blocks = 2
+    k1, v1 = model_lib.init_kv_cache(cfg, 1, alloc.width)
     k1 = jax.tree.map(lambda a: jnp.full_like(a, 2.0), k1)
     v1 = jax.tree.map(lambda a: jnp.full_like(a, 3.0), v1)
-    alloc.insert(1, k1, v1)
+    slot = alloc.alloc()
+    assert pool.reserve(2)
+    alloc.set_reservation(slot, 2)
+    alloc.insert(slot, k1, v1, n_tokens=8)
+    assert pool.used_blocks == 2 and alloc.reserved[slot] == 0
+    # the gathered view of the slot's table reproduces the dense insert
+    tbl = jnp.asarray(alloc.tables[slot:slot + 1])
     jax.tree.map(lambda g, s: np.testing.assert_array_equal(
         np.asarray(g), np.asarray(s)),
-        model_lib.cache_slot_read(alloc.k_cache, 1), k1)
+        model_lib.cache_gather_blocks(alloc.k_pool, tbl), k1)
     jax.tree.map(lambda g, s: np.testing.assert_array_equal(
         np.asarray(g), np.asarray(s)),
-        model_lib.cache_slot_read(alloc.v_cache, 1), v1)
-    # slot 0 untouched
-    jax.tree.map(lambda r: np.testing.assert_array_equal(np.asarray(r), 0),
-                 model_lib.cache_slot_read(alloc.k_cache, 0))
+        model_lib.cache_gather_blocks(alloc.v_pool, tbl), v1)
+    # release drops the refs and returns the blocks to the free list
+    alloc.release(slot)
+    assert pool.used_blocks == 0 and pool.free_blocks == 8
+
+
+def test_insert_shared_prefix_blocks_are_ref_bumps(cfg):
+    """A prefix hit's shared block ids land in the table by incref —
+    the scatter touches only the freshly computed tail blocks, and
+    releasing either sharer never frees a block still referenced."""
+    pool = BlockPool(cfg, 9, 4)
+    alloc = SlotAllocator(cfg, 2, 16, pool)  # table_blocks = 4
+    kd, vd = model_lib.init_kv_cache(cfg, 1, alloc.width)
+    kd = jax.tree.map(lambda a: jnp.full_like(a, 1.0), kd)
+    vd = jax.tree.map(lambda a: jnp.full_like(a, 1.0), vd)
+    s0 = alloc.alloc()
+    assert pool.reserve(3)
+    alloc.set_reservation(s0, 3)
+    alloc.insert(s0, kd, vd, n_tokens=12)  # blocks 0..2 of the table
+    shared = [int(b) for b in alloc.tables[s0][:2]]
+    cow_before = pool.cow_copies
+
+    s1 = alloc.alloc()
+    assert pool.reserve(1)  # only the non-shared tail block
+    alloc.set_reservation(s1, 1)
+    alloc.insert(s1, kd, vd, n_tokens=12, shared_bids=shared)
+    assert [int(b) for b in alloc.tables[s1][:2]] == shared
+    assert all(pool.ref(b) == 2 for b in shared)
+    assert pool.cow_copies == cow_before  # pure ref bump, zero copies
+    assert pool.used_blocks == 4  # 3 + 1 fresh tail, not 3 + 3
+
+    alloc.release(s0)
+    assert all(pool.ref(b) == 1 for b in shared)  # s1 keeps them alive
+    alloc.release(s1)
+    assert pool.used_blocks == 0
