@@ -7,4 +7,15 @@ Pallas flash-attention and norm kernels, and a functional train step.
 
 __version__ = "0.1.0"
 
-from . import config  # noqa: F401
+
+def __getattr__(name):
+    # `config` loads lazily (it pulls in jax at import time) so that bare
+    # `import megatron_llm_tpu` stays stdlib-only — the static-analysis
+    # pass (analysis/, `python -m megatron_llm_tpu.analysis`) must run on
+    # a CI host with no dependencies installed.  Submodule imports
+    # (`from megatron_llm_tpu.config import ...`) are unaffected.
+    if name == "config":
+        import importlib
+
+        return importlib.import_module(".config", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,28 @@
+"""tpulint: codebase-specific static analysis + opt-in runtime sanitizers.
+
+Static side (stdlib-only, ``ast``-based — importable without jax):
+
+* ``core``  — findings, suppression comments, baselines, the file walker;
+* ``rules`` — the five rule families tuned to this repo's invariants:
+  ``recompile``, ``host-sync``, ``donation``, ``tracer-leak``,
+  ``lock-discipline`` (docs/analysis.md has the catalog);
+* ``python -m megatron_llm_tpu.analysis`` (or ``tools/lint.py``) runs
+  the pass over the package and exits nonzero on unbaselined findings.
+
+Runtime side (``analysis.sanitizers``, gated behind ``MEGATRON_SANITIZE=1``
+or ``EngineConfig.sanitize``): a jit recompilation guard, the block-pool
+ledger sanitizer, and a lock-order checker.  ``sanitizers`` imports jax
+lazily so the static pass stays dependency-free.
+"""
+
+from .core import (  # noqa: F401
+    AnalysisConfig,
+    Finding,
+    RULES,
+    analyze_paths,
+    analyze_source,
+    default_baseline_path,
+    default_targets,
+    load_baseline,
+    save_baseline,
+)

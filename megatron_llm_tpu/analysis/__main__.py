@@ -1,0 +1,104 @@
+"""CLI for the tpulint static pass.
+
+Usage::
+
+    python -m megatron_llm_tpu.analysis [paths...] [options]
+
+With no paths, scans the package plus the repo-root ``tools/``
+directory.  Exit codes: 0 clean (or all findings baselined), 1 new
+findings, 2 usage/internal error.  Never imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List
+
+from .core import (
+    AnalysisConfig,
+    Finding,
+    RULES,
+    analyze_paths,
+    default_baseline_path,
+    default_targets,
+    load_baseline,
+    save_baseline,
+    split_by_baseline,
+)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m megatron_llm_tpu.analysis",
+        description="tpulint: recompile/host-sync/donation/tracer-leak/"
+                    "lock-discipline static analysis for this codebase.")
+    p.add_argument("paths", nargs="*", type=Path,
+                   help="files or directories to scan "
+                        "(default: the package and tools/)")
+    p.add_argument("--baseline", type=Path, default=None,
+                   help="baseline JSON path "
+                        f"(default: {default_baseline_path().name} next to "
+                        "the package)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline; report every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write current findings to the baseline and exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as JSON on stdout")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def _emit_json(new: List[Finding], baselined: List[Finding],
+               stale: List[str], files: int) -> None:
+    payload = {
+        "files_scanned": files,
+        "new": [f.__dict__ | {"fingerprint": f.fingerprint} for f in new],
+        "baselined": [f.fingerprint for f in baselined],
+        "stale_baseline_entries": sorted(stale),
+    }
+    json.dump(payload, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+
+
+def main(argv: List[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid, desc in RULES.items():
+            print(f"{rid}: {desc}")
+        return 0
+    targets = args.paths or default_targets()
+    for t in targets:
+        if not Path(t).exists():
+            print(f"error: no such path: {t}", file=sys.stderr)
+            return 2
+    findings, files = analyze_paths(targets, AnalysisConfig())
+    if args.update_baseline:
+        path = save_baseline(findings, args.baseline)
+        print(f"wrote {len(findings)} fingerprint(s) to {path}")
+        return 0
+    baseline = set() if args.no_baseline else load_baseline(args.baseline)
+    new, baselined, stale = split_by_baseline(findings, baseline)
+    if args.as_json:
+        _emit_json(new, baselined, sorted(stale), files)
+    else:
+        for f in new:
+            print(f.render())
+        if baselined:
+            print(f"[tpulint] {len(baselined)} baselined finding(s) "
+                  "suppressed")
+        for fp in sorted(stale):
+            print(f"[tpulint] stale baseline entry (fixed? run "
+                  f"--update-baseline): {fp}")
+        status = "FAIL" if new else "ok"
+        print(f"[tpulint] {status}: {files} file(s) scanned, "
+              f"{len(new)} new finding(s)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,289 @@
+"""tpulint core: findings, suppression comments, baselines, file walking.
+
+Everything here is stdlib-only (``ast`` + ``json``) so the static pass
+runs on a bare CI host with no jax installed.  The rule implementations
+live in ``rules.py``; this module owns the machinery around them:
+
+* ``Finding`` — one diagnostic, with a line-free ``fingerprint`` so a
+  baseline survives unrelated edits above the finding;
+* suppression comments — a trailing comment of the form
+  ``tpulint: allow[<rule>] <reason>`` on the offending line (or a
+  comment line directly above it) silences exactly that rule there; a
+  missing reason is itself reported, so every suppression in the tree
+  documents *why* the hazard is intended;
+* a ``tpulint: hot-path`` comment marks the next ``def`` as
+  serving-hot-path scope for the host-sync rule (the engine step loop
+  annotates itself);
+* a ``tpulint: skip-file`` comment exempts a whole file (generated);
+* baseline — a checked-in JSON set of fingerprints
+  (``analysis/baseline.json``, empty on a clean tree); the CLI fails
+  only on findings *not* in the baseline, so the pass is enforceable
+  from day one even if a future PR needs to land with a known debt.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "recompile": (
+        "jax.jit wrapper built per call / per loop iteration, or an "
+        "unbounded request-derived value passed as a static argument — "
+        "each distinct value compiles a fresh executable"),
+    "host-sync": (
+        "host-device synchronization (.item(), np.asarray, "
+        "jax.device_get, .block_until_ready, float()/int() on arrays) "
+        "inside a # tpulint: hot-path function or a Pallas kernel"),
+    "donation": (
+        "read of a buffer after it was donated to a jit call "
+        "(donate_argnums) without being rebound — donated buffers are "
+        "invalidated on TPU"),
+    "tracer-leak": (
+        "Python if/while/assert on a traced value inside a jit'd or "
+        "Pallas-kernel function (shape/dtype/len() access is fine)"),
+    "lock-discipline": (
+        "attribute written under a class's threading.Lock/Condition in "
+        "one method but written without the lock in another"),
+    "suppression": (
+        "malformed tpulint suppression (unknown rule id or missing "
+        "reason) — suppressions must document why"),
+}
+
+_ALLOW_RE = re.compile(
+    r"#\s*tpulint:\s*(?P<kind>allow|skip-file|hot-path)"
+    r"(?:\[(?P<rules>[a-z\-, ]*)\])?\s*(?P<reason>.*?)\s*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic at ``path:line:col`` (1-based line)."""
+
+    path: str            # repo-relative posix path
+    line: int
+    col: int
+    rule: str
+    message: str         # must not embed line numbers (baseline stability)
+    qualname: str = ""   # enclosing function/class dotted name
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.path}::{self.rule}::{self.qualname}::{self.message}"
+
+    def render(self) -> str:
+        where = f" ({self.qualname})" if self.qualname else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}{where}")
+
+
+class Suppressions:
+    """Per-line ``# tpulint:`` directives parsed from raw source.
+
+    A directive on a code line applies to that line; a directive on a
+    comment-only line applies to the next code line (so multi-clause
+    statements can carry the comment above them).  ``hot_path_lines``
+    are the code lines marked as serving hot path (used by the
+    host-sync rule to scope itself to ``def`` lines it covers).
+    """
+
+    def __init__(self, text: str):
+        self.skip_file = False
+        self.allow: Dict[int, Set[str]] = {}
+        self.reasons: Dict[int, str] = {}
+        self.hot_path_lines: Set[int] = set()
+        self.malformed: List[Tuple[int, str]] = []
+        self._used: Set[int] = set()
+        pending_allow: List[Tuple[Set[str], str, int]] = []
+        pending_hot = False
+        for lineno, raw in enumerate(text.splitlines(), start=1):
+            stripped = raw.strip()
+            m = _ALLOW_RE.search(raw)
+            comment_only = stripped.startswith("#")
+            if m:
+                kind = m.group("kind")
+                if kind == "skip-file":
+                    self.skip_file = True
+                elif kind == "hot-path":
+                    if comment_only:
+                        pending_hot = True
+                    else:
+                        self.hot_path_lines.add(lineno)
+                else:  # allow
+                    rules = {r.strip() for r in (m.group("rules") or "")
+                             .split(",") if r.strip()}
+                    reason = (m.group("reason") or "").strip()
+                    unknown = rules - set(RULES)
+                    if not rules or unknown or not reason:
+                        why = ("unknown rule id(s): "
+                               + ", ".join(sorted(unknown)) if unknown
+                               else "missing rule id in allow[...]"
+                               if not rules else "missing reason text")
+                        self.malformed.append((lineno, why))
+                    if comment_only:
+                        pending_allow.append((rules, reason, lineno))
+                    else:
+                        self.allow.setdefault(lineno, set()).update(rules)
+                        self.reasons[lineno] = reason
+                continue
+            if comment_only or not stripped:
+                continue
+            # first code line after pending comment-only directives
+            for rules, reason, _src in pending_allow:
+                self.allow.setdefault(lineno, set()).update(rules)
+                self.reasons.setdefault(lineno, reason)
+            if pending_hot:
+                self.hot_path_lines.add(lineno)
+            pending_allow = []
+            pending_hot = False
+
+    def allows(self, line: int, rule: str) -> bool:
+        if rule in self.allow.get(line, ()):
+            self._used.add(line)
+            return True
+        return False
+
+
+@dataclasses.dataclass
+class AnalysisConfig:
+    """Codebase-specific tuning for the rule families."""
+
+    # numpy module aliases whose asarray/array calls sync in hot paths
+    numpy_names: Tuple[str, ...] = ("np", "numpy")
+    # files under a path containing this segment get kernel treatment
+    kernel_dir: str = "kernels"
+    # function-name suffix that marks a Pallas kernel body
+    kernel_fn_suffix: str = "_kernel"
+    # attribute names that mark request/slot-varying quantities when they
+    # appear in arithmetic flowing into a static jit argument
+    request_state_attrs: Tuple[str, ...] = ("prompt", "generated")
+    # directories never scanned by analyze_paths
+    exclude_dirs: Tuple[str, ...] = (
+        "tests", "tests_tpu", "__pycache__", ".git", ".github", "docs",
+        "related")
+
+
+def default_targets() -> List[Path]:
+    """What ``python -m megatron_llm_tpu.analysis`` scans by default:
+    the package itself plus the repo-root ``tools/`` scripts."""
+    pkg = Path(__file__).resolve().parents[1]
+    root = pkg.parent
+    targets = [pkg]
+    if (root / "tools").is_dir():
+        targets.append(root / "tools")
+    return targets
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def _rel(path: Path) -> str:
+    try:
+        return path.resolve().relative_to(repo_root()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def analyze_source(path: str, text: str,
+                   config: Optional[AnalysisConfig] = None) -> List[Finding]:
+    """Run every rule over one file's source; returns unsuppressed
+    findings (plus ``suppression`` findings for malformed directives)."""
+    from . import rules  # local import: keeps module load cheap
+
+    config = config or AnalysisConfig()
+    sup = Suppressions(text)
+    findings: List[Finding] = [
+        Finding(path, line, 0, "suppression", why)
+        for line, why in sup.malformed]
+    if sup.skip_file:
+        return findings
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return findings + [Finding(path, e.lineno or 0, e.offset or 0,
+                                   "suppression",
+                                   f"file does not parse: {e.msg}")]
+    ctx = rules.ModuleContext(path, tree, config, sup)
+    raw = rules.run_all(ctx)
+    findings.extend(f for f in raw if not sup.allows(f.line, f.rule))
+    return findings
+
+
+def iter_python_files(targets: Sequence[Path],
+                      config: AnalysisConfig) -> Iterable[Path]:
+    for target in targets:
+        target = Path(target)
+        if target.is_file() and target.suffix == ".py":
+            yield target
+            continue
+        if not target.is_dir():
+            continue
+        for p in sorted(target.rglob("*.py")):
+            # Exclusions apply to directories beneath the target, so an
+            # explicitly named path (e.g. a fixtures dir under tests/)
+            # is always scanned.
+            rel_dirs = p.relative_to(target).parts[:-1]
+            if any(part in config.exclude_dirs for part in rel_dirs):
+                continue
+            yield p
+
+
+def analyze_paths(targets: Sequence[Path],
+                  config: Optional[AnalysisConfig] = None,
+                  ) -> Tuple[List[Finding], int]:
+    """Analyze every ``.py`` under ``targets``; returns (findings,
+    files_scanned)."""
+    config = config or AnalysisConfig()
+    findings: List[Finding] = []
+    n = 0
+    for p in iter_python_files(targets, config):
+        n += 1
+        findings.extend(
+            analyze_source(_rel(p), p.read_text(encoding="utf-8"), config))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, n
+
+
+# -- baseline ---------------------------------------------------------------
+
+def default_baseline_path() -> Path:
+    return Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_baseline(path: Optional[Path] = None) -> Set[str]:
+    path = Path(path or default_baseline_path())
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return set(data.get("findings", []))
+
+
+def save_baseline(findings: Sequence[Finding],
+                  path: Optional[Path] = None) -> Path:
+    path = Path(path or default_baseline_path())
+    payload = {
+        "version": 1,
+        "note": ("fingerprints of accepted pre-existing findings; "
+                 "regenerate with --update-baseline (docs/analysis.md)"),
+        "findings": sorted({f.fingerprint for f in findings}),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+    return path
+
+
+def split_by_baseline(findings: Sequence[Finding], baseline: Set[str],
+                      ) -> Tuple[List[Finding], List[Finding], Set[str]]:
+    """(new, baselined, stale-fingerprints)."""
+    new, old = [], []
+    seen: Set[str] = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            old.append(f)
+            seen.add(f.fingerprint)
+        else:
+            new.append(f)
+    return new, old, baseline - seen

@@ -40,6 +40,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..analysis.sanitizers import make_lock
 from ..config import ModelConfig
 from ..obs.logging import EVENT_LOG
 from ..obs.registry import REGISTRY
@@ -110,9 +111,9 @@ class GenerationService:
         self.trace_enabled = trace
         # the lock now guards only the legacy one-shot paths (beam search,
         # scoring, PLD); standard generation goes through the engine
-        self.lock = threading.Lock()
+        self.lock = make_lock("server.generate")
         self._engine = engine
-        self._engine_init_lock = threading.Lock()
+        self._engine_init_lock = make_lock("server.engine_init")
         self._draining = False
 
     @property

@@ -10,12 +10,12 @@ the jitted eval step.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 
+from .analysis.sanitizers import make_lock
 from .obs.registry import REGISTRY, MetricFamily
 
 
@@ -29,7 +29,7 @@ class EventCounters:
     tensorboard export (``write``)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.counters")
         self._counts: Dict[str, int] = {}
 
     def inc(self, name: str, by: int = 1) -> None:

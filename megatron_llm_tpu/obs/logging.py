@@ -19,9 +19,10 @@ line is a single ``write()`` call.
 from __future__ import annotations
 
 import json
-import threading
 import time
 from collections import deque
+
+from ..analysis.sanitizers import make_lock
 from typing import Dict, List, Optional
 
 _UNSET = object()
@@ -39,7 +40,7 @@ class StructuredLog:
     """Bounded in-memory event ring + optional JSON-lines stream."""
 
     def __init__(self, stream=None, capacity: int = 4096):
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.eventlog")
         self._stream = stream
         self._events: deque = deque(maxlen=capacity)
         self._rank: Optional[int] = None

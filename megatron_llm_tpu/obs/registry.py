@@ -27,7 +27,8 @@ from __future__ import annotations
 
 import math
 import re
-import threading
+
+from ..analysis.sanitizers import make_lock
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -90,7 +91,7 @@ class _Metric:
         for ln in self.labelnames:
             if not _LABEL_RE.match(ln):
                 raise ValueError(f"invalid label name: {ln!r}")
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.metric")
         self._children: Dict[Tuple[str, ...], object] = {}
 
     def _child(self, labels: Dict[str, str]):
@@ -122,7 +123,7 @@ class _CounterChild:
     __slots__ = ("_lock", "_value")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.metric.child")
         self._value = 0.0
 
     def inc(self, by: float = 1.0) -> None:
@@ -162,7 +163,7 @@ class _GaugeChild:
     __slots__ = ("_lock", "_value")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.metric.child")
         self._value = 0.0
 
     def set(self, value: float) -> None:
@@ -213,7 +214,7 @@ class _HistogramChild:
     __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count")
 
     def __init__(self, buckets: Tuple[float, ...]):
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.metric.child")
         self._buckets = buckets
         self._counts = [0] * len(buckets)
         self._sum = 0.0
@@ -316,7 +317,7 @@ class MetricsRegistry:
     """Named metrics + scrape-time collectors, one lock, one text dump."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.registry")
         self._metrics: Dict[str, _Metric] = {}
         self._collectors: Dict[str, Callable[[], Iterable[MetricFamily]]] = {}
 

@@ -23,12 +23,12 @@ healthy replica.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List
 
+from ..analysis.sanitizers import make_lock
 from .registry import MetricFamily
 
 
@@ -83,7 +83,7 @@ class SLOTracker:
                  clock: Callable[[], float] = time.monotonic):
         self.config = config
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.slo")
         self._windows = {d: _Window(config.window_s) for d in self.DIMENSIONS}
 
     def _objective(self, dim: str) -> float:

@@ -25,9 +25,10 @@ from __future__ import annotations
 
 import contextlib
 import os
-import threading
 import time
 from collections import deque
+
+from ..analysis.sanitizers import make_lock
 from typing import Dict, Iterator, List, Optional
 
 _PROFILER_SENTINEL = object()
@@ -61,7 +62,7 @@ class TraceRecorder:
     def __init__(self, capacity: int = 8192, enabled: bool = True):
         self.enabled = enabled
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.trace")
         # (name, ph, t0, dur, tid, request_id, args) — compact on the hot
         # path; the ring drops the oldest spans once capacity is reached.
         self._events: deque = deque(maxlen=capacity)

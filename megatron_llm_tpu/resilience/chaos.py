@@ -24,10 +24,11 @@ from __future__ import annotations
 
 import os
 import signal
-import threading
 from typing import Callable, Iterable, Optional
 
 import numpy as np
+
+from ..analysis.sanitizers import make_lock
 
 
 class SimulatedCrash(BaseException):
@@ -44,11 +45,12 @@ class Chaos:
     """Process-global fault-injection controller (see module docstring)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("chaos")
         self._io_failures: dict[str, list] = {}   # site -> [remaining, exc]
         self._crashes: set[str] = set()
         self._kills: dict[str, int] = {}          # site -> signal number
         self._poisoned_iters: set[int] = set()
+        self._kv_leaks: dict[str, int] = {}       # site -> refs to drop
         self.events: list[tuple[str, str]] = []   # (kind, site) fired log
 
     # -- arming (test side) -------------------------------------------------
@@ -59,6 +61,7 @@ class Chaos:
             self._crashes.clear()
             self._kills.clear()
             self._poisoned_iters.clear()
+            self._kv_leaks.clear()
             self.events.clear()
 
     def fail_io(self, site: str, times: int = 1,
@@ -82,6 +85,13 @@ class Chaos:
         """NaN-poison the batches of these 1-based training iterations."""
         with self._lock:
             self._poisoned_iters.update(int(i) for i in iterations)
+
+    def leak_kv_blocks(self, site: str, times: int = 1) -> None:
+        """Make the next ``times`` block releases at ``site`` silently
+        drop one ref on the floor — a deliberate KV block leak for
+        exercising the ledger sanitizer (analysis/sanitizers.py)."""
+        with self._lock:
+            self._kv_leaks[site] = int(times)
 
     # -- hooks (instrumented-code side; inert unless armed) -----------------
 
@@ -110,6 +120,17 @@ class Chaos:
             self.events.append(("fail_io", site))
             exc = armed[1]
         raise exc()
+
+    def should_leak_kv_block(self, site: str) -> bool:
+        """One armed KV-block leak consumed at ``site``; the caller skips
+        exactly one ``decref`` when this returns True."""
+        with self._lock:
+            n = self._kv_leaks.get(site, 0)
+            if n <= 0:
+                return False
+            self._kv_leaks[site] = n - 1
+            self.events.append(("kv_leak", site))
+            return True
 
     def corrupt_batch(self, batch: dict, iteration: int) -> dict:
         """Return ``batch`` NaN-poisoned iff ``iteration`` is armed."""

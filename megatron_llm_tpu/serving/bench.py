@@ -15,6 +15,37 @@ from __future__ import annotations
 
 import time
 
+from ..analysis.sanitizers import make_lock
+
+
+def _itl_recorder():
+    """One shared inter-token-latency recorder: ``(histogram,
+    make_stream)``.
+
+    ``make_stream()`` returns a fresh per-request ``on_token`` callback
+    that observes the gap between that request's consecutive tokens
+    into the shared lock-guarded histogram.  Callbacks run on the
+    scheduler thread but results are read from the bench thread, hence
+    the lock.
+    """
+    from .metrics import LatencyHistogram
+
+    itl = LatencyHistogram(max_samples=1 << 16)
+    itl_lock = make_lock("bench.itl")
+
+    def make_stream():
+        last = [None]
+
+        def on_token(_tok, _last=last):
+            now = time.perf_counter()
+            if _last[0] is not None:
+                with itl_lock:
+                    itl.observe(now - _last[0])
+            _last[0] = now
+        return on_token
+
+    return itl, make_stream
+
 
 def run_serving_bench(cfg, params, *, num_requests: int = 24,
                       prompt_len: int = 128, gen_len: int = 128,
@@ -98,12 +129,10 @@ def run_mixed_serving_bench(cfg, params, *, num_requests: int = 24,
     ``bench.py`` runs this point both ways so ``--compare`` can gate
     the tracing overhead (docs/observability.md).
     """
-    import threading
-
     import numpy as np
 
     from .engine import EngineConfig, ServingEngine
-    from .metrics import LatencyHistogram, ServingMetrics
+    from .metrics import ServingMetrics
 
     rng = np.random.default_rng(seed)
     # short-prompt majority, long-prompt minority (arrive mid-decode)
@@ -125,19 +154,7 @@ def run_mixed_serving_bench(cfg, params, *, num_requests: int = 24,
         pipeline_decode=pipeline_decode,
         trace=trace,
     )).start()
-    itl = LatencyHistogram(max_samples=1 << 16)
-    itl_lock = threading.Lock()
-
-    def make_stream():
-        last = [None]
-
-        def on_token(_tok, _last=last):
-            now = time.perf_counter()
-            if _last[0] is not None:
-                with itl_lock:
-                    itl.observe(now - _last[0])
-            _last[0] = now
-        return on_token
+    itl, make_stream = _itl_recorder()
 
     try:
         # warmup: compile prefill/chunk + decode outside the window
@@ -317,12 +334,10 @@ def run_paged_serving_bench(cfg, params, *, num_requests: int = 12,
     observed under paging), with the fixed-stride baseline and the ratio
     alongside, plus paged ITL p50/p99 for the latency-regression gate.
     """
-    import threading
-
     import numpy as np
 
     from .engine import EngineConfig, ServingEngine
-    from .metrics import LatencyHistogram, ServingMetrics
+    from .metrics import ServingMetrics
 
     rng = np.random.default_rng(seed)
     max_seq = min(max(prompt_lens) + gen_len, cfg.max_position_embeddings)
@@ -343,19 +358,7 @@ def run_paged_serving_bench(cfg, params, *, num_requests: int = 12,
             kv_block_size=block,
             kv_pool_blocks=n_blocks,
         )).start()
-        itl = LatencyHistogram(max_samples=1 << 16)
-        itl_lock = threading.Lock()
-
-        def make_stream():
-            last = [None]
-
-            def on_token(_tok, _last=last):
-                now = time.perf_counter()
-                if _last[0] is not None:
-                    with itl_lock:
-                        itl.observe(now - _last[0])
-                _last[0] = now
-            return on_token
+        itl, make_stream = _itl_recorder()
 
         try:
             # warmup: compile each distinct prompt-length bucket's

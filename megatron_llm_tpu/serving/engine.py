@@ -88,6 +88,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import sanitizers
 from ..config import ModelConfig
 from ..generation.sampling import NEG_INF
 from ..models import model as model_lib
@@ -164,6 +165,15 @@ class EngineConfig:
     #                               lower to trade worst-case headroom for
     #                               more concurrent mixed-length requests
     #                               at the same HBM (bench serving_paged).
+    sanitize: bool = False        # runtime sanitizers (analysis/
+    #                               sanitizers.py): per-iteration block-
+    #                               pool ledger checks, a leak report at
+    #                               shutdown/drain, and lock-order
+    #                               tracking across the engine's locks.
+    #                               Also enabled by MEGATRON_SANITIZE=1.
+    #                               Costs one host pass over the slot
+    #                               tables per iteration — tests/debug
+    #                               only, default off.
 
 
 @dataclasses.dataclass
@@ -495,8 +505,15 @@ class ServingEngine:
         assert self.config.max_seq_len <= cfg.max_position_embeddings, (
             f"max_seq_len {self.config.max_seq_len} exceeds the model's "
             f"max_position_embeddings {cfg.max_position_embeddings}")
+        # sanitizer resolution comes first so every lock/condition the
+        # engine (and its queue) creates below is order-tracked
+        self._sanitize = bool(self.config.sanitize) or sanitizers.env_enabled()
+        if self._sanitize:
+            sanitizers.enable_lock_tracking()
+        self._sanitizer: Optional[sanitizers.LedgerSanitizer] = None
+        self.sanitizer_report: List[dict] = []  # leaks found at shutdown
         self.metrics = metrics or ServingMetrics(self.config.max_batch_size)
-        self.metrics.num_slots = self.config.max_batch_size
+        self.metrics.set_gauges(num_slots=self.config.max_batch_size)
         self.trace = TraceRecorder(capacity=self.config.trace_capacity,
                                    enabled=self.config.trace)
         self.queue = RequestQueue(self.config.max_queue_size,
@@ -522,9 +539,12 @@ class ServingEngine:
         self._paused = threading.Event()
         self._draining = threading.Event()
         self._started = threading.Event()
-        self._lock = threading.Lock()  # guards start/shutdown
-        self._wake = threading.Condition()        # paused-loop wakeups
-        self._drain_cond = threading.Condition()  # drain() wakeups
+        self._lock = sanitizers.make_lock("engine.lifecycle")
+        #                              guards start/shutdown
+        self._wake = sanitizers.make_condition("engine.wake")
+        #                              paused-loop wakeups
+        self._drain_cond = sanitizers.make_condition("engine.drain")
+        #                              drain() wakeups
         # device/host overlap accounting (metrics.observe_step_breakdown)
         self._last_dispatch_t: Optional[float] = None
         self._last_ready_t: Optional[float] = None
@@ -569,6 +589,8 @@ class ServingEngine:
                     cfg_e.max_batch_size, self.slots.table_blocks,
                     jax.default_backend())
                 self._update_pool_gauges()
+                if self._sanitize:
+                    self._sanitizer = sanitizers.LedgerSanitizer()
                 self._thread = threading.Thread(
                     target=self._loop, name="serving-engine", daemon=True)
                 self._thread.start()
@@ -587,6 +609,10 @@ class ServingEngine:
             self._thread = None
             with self._drain_cond:
                 self._drain_cond.notify_all()
+            if self._sanitizer is not None:
+                self.sanitizer_report = self._sanitizer.leak_report(self)
+                for leak in self.sanitizer_report:
+                    EVENT_LOG.emit("sanitizer", "kv_block_leak", **leak)
 
     def pause(self) -> None:
         """Stop admitting and decoding (requests keep queueing) — used for
@@ -615,6 +641,9 @@ class ServingEngine:
             while True:
                 idle = self._is_idle()
                 if idle or self._stop.is_set():
+                    if idle and self._sanitizer is not None:
+                        self.sanitizer_report = (
+                            self._sanitizer.leak_report(self))
                     return idle
                 remaining = (None if deadline is None
                              else deadline - time.perf_counter())
@@ -737,6 +766,10 @@ class ServingEngine:
                     self._last_dispatch_t = self._last_ready_t = None
                     self._notify_drain()
                     self.queue.wait_for_work(self.config.idle_wait_s)
+                if self._sanitizer is not None:
+                    # ledger audit once per iteration; a LedgerError
+                    # lands in the handler below — loud, fails everything
+                    self._sanitizer.check_engine(self)
         except Exception as e:  # noqa: BLE001 — a dead scheduler must not
             # leave submitters blocked on result() forever: fail every
             # in-flight and queued request loudly, then stop.
@@ -1091,6 +1124,7 @@ class ServingEngine:
         self._commit_token(slot, first, float(np.asarray(tok_lp)[0]))
         return True
 
+    # tpulint: hot-path
     def _step(self) -> None:
         """One scheduler iteration of the decode fast path: dispatch step
         N+1, then process step N's tokens (which the device computed — and
@@ -1122,6 +1156,7 @@ class ServingEngine:
                   "route": "fused" if self._fused_decode else "fallback",
                   "pipelined": self.config.pipeline_decode})
 
+    # tpulint: hot-path
     def _dispatch_decode(self) -> _Inflight:
         assert self.slots is not None
         S = self.config.max_batch_size
@@ -1198,12 +1233,17 @@ class ServingEngine:
             st.count += 1  # one more token sampled (possibly speculative)
         return _Inflight(tok, tok_lp, snapshot, t0)
 
+    # tpulint: hot-path
     def _process_step_results(self, step: _Inflight) -> float:
         """Sync a dispatched step's tokens to the host and commit them.
         Returns the wall time spent blocked on the device."""
         t_fetch = time.perf_counter()
-        tok = np.asarray(step.tok)     # host sync: the scheduling point
-        tok_lp = np.asarray(step.tok_lp)
+        # tpulint: allow[host-sync] THE deliberate scheduling point: the
+        # one place per iteration the host waits for sampled tokens (the
+        # copy was started async at dispatch, so pipelined mode overlaps
+        # it with the next step's execution)
+        tok = np.asarray(step.tok)
+        tok_lp = np.asarray(step.tok_lp)  # tpulint: allow[host-sync] same fetch: arrives with tok, no extra sync
         t_ready = time.perf_counter()
         self._last_ready_t = t_ready
         device_s = t_ready - step.t_dispatch
@@ -1215,6 +1255,8 @@ class ServingEngine:
                 # token is speculative — masked, never committed/streamed
                 continue
             committed += 1
+            # tpulint: allow[host-sync] tok is already host numpy (the
+            # fetch above); int() here is a free scalar conversion
             st.pending = int(tok[slot])
             # with no newer step in flight the device token vector is
             # gone; the next dispatch must feed this host value
@@ -1224,11 +1266,14 @@ class ServingEngine:
                                request_id=st.req.rid, tid=st.req.id,
                                args={"slot": slot,
                                      "token_index": len(st.req.generated)})
+            # tpulint: allow[host-sync] tok_lp is host numpy; no device
+            # round-trip
             self._commit_token(slot, st.pending, float(tok_lp[slot]))
         self.metrics.observe_decode_iteration(committed, device_s)
         self.metrics.observe_step_breakdown(device_s=device_s)
         return t_ready - t_fetch
 
+    # tpulint: hot-path
     def _flush_inflight(self) -> None:
         """Drain the in-flight step (pause/idle paths).  If every slot it
         covered has retired, all its tokens are speculative: drop the step

@@ -23,9 +23,9 @@ thread and HTTP threads, the readers are tests / monitoring pollers.
 
 from __future__ import annotations
 
-import threading
 from typing import List, Optional, Sequence
 
+from ..analysis.sanitizers import make_lock
 from ..obs.registry import REGISTRY, MetricFamily, summary_family
 from ..obs.slo import SLOConfig, SLOTracker
 from ..utils.timers import Timers
@@ -154,7 +154,7 @@ class ServingMetrics:
 
     def __init__(self, num_slots: int = 0,
                  slo: Optional[SLOConfig] = None, register: bool = True):
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.metrics")
         self.counters = {name: 0 for name in _COUNTERS}
         self.num_slots = num_slots
         self.slots_active = 0
@@ -198,8 +198,11 @@ class ServingMetrics:
                    prefix_blocks: Optional[int] = None,
                    blocks_free: Optional[int] = None,
                    blocks_used: Optional[int] = None,
-                   kv_cache_util: Optional[float] = None) -> None:
+                   kv_cache_util: Optional[float] = None,
+                   num_slots: Optional[int] = None) -> None:
         with self._lock:
+            if num_slots is not None:
+                self.num_slots = num_slots
             if slots_active is not None:
                 self.slots_active = slots_active
             if queue_depth is not None:

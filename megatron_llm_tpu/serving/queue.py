@@ -17,10 +17,10 @@ stragglers anyway, so partial admission buys nothing.
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import Optional
 
+from ..analysis.sanitizers import make_condition
 from ..obs.logging import EVENT_LOG
 
 
@@ -47,7 +47,7 @@ class RequestQueue:
         self.max_size = max_size
         self.retry_after_s = retry_after_s
         self._q: deque = deque()
-        self._cond = threading.Condition()
+        self._cond = make_condition("serving.queue")
 
     def __len__(self) -> int:
         with self._cond:

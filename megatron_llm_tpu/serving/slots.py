@@ -42,6 +42,7 @@ import jax
 import numpy as np
 
 from ..models import model as model_lib
+from ..resilience.chaos import chaos
 from .block_pool import BlockPool
 
 
@@ -103,7 +104,11 @@ class SlotAllocator:
         """Return a slot: drop one ref on every table entry, hand back any
         unused reservation, reset the row."""
         assert 0 <= slot < self.num_slots and slot not in self._free
+        leak = chaos().should_leak_kv_block("slots-release")
         for bid in self.tables[slot]:
+            if leak and int(bid) != BlockPool.TRASH:
+                leak = False  # chaos: drop exactly one ref on the floor
+                continue
             self.pool.decref(int(bid))
         self.tables[slot] = BlockPool.TRASH
         if self.reserved[slot]:

@@ -1,0 +1,35 @@
+# tpulint test fixture: known-bad recompile hazards (R1).  Never
+# imported or executed — only parsed by the analysis pass; the
+# `# BAD: <rule>` markers are the expected-findings oracle read by
+# tests/analysis/test_rules.py.
+import functools
+
+import jax
+
+
+def _impl(x, width):
+    return x[:width]
+
+
+_step = functools.partial(jax.jit, static_argnames=("width",))(_impl)
+
+
+def serve(req, x):
+    return _step(x, len(req.prompt) + 3)  # BAD: recompile
+
+
+def rebuild_per_call(f, x):
+    return jax.jit(f)(x)  # BAD: recompile
+
+
+def rebuild_in_loop(f, xs):
+    out = []
+    for x in xs:
+        g = jax.jit(f)  # BAD: recompile
+        out.append(g(x))
+    return out
+
+
+def fine_bounded_static(req, x):
+    # bounded flags/comparisons are legal static args: not flagged
+    return _step(x, 4)

@@ -1,0 +1,20 @@
+# tpulint test fixture: known-bad host syncs inside a hot-path
+# function (R2).  Parsed only, never executed.
+import jax
+import numpy as np
+
+
+# tpulint: hot-path
+def step_loop(tok_dev):
+    tok = np.asarray(tok_dev)  # BAD: host-sync
+    val = tok_dev.item()  # BAD: host-sync
+    got = jax.device_get(tok_dev)  # BAD: host-sync
+    n = int(tok_dev)  # BAD: host-sync
+    f = float(tok_dev)  # BAD: host-sync
+    tok_dev.block_until_ready()  # BAD: host-sync
+    return tok, val, got, n, f
+
+
+def cold_path(tok_dev):
+    # identical syncs OUTSIDE a hot-path function are fine
+    return np.asarray(tok_dev), int(tok_dev)

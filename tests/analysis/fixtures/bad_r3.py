@@ -1,0 +1,40 @@
+# tpulint test fixture: known-bad donated-buffer reuse (R3).  Parsed
+# only, never executed.
+import functools
+
+import jax
+
+
+def _impl(k, v, x):
+    return k, v
+
+
+_plain = jax.jit(_impl)
+_donated = functools.partial(jax.jit, donate_argnums=(0, 1))(_impl)
+
+
+def use_after_donate(k, v, x):
+    k2, v2 = _donated(k, v, x)
+    return k + k2  # BAD: donation
+
+
+def rebound_is_fine(k, v, x):
+    k, v = _donated(k, v, x)
+    return k + v  # rebinding in the call statement kills the donation
+
+
+class Engine:
+    def __init__(self, cpu):
+        self.k_pool = object()
+        self._fn = (_plain if cpu else _donated)
+
+    def bad(self, x):
+        out = self._fn(self.k_pool, self.k_pool, x)
+        y = self.k_pool  # BAD: donation
+        self.k_pool = out[0]
+        return y
+
+    def good(self, x):
+        out = self._fn(self.k_pool, self.k_pool, x)
+        self.k_pool = out[0]
+        return self.k_pool

@@ -1,0 +1,26 @@
+# tpulint test fixture: known-bad tracer leaks (R4).  Parsed only,
+# never executed.
+import jax
+
+
+@jax.jit
+def branchy(x, flag):
+    if x > 0:  # BAD: tracer-leak
+        return x
+    while flag:  # BAD: tracer-leak
+        x = x - 1
+    y = x + 1
+    assert y != 0  # BAD: tracer-leak
+    return -x if y > 0 else x  # BAD: tracer-leak
+
+
+@jax.jit
+def shape_access_is_static(x):
+    if x.shape[0] > 2:
+        return x
+    if len(x) > 1:
+        return x
+    n = x.ndim
+    if n > 1:
+        return x
+    return x

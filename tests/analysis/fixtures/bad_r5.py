@@ -1,0 +1,25 @@
+# tpulint test fixture: known-bad lock discipline (R5).  Parsed only,
+# never executed.
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0  # __init__ writes are exempt
+
+    def inc(self):
+        with self._lock:
+            self.total += 1  # establishes 'total' as lock-guarded
+
+    def reset(self):
+        self.total = 0  # BAD: lock-discipline
+
+
+class Unlocked:
+    # no lock declared: attribute writes are not lock-discipline's business
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1

@@ -1,0 +1,213 @@
+"""Unit tests for the three runtime sanitizers.
+
+The ledger sanitizer is exercised here against a hand-built engine
+shape (fast, no model); the end-to-end chaos-injected leak runs in
+``tests/serving/test_sanitize.py``.
+"""
+import threading
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.analysis import sanitizers
+from megatron_llm_tpu.analysis.sanitizers import (
+    CompileCounter,
+    LedgerError,
+    LedgerSanitizer,
+    LockOrderError,
+    RecompilationError,
+    TrackedLock,
+    no_recompiles,
+)
+
+
+# -- recompilation guard ----------------------------------------------------
+
+def test_compile_counter_sees_fresh_compile_and_not_cache_hits():
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    with CompileCounter() as warm:
+        f(jnp.ones(3)).block_until_ready()
+    assert warm.count >= 1  # fresh function: at least one backend compile
+
+    with CompileCounter() as cached:
+        f(jnp.ones(3)).block_until_ready()
+    assert cached.count == 0  # same shape: executable comes from cache
+
+
+def test_no_recompiles_raises_on_new_shape():
+    @jax.jit
+    def g(x):
+        return x * 2
+
+    g(jnp.ones(4)).block_until_ready()  # warmup
+    with no_recompiles():
+        g(jnp.ones(4)).block_until_ready()  # cached: fine
+    with pytest.raises(RecompilationError):
+        with no_recompiles():
+            g(jnp.ones(5)).block_until_ready()  # new shape: compiles
+
+
+def test_no_recompiles_allowance():
+    @jax.jit
+    def h(x):
+        return x - 1
+
+    x = jnp.ones(6)  # jnp.ones compiles too — keep it outside the region
+    with no_recompiles(allow=1):
+        h(x).block_until_ready()  # exactly one compile permitted
+
+
+# -- lock-order checker -----------------------------------------------------
+
+@pytest.fixture
+def lock_tracking():
+    sanitizers.enable_lock_tracking()
+    sanitizers.reset_lock_tracking()
+    yield
+    sanitizers.reset_lock_tracking()
+
+
+def test_lock_order_cycle_detected(lock_tracking):
+    a, b = TrackedLock("A"), TrackedLock("B")
+    with a:
+        with b:
+            pass
+    assert sanitizers.lock_order_violations() == []
+    with b:
+        with a:  # inverts the recorded A->B order
+            pass
+    violations = sanitizers.lock_order_violations()
+    assert violations and "A" in violations[0] and "B" in violations[0]
+    with pytest.raises(LockOrderError):
+        sanitizers.check_lock_order()
+
+
+def test_lock_order_cycle_detected_across_threads(lock_tracking):
+    a, b = TrackedLock("T-A"), TrackedLock("T-B")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=forward)
+    t.start()
+    t.join()
+    t = threading.Thread(target=backward)
+    t.start()
+    t.join()
+    assert sanitizers.lock_order_violations()
+
+
+def test_consistent_order_is_clean(lock_tracking):
+    a, b = TrackedLock("C-A"), TrackedLock("C-B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    sanitizers.check_lock_order()  # no violation to raise
+
+
+def test_condition_wait_produces_no_violation(lock_tracking):
+    cond = sanitizers.make_condition("cond")
+    with cond:
+        cond.wait(timeout=0.01)
+    sanitizers.check_lock_order()
+
+
+def test_make_lock_untracked_when_disabled(monkeypatch):
+    monkeypatch.setattr(sanitizers, "_tracking_enabled", False)
+    lock = sanitizers.make_lock("plain")
+    assert not isinstance(lock, TrackedLock)
+
+
+# -- block-pool ledger ------------------------------------------------------
+
+def _fake_engine(n_blocks=8, num_slots=2, table_blocks=4):
+    """Minimal engine shape the ledger sanitizer walks: one occupied
+    slot owning blocks 1 and 2, everything else free."""
+    ref = np.zeros(n_blocks, np.int32)
+    ref[0] = 1  # trash, permanently pinned
+    ref[1] = 1
+    ref[2] = 1
+    pool = SimpleNamespace(
+        TRASH=0,
+        n_blocks=n_blocks,
+        _ref=ref,
+        _free=[b for b in range(n_blocks - 1, 0, -1) if b not in (1, 2)],
+        _reserved=0,
+    )
+    tables = np.zeros((num_slots, table_blocks), np.int32)
+    tables[0, 0], tables[0, 1] = 1, 2
+    slots = SimpleNamespace(
+        pool=pool,
+        num_slots=num_slots,
+        tables=tables,
+        reserved=np.zeros(num_slots, np.int64),
+        _free=[1],  # slot 1 is free; slot 0 occupied
+    )
+    req = SimpleNamespace(rid="req-7")
+    return SimpleNamespace(
+        slots=slots,
+        _active={0: SimpleNamespace(req=req)},
+        _prefilling=None,
+        prefix_cache=None,
+    )
+
+
+def test_ledger_clean_state_passes():
+    engine = _fake_engine()
+    san = LedgerSanitizer()
+    san.check_engine(engine)
+    assert san.checks == 1
+    assert san.owners[1] == ["req-7"]
+    assert san.leak_report(engine) == []
+
+
+def test_ledger_reports_leak_with_owner():
+    engine = _fake_engine()
+    san = LedgerSanitizer()
+    san.check_engine(engine)  # records block 2's owner
+    # simulate a dropped decref: slot table forgets block 2, ref stays 1
+    engine.slots.tables[0, 1] = 0
+    with pytest.raises(LedgerError, match=r"block 2 .*leaked"):
+        san.check_engine(engine)
+    (leak,) = san.leak_report(engine)
+    assert leak["block"] == 2
+    assert leak["ref"] == 1 and leak["accounted"] == 0
+    assert leak["last_owners"] == ["req-7"]
+
+
+def test_ledger_detects_use_after_free_hazard():
+    engine = _fake_engine()
+    san = LedgerSanitizer()
+    # slot table points at block 2 but its ref was dropped to 0
+    engine.slots.pool._ref[2] = 0
+    engine.slots.pool._free.append(2)
+    with pytest.raises(LedgerError, match="use-after-free"):
+        san.check_engine(engine)
+
+
+def test_ledger_detects_double_free():
+    engine = _fake_engine()
+    engine.slots.pool._free.append(engine.slots.pool._free[0])
+    with pytest.raises(LedgerError, match="double free"):
+        LedgerSanitizer().check_engine(engine)
+
+
+def test_ledger_detects_reservation_drift():
+    engine = _fake_engine()
+    engine.slots.pool._reserved = 3  # nothing in slots.reserved backs this
+    with pytest.raises(LedgerError, match="reservation"):
+        LedgerSanitizer().check_engine(engine)

@@ -1,0 +1,16 @@
+#!/usr/bin/env python3
+"""Shim: run the tpulint static pass from anywhere in the repo.
+
+Equivalent to ``python -m megatron_llm_tpu.analysis``; exists so CI and
+editors can invoke a plain script path.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from megatron_llm_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
