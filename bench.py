@@ -560,6 +560,29 @@ def _serving_paged_point():
         gen_len=gen_len, kv_block_size=64, pool_seqs=4)
 
 
+def _serving_spec_point():
+    """Speculative-decoding serving point (serving/engine.py spec path):
+    repetitive traffic (tiled 8-token motifs, the workload prompt-lookup
+    drafting exists for) spec on vs off at identical engine geometry,
+    plus an incompressible random-traffic control where the acceptance
+    EWMA must back the batch off to the plain pipelined path.  Headline
+    ``serving_spec_itl_speedup`` = off ITL p50 / on ITL p50 gates in
+    --compare (acceptance bar ≥ 1.3x at this geometry), with the
+    acceptance rate riding along; ``serving_spec_random_overhead`` is
+    the enabled-but-useless cost and must stay ≤ 1.05."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.serving.bench import run_spec_serving_bench
+
+    prompt_len, gen_len = 256, 128
+    cfg = _bench_model(prompt_len + gen_len, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return run_spec_serving_bench(
+        cfg, params, num_requests=16, prompt_len=prompt_len,
+        gen_len=gen_len, slots=8, draft_len=4, ngram=3)
+
+
 def _transient_error_types():
     """The error classes worth retrying: the axon-tunneled compile service
     occasionally throws a transient remote-compile XlaRuntimeError.
@@ -600,7 +623,9 @@ _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
                      "decode_int8_roofline_frac",
                      "serving_prefix.serving_prefix_ttft_speedup",
                      "serving_prefix.serving_prefix_hit_rate",
-                     "serving_paged.serving_paged_max_concurrency")
+                     "serving_paged.serving_paged_max_concurrency",
+                     "serving_spec.serving_spec_itl_speedup",
+                     "serving_spec.serving_spec_acceptance_rate")
 _REGRESSION_TOLERANCE = 0.10
 # Tracing must stay effectively free on the serving hot path: the mixed
 # point's ITL p50 with the span recorder on may exceed the untraced rerun
@@ -609,7 +634,8 @@ _TRACE_OVERHEAD_TOLERANCE = 0.10
 
 # Bumped when the record's shape changes (new points / renamed keys) so
 # --compare across old records is interpretable.
-_BENCH_SCHEMA_VERSION = 2
+# v3: + serving_spec point (speculative decoding ITL speedup + acceptance)
+_BENCH_SCHEMA_VERSION = 3
 
 
 def _run_metadata(platform: str, device_count: int) -> dict:
@@ -796,6 +822,8 @@ def _child_main(spec_json: str) -> None:
         out = _retry(_serving_prefix_point)
     elif kind == "serving_paged":
         out = _retry(_serving_paged_point)
+    elif kind == "serving_spec":
+        out = _retry(_serving_spec_point)
     else:  # pragma: no cover - parent and child ship together
         raise ValueError(f"unknown point kind {kind!r}")
     print(_CHILD_MARK + json.dumps(out), flush=True)
@@ -978,6 +1006,10 @@ def main() -> None:
                            {"kind": "serving_paged",
                             "platform": platform},
                            timeout_s=1800)
+    serving_spec = _point("serving/spec",
+                          {"kind": "serving_spec",
+                           "platform": platform},
+                          timeout_s=1800)
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     record = {
@@ -1032,6 +1064,8 @@ def main() -> None:
         record["serving_prefix"] = serving_prefix
     if serving_paged is not None:
         record["serving_paged"] = serving_paged
+    if serving_spec is not None:
+        record["serving_spec"] = serving_spec
     if headline is not None:
         record.update({
             "value": round(mfu, 4),

@@ -70,6 +70,8 @@ class GenerationService:
                  prefix_cache_blocks: int | None = None,
                  kv_block_size: int | None = None,
                  kv_pool_blocks: int | None = None,
+                 spec_draft_len: int = 0,
+                 spec_ngram: int = 3,
                  trace: bool = True):
         self.cfg = cfg
         self.params = params
@@ -106,6 +108,11 @@ class GenerationService:
         # (docs/serving.md, 'Paged KV cache')
         self.kv_block_size = kv_block_size
         self.kv_pool_blocks = kv_pool_blocks
+        # engine-side speculative decoding (serving/engine.py): per-slot
+        # n-gram drafts checked by a batched verify step; 0 disables.
+        # Distinct from the one-shot PLD path behind ``speculative="pld"``
+        self.spec_draft_len = spec_draft_len
+        self.spec_ngram = spec_ngram
         # per-request span tracing (obs/trace.py, GET /trace); the CLI's
         # --no_trace escape hatch lands here
         self.trace_enabled = trace
@@ -141,6 +148,8 @@ class GenerationService:
                                  prefill_bucket=self.prefill_bucket,
                                  prefill_chunk=self.prefill_chunk,
                                  pipeline_decode=self.pipeline_decode,
+                                 spec_draft_len=self.spec_draft_len,
+                                 spec_ngram=self.spec_ngram,
                                  trace=self.trace_enabled,
                                  **extra))
             return self._engine

@@ -19,8 +19,12 @@ generation) acceptance is high and tokens/step approaches
 degrades gracefully toward one token per forward (plus the verify rows'
 negligible extra FLOPs — decode is latency-bound, which is the point).
 
-Extension beyond the reference (its serving loop is strictly one token per
-pipelined ForwardStep, megatron/text_generation/generation.py:89-285).
+Extension beyond the reference (its generation loop is strictly one token
+per pipelined ForwardStep, megatron/text_generation/generation.py:89-285).
+This module is the ONE-SHOT path (fixed batch, dense cache, jitted loop);
+the continuous-batching serving engine carries its own speculative path
+over paged blocks with a per-slot acceptance policy —
+serving/engine.py and docs/serving.md ("Speculative decoding").
 
 Batched behavior (round 5): fully per-sample.  The KV cache carries a
 [batch] vector of fill levels (ops/kv_quant.py:cache_update and the

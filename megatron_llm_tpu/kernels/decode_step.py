@@ -314,7 +314,7 @@ def _decode_step_kernel(per_row: bool, wq8: bool, cq8: bool,
         xo_ref[...] = x_scr[...].astype(xo_ref.dtype)
 
 
-def _decode_step_kernel_paged(wq8: bool, cq8: bool,
+def _decode_step_kernel_paged(wq8: bool, cq8: bool, W: int,
                               ntb: int, nm: int, block_k: int,
                               b: int, nq: int, nkv: int, g: int, d: int,
                               eps: float, scale: float, act,
@@ -322,19 +322,34 @@ def _decode_step_kernel_paged(wq8: bool, cq8: bool,
                               x_ref, rot_ref, cos_ref, sin_ref, *refs):
     # Paged twin of _decode_step_kernel, always per-row (the serving
     # engine's slot batch).  ``lens_ref`` is [1 + b] (lens[0] = max fill,
-    # layout parity with the dense kernel; lens[1 + i] = row i's fill);
-    # ``tbl_ref`` [b, ntb] is consumed by the BlockSpec index maps only.
-    # The grid's second axis runs b*ntb attend ticks then nm MLP ticks:
-    # attend tick t streams ONE pool block — row r = t // ntb, logical
-    # block j = t % ntb — and updates ALL rows' online-softmax state
-    # under the mask (rows == r) & (cols < fill_r).  Non-r rows see only
-    # NEG_INF scores, which the recurrence treats as a no-op once the
-    # row has any real score (alpha = 1, p underflows to exactly 0.0);
-    # garbage accumulated while a row's m is still at the -1e30 start is
-    # annihilated by alpha = exp(-1e30 - s) = 0.0 at its first real
-    # score — and every row folds the new token's finite score in
-    # _finish_attn, so garbage never survives to the output.  The
-    # full-shape masked update avoids dynamic scratch indexing entirely.
+    # layout parity with the dense kernel; lens[1 + i] = row i's limit —
+    # the number of cache positions it may attend); ``tbl_ref``
+    # [b // W, ntb] is consumed by the BlockSpec index maps only.
+    # The grid's second axis runs (b // W)*ntb attend ticks then nm MLP
+    # ticks: attend tick t streams ONE pool block — slot r = t // ntb,
+    # logical block j = t % ntb — and updates ALL rows' online-softmax
+    # state under the mask (slot_of_row == r) & (cols < limit_row).
+    # Non-r rows see only NEG_INF scores, which the recurrence treats as
+    # a no-op once the row has any real score (alpha = 1, p underflows
+    # to exactly 0.0); garbage accumulated while a row's m is still at
+    # the -1e30 start is annihilated by alpha = exp(-1e30 - s) = 0.0 at
+    # its first real score — and every row folds the new token's finite
+    # score in _finish_attn, so garbage never survives to the output.
+    # The full-shape masked update avoids dynamic scratch indexing
+    # entirely.
+    #
+    # W is the speculative verify window: each of the b = S·W rows is
+    # (slot s = row // W, window position j = row % W), a query at cache
+    # position fill_s + j whose K/V row is appended by this same call.
+    # A sequential single-token run would have WRITTEN window rows
+    # 0..j-1 into the pool before row j reads them, so the tick splices
+    # the slot's in-flight window K/V (kn/vn scratch, converted to the
+    # exact values a pool round-trip would return) over tile columns
+    # [fill_s, fill_s + W - 1) — the joint online-softmax walk then sees
+    # the same values at the same positions in the same order as the
+    # sequential steps, which is what makes the verify logits bitwise
+    # equal rather than merely close.  W = 1 degenerates to the plain
+    # single-token kernel (no splice, slot_of_row == row).
     (in_nw_ref, post_nw_ref,
      wq_ref, wk_ref, wv_ref, wo_ref,
      wg_ref, wu_ref, wd_ref, *refs) = refs
@@ -350,7 +365,7 @@ def _decode_step_kernel_paged(wq8: bool, cq8: bool,
     li = pl.program_id(0)
     ki = pl.program_id(1)
     n_layers = pl.num_programs(0)
-    nk = b * ntb                                        # attend ticks
+    nk = (b // W) * ntb                                 # attend ticks
     f32 = jnp.float32
     cdt = x_ref.dtype if wq8 else wq_ref.dtype
 
@@ -417,7 +432,46 @@ def _decode_step_kernel_paged(wq8: bool, cq8: bool,
         cols = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, 1, block_k), 2)
         rows = jax.lax.broadcasted_iota(jnp.int32, (b, 1, 1), 0)
-        in_range = jnp.logical_and(rows == r, cols < lens_ref[1 + r])
+        if W == 1:
+            in_range = jnp.logical_and(rows == r, cols < lens_ref[1 + r])
+        else:
+            # splice slot r's in-flight window K/V over the tile columns
+            # a sequential run would already have written.  The spliced
+            # values are the exact pool ROUND-TRIP of the scratch rows:
+            # fake-quantized twice for an int8 pool (the second pass
+            # reproduces q·scale as the dequant load computes it), or
+            # cast through the pool dtype otherwise — never the raw fp32
+            # rows, whose extra precision the sequential path lost at
+            # its cache write.  Only window keys 0..W-2 are spliced: key
+            # W-1 is read by no later row (each row folds its OWN raw
+            # key in _finish_attn, exactly like the sequential step).
+            fill_r = lens_ref[1 + r * W]                 # slot r's fill
+            kn_all = kn_scr[...]                         # (b, nkv, d)
+            vn_all = vn_scr[...]
+            if cq8:
+                kn_vis = fake_quantize_rows(kn_all)
+                vn_vis = fake_quantize_rows(vn_all)
+            else:
+                kn_vis = kn_all.astype(kr_ref.dtype).astype(f32)
+                vn_vis = vn_all.astype(vr_ref.dtype).astype(f32)
+            c2 = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            sel_rows = jax.lax.broadcasted_iota(jnp.int32, (b, 1, 1), 0)
+            for i in range(W - 1):
+                # one-hot gather of scratch row r·W + i (r is traced, so
+                # no dynamic scratch indexing)
+                sel = (sel_rows == r * W + i).astype(f32)
+                kvi = jnp.sum(kn_vis * sel, axis=0)      # (nkv, d)
+                vvi = jnp.sum(vn_vis * sel, axis=0)
+                hit = (c2 == fill_r + i)[..., None]      # (1, bk, 1)
+                k4 = jnp.where(hit, kvi[:, None, :], k4)
+                v4 = jnp.where(hit, vvi[:, None, :], v4)
+            # per-row limits: row (s, j) attends cache positions
+            # < fill_s + j (its own key folds in _finish_attn)
+            in_range = jnp.logical_and(
+                rows // W == r,
+                jnp.concatenate([cols < lens_ref[1 + rr]
+                                 for rr in range(b)], axis=0))
         for gg in range(g):
             qv = q_scr[gg]                               # (b, nkv, d) f32
             s = jnp.sum(qv[:, :, None, :] * k4[None], axis=-1) * scale
@@ -623,6 +677,31 @@ def fused_paged_decode_eligible(cfg, params, k_pool, n_slots: int,
     # VMEM term loses its batch factor, but the broadcast-reduce scratch
     # is still over all b rows (the masked no-op trick computes them all)
     return _vmem_fit(cfg, n_slots, block_k, w_item,
+                     1 if cq8 else kc.dtype.itemsize, cache_rows=1)
+
+
+def fused_paged_verify_eligible(cfg, params, k_pool, n_slots: int,
+                                window: int, table_blocks: int,
+                                platform: str) -> bool:
+    """Static predicate for the speculative verify kernel
+    (fused_decode_verify_paged): the paged predicate with the row batch
+    widened to ``n_slots * window`` — the flattened (slot, window-pos)
+    rows all carry q/kn/vn scratch, so the VMEM estimate scales with the
+    window even though cache traffic still streams one block per tick."""
+    from ..ops.kv_quant import is_quantized_cache
+
+    if n_slots < 1 or window < 1 or table_blocks < 1:
+        return False
+    wq8 = _stack_eligible(cfg, params, platform)
+    if wq8 is None:
+        return False
+    cq8 = is_quantized_cache(k_pool)
+    kc = k_pool["q"] if cq8 else k_pool
+    block_k = kc.shape[3]
+    if block_k % 128 != 0:
+        return False
+    w_item = 1 if wq8 else params["layers"]["attn"]["wq"].dtype.itemsize
+    return _vmem_fit(cfg, n_slots * window, block_k, w_item,
                      1 if cq8 else kc.dtype.itemsize, cache_rows=1)
 
 
@@ -951,6 +1030,60 @@ def fused_decode_step_paged(
     models/model.py:cache_append_rows (quantizing first for an int8
     pool) — the same single-write-point contract as the dense kernel.
     """
+    fills = jnp.asarray(fills, jnp.int32)
+    return _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables,
+                             fills, fills, rope, window=1,
+                             interpret=interpret)
+
+
+def fused_decode_verify_paged(
+    cfg,
+    stacked,             # params["layers"]: stacked [L, ...] pytree
+    x: jax.Array,        # [S, W, h] — embedded window hiddens: row (s, j)
+    #                      is slot s's token at position fills[s] + j
+    k_pool,              # [L, n_blocks, kv_heads, block, d] pool pytree,
+    #                      or the int8 {"q", "scale"} dict form
+    v_pool,
+    tables: jax.Array,   # [S, T] int32 per-slot block tables
+    fills: jax.Array,    # [S] int32 per-slot committed fills
+    rope: tuple,         # (cos, sin) tables from rope_tables(cfg)
+    *,
+    interpret: bool | None = None,
+):
+    """Batched variable-length speculative verify: the paged fused step
+    over a ``W``-wide window per slot in ONE kernel launch.
+
+    Returns ``(hidden [S, W, h], k_rows [L, S·W, kv, 1, d], v_rows ...)``
+    — hidden for EVERY window position (the engine's accept logic needs
+    all of them), K/V rows in the ``s*W + j`` flattened order
+    ``cache_append_rows`` consumes.  Each window position's output is
+    bitwise-identical to what ``W`` sequential ``fused_decode_step_paged``
+    calls (with the host cache writes in between) would produce: the
+    kernel splices the in-flight window K/V over the exact tile columns
+    the sequential run would have written (see the kernel docstring), so
+    per-row variable draft lengths are handled by the caller simply
+    ignoring logits past a row's real drafts — the arity stays fixed and
+    the executable is one.
+    """
+    S, W, h = x.shape
+    fills = jnp.asarray(fills, jnp.int32)
+    pos = (fills[:, None]
+           + jnp.arange(W, dtype=jnp.int32)[None, :]).reshape(-1)
+    hidden, k_rows, v_rows = _fused_paged_call(
+        cfg, stacked, x.reshape(S * W, h), k_pool, v_pool, tables, pos,
+        fills, rope, window=W, interpret=interpret)
+    return hidden.reshape(S, W, h), k_rows, v_rows
+
+
+def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
+                      fills, rope, *, window: int,
+                      interpret: bool | None = None):
+    """Shared launch builder for the paged decode/verify kernels.
+
+    ``x`` is the flattened [b = S·window, h] row batch, ``pos`` the [b]
+    per-row cache positions (== ``fills`` when window == 1) driving both
+    the RoPE rows and the per-row attention limits; ``fills`` stays [S]
+    per-slot for the lens[0] clamp parity."""
     from ..ops.kv_quant import is_quantized_cache
     from ..ops.quant import is_quantized
 
@@ -960,6 +1093,7 @@ def fused_decode_step_paged(
     k_arr = k_pool["q"] if cq8 else k_pool
     v_arr = v_pool["q"] if cq8 else v_pool
     b, h = x.shape
+    W = window
     L, _, nkv, block_k, d = k_arr.shape
     ntb = tables.shape[1]
     nq = cfg.num_attention_heads
@@ -968,19 +1102,22 @@ def fused_decode_step_paged(
     eps = float(cfg.norm_eps)
     scale = 1.0 / float(np.sqrt(d))
     act = _GLU_BASE[cfg.activation]
-    nk = b * ntb                       # one attend tick per (row, block)
+    nk = (b // W) * ntb                # one attend tick per (slot, block)
     nm = _mlp_chunks(ffn)
     f_chunk = ffn // nm
 
     b_pad = max(8, -(-b // 8) * 8)
     x_p = x if b_pad == b else jnp.pad(x, ((0, b_pad - b), (0, 0)))
-    fills = jnp.asarray(fills, jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
     tables = jnp.asarray(tables, jnp.int32)
-    lens = jnp.concatenate([jnp.max(fills)[None], fills])
+    lens = jnp.concatenate([jnp.max(fills)[None], pos])
     # interleaved-pair RoPE at each row's own position, factored as
-    # x·C + (x·P)·S so the kernel needs no per-row matrices
-    c_half = rope[0][fills, :d // 2].astype(jnp.float32)  # (b, d/2)
-    s_half = rope[1][fills, :d // 2].astype(jnp.float32)
+    # x·C + (x·P)·S so the kernel needs no per-row matrices.  Window
+    # rows past the table length clamp (their logits are discarded by
+    # the caller; the gather must simply stay in bounds).
+    rpos = jnp.minimum(pos, rope[0].shape[0] - 1)
+    c_half = rope[0][rpos, :d // 2].astype(jnp.float32)  # (b, d/2)
+    s_half = rope[1][rpos, :d // 2].astype(jnp.float32)
     sign = jnp.where(jnp.arange(d) % 2 == 0, -1.0, 1.0)
     c_rows = jnp.repeat(c_half, 2, axis=-1)
     s_rows = jnp.repeat(s_half, 2, axis=-1) * sign[None, :]
@@ -1027,16 +1164,21 @@ def fused_decode_step_paged(
             (1,) + shape, lambda li, ki, *s: (li,) + (0,) * len(shape))
 
     def cache_spec(trailing):
-        # attend tick t = r*ntb + j fetches row r's logical block j via
-        # its table, clamped at the row's own last live block — so HBM
+        # attend tick t = r*ntb + j fetches slot r's logical block j via
+        # its table, clamped at the slot's own last live block — so HBM
         # traffic is the sum of per-row fills; an empty row's walk lands
         # on the trash block (one fetch, fully masked).  MLP ticks clamp
-        # to the final attend tick, adding no traffic.
+        # to the final attend tick, adding no traffic.  With a verify
+        # window the walk extends to the slot's DEEPEST row's limit
+        # (lens[1 + r·W + W-1] = fill_r + W - 1): the fill-boundary and
+        # append blocks must stream so the kernel can splice the window
+        # K/V over their columns; un-allocated append entries point at
+        # the trash block, whose columns are all spliced or masked.
         def idx(li, ki, lens, tbl):
             t = jnp.minimum(ki, nk - 1)
             r = t // ntb
             j = t - r * ntb
-            last = jnp.maximum(lens[1 + r] - 1, 0) // block_k
+            last = jnp.maximum(lens[1 + r * W + W - 1] - 1, 0) // block_k
             return (li, tbl[r, jnp.minimum(j, last)], 0, 0, 0)
         return pl.BlockSpec((1, 1, nkv, block_k, trailing), idx)
 
@@ -1096,7 +1238,7 @@ def fused_decode_step_paged(
     compiler_params_cls = getattr(pltpu, "CompilerParams", None) \
         or pltpu.TPUCompilerParams
     hidden, k_rows, v_rows = pl.pallas_call(
-        functools.partial(_decode_step_kernel_paged, wq8, cq8,
+        functools.partial(_decode_step_kernel_paged, wq8, cq8, W,
                           ntb, nm, block_k,
                           b, nq, nkv, g, d, eps, scale, act),
         grid_spec=pltpu.PrefetchScalarGridSpec(

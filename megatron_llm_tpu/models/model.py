@@ -341,6 +341,97 @@ def forward_cached_paged(
     return logits, k_pool, v_pool
 
 
+def forward_cached_paged_verify(
+    cfg: ModelConfig,
+    params: Params,
+    window: jax.Array,   # [S, W] int32 — pending token + drafted tokens
+    k_pool: jax.Array,   # [L, n_blocks, kv_heads, block, head_dim] (pytree)
+    v_pool: jax.Array,
+    tables: jax.Array,   # [S, T] int32 per-slot block tables
+    fills: jax.Array,    # [S] int32 per-slot fill levels
+    bids: jax.Array,     # [S*W] int32 destination block per window row
+    offs: jax.Array,     # [S*W] int32 in-block offset per window row
+    *,
+    rope: Optional[tuple] = None,
+    use_fused: bool = False,
+):
+    """Batched variable-length speculative *verify* over the paged pool.
+
+    Row ``s`` of ``window`` holds ``[pending, d_1 .. d_{W-1}]`` — its last
+    committed token followed by ``W-1`` draft tokens (rows with fewer
+    real drafts are padded; the engine ignores their logits).  One
+    dispatch runs the whole stack at positions ``fills[s] .. fills[s]+W-1``
+    per row with per-row causal masking, returns logits for every window
+    position, and appends the window's K/V rows to the pool.
+
+    Rollback is the caller's concern and costs nothing here: rejected
+    rows were written to ``(bids, offs)`` slots that the next step simply
+    overwrites (the engine routes suppressed rows to the trash block), and
+    the fill vector just doesn't advance past the accepted prefix.
+
+    Each verify position is bitwise-identical to the corresponding
+    sequential single-token step, which is what makes
+    accept-longest-greedy-prefix exact rather than approximate.  The two
+    arms get there differently: the fused kernel replays the window as
+    per-row merged-tile splices inside one dispatch (kernels/
+    decode_step.py), while the composed fallback walks the window one
+    token at a time over a single gathered dense view — the same
+    fixed-arity buffer shape and op sequence as ``forward_cached_paged``'s
+    composed route, because XLA's reductions are only bitwise-stable
+    when the shapes match exactly (a one-pass W-token batch reassociates
+    the attention sums and drifts ~1e-7).  The gather/append pool
+    round-trip equals in-place dense updates leaf-for-leaf (int8 rows
+    requantize through the identical ``quantize_rows``), so walking a
+    persistent dense view matches re-gathering every step.
+
+    The window writes land at ``fills[s] .. fills[s]+W-1``, which the
+    caller must keep inside the table capacity (the engine reserves
+    blocks and clamps draft length near ``max_seq_len``); the dense
+    view is deliberately *not* padded — padding would change the
+    attention reduction length and break bitwise equality.
+
+    Returns ``(logits [S, W, vocab] fp32, new_k_pool, new_v_pool)``.
+    """
+    if rope is None:
+        rope = rope_tables(cfg)
+    S, W = window.shape
+    fills = jnp.asarray(fills, jnp.int32)
+    tables = jnp.asarray(tables, jnp.int32)
+    bids = jnp.asarray(bids, jnp.int32).reshape(S * W)
+    offs = jnp.asarray(offs, jnp.int32).reshape(S * W)
+    if use_fused:
+        from ..kernels.decode_step import fused_decode_verify_paged
+        from ..ops.kv_quant import is_quantized_cache, quantize_rows
+
+        pos = fills[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        x = embed(cfg, params, window, pos)
+        hidden, k_rows, v_rows = fused_decode_verify_paged(
+            cfg, params["layers"], x, k_pool, v_pool, tables, fills, rope)
+        if is_quantized_cache(k_pool):
+            k_rows = quantize_rows(k_rows)
+            v_rows = quantize_rows(v_rows)
+        k_pool = cache_append_rows(k_pool, k_rows, bids, offs)
+        v_pool = cache_append_rows(v_pool, v_rows, bids, offs)
+        x = norm_apply(cfg.norm_type, hidden, params["final_norm"],
+                       cfg.norm_eps, impl=cfg.norm_impl)
+        logits = unembed(cfg, params, x)
+        return logits.astype(jnp.float32), k_pool, v_pool
+    k_dense = cache_gather_blocks(k_pool, tables)
+    v_dense = cache_gather_blocks(v_pool, tables)
+    steps = []
+    for j in range(W):
+        lj, k_dense, v_dense = forward_cached(
+            cfg, params, window[:, j:j + 1], k_dense, v_dense, fills + j,
+            rope=rope)
+        steps.append(lj)
+    logits = jnp.concatenate(steps, axis=1)
+    k_pool = cache_append_rows(
+        k_pool, cache_rows_range(k_dense, fills, W), bids, offs)
+    v_pool = cache_append_rows(
+        v_pool, cache_rows_range(v_dense, fills, W), bids, offs)
+    return logits, k_pool, v_pool
+
+
 def init_kv_cache(cfg: ModelConfig, batch_size: int, max_len: int,
                   dtype=None):
     """Allocate an empty stacked KV cache ([L, b, kv_heads, max_len, d] ×2).
@@ -457,6 +548,28 @@ def cache_rows_at(dense, fills):
     def f(a):
         idx = fills.reshape((1, -1) + (1,) * (a.ndim - 2))
         return jnp.take_along_axis(a, idx, axis=3)
+
+    return jax.tree.map(f, dense)
+
+
+def cache_rows_range(dense, fills, width: int):
+    """Extract ``width`` consecutive rows starting at each slot's own fill
+    level from a dense cache (leaves [L, S, kv, Wd(, d)]), flattened to
+    the [L, S·width, kv, 1(, d)] row layout ``cache_append_rows``
+    consumes — row ``s*width + j`` is slot ``s``'s window position ``j``.
+    The ``width == 1`` case degenerates to ``cache_rows_at``; the verify
+    path uses it to pull a whole speculative window's appended K/V out of
+    the padded working view in one gather."""
+    fills = jnp.asarray(fills, jnp.int32)
+
+    def f(a):
+        S, kv = a.shape[1], a.shape[2]
+        tail = tuple(a.shape[4:])
+        idx = fills[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+        idx = idx.reshape((1, S, 1, width) + (1,) * (a.ndim - 4))
+        rows = jnp.take_along_axis(a, idx, axis=3)   # [L, S, kv, W(,d)]
+        rows = jnp.moveaxis(rows, 3, 2)              # [L, S, W, kv(,d)]
+        return rows.reshape((a.shape[0], S * width, kv, 1) + tail)
 
     return jax.tree.map(f, dense)
 

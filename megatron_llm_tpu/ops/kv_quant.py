@@ -38,10 +38,19 @@ def init_quantized_cache(shape: tuple) -> dict:
             "scale": jnp.zeros(shape[:-1], jnp.float32)}
 
 
+# Scales are amax·(1/127), not amax/127: the speculative-verify kernel
+# recomputes row scales inside the fused kernel and must land on the very
+# same fp32 the host-side quantize_rows stored — a constant multiply is one
+# exactly-rounded op everywhere, while XLA lowers a constant *divide*
+# differently across fusion contexts (reciprocal-multiply rewrite), which
+# showed up as a 1-ulp scale split between the two paths.
+_RCP127 = float(jnp.float32(1.0) / jnp.float32(127.0))
+
+
 def quantize_rows(rows: jax.Array) -> dict:
     """[..., s, d] new rows → {"q": int8, "scale": fp32 [..., s]}."""
     r32 = rows.astype(jnp.float32)
-    scale = jnp.max(jnp.abs(r32), axis=-1) / 127.0
+    scale = jnp.max(jnp.abs(r32), axis=-1) * _RCP127
     scale = jnp.where(scale == 0, 1.0, scale)
     q = jnp.clip(jnp.round(r32 / scale[..., None]),
                  -127, 127).astype(jnp.int8)
@@ -58,10 +67,10 @@ def fake_quantize_rows(rows: jax.Array) -> jax.Array:
     path reads back from the quantized cache.  The kernel then returns
     these fp rows and the host-side ``quantize_rows`` reproduces the same
     int8 payload — requantizing a dequantized row is idempotent (the row
-    max is exactly scale·127, so the recovered scale matches to 1 ulp and
+    max is exactly scale·127, so the recovered scale matches bitwise and
     every q/scale quotient rounds back to the same integer)."""
     r32 = rows.astype(jnp.float32)
-    scale = jnp.max(jnp.abs(r32), axis=-1, keepdims=True) / 127.0
+    scale = jnp.max(jnp.abs(r32), axis=-1, keepdims=True) * _RCP127
     scale = jnp.where(scale == 0, 1.0, scale)
     deq = jnp.clip(jnp.round(r32 / scale), -127, 127) * scale
     return deq.astype(rows.dtype)

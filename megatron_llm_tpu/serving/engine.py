@@ -70,9 +70,33 @@ their prefix blocks back the same way.  Because the shared blocks hold
 exactly what a cold prefill would write, the cache is purely a prefill
 shortcut — TTFT drops, trajectories don't move.
 
+Greedy requests can opt the engine into **speculative decoding**
+(``EngineConfig.spec_draft_len``): at schedule time the host proposes,
+per slot, up to ``spec_draft_len`` draft tokens by prompt lookup — the
+most recent earlier occurrence of the context's trailing n-gram, the
+PLD idea of generation/speculative.py applied per slot over the paged
+cache — and ONE batched verify forward scores every slot's
+``[pending, draft...]`` window at its own fill positions
+(models/model.py:forward_cached_paged_verify; on eligible TPU configs
+the multi-token fused kernel).  The longest draft prefix matching
+greedy argmax commits in a single iteration; position 0 samples exactly
+like a plain step, so acceptance can only reproduce what sequential
+decode would have emitted, bitwise, and non-greedy requests ride the
+verify batch with an empty draft, their trajectories untouched.
+Rejected drafts roll back by fill arithmetic alone: their K/V rows sit
+past the slot's fill level, masked out of attention, and later steps
+overwrite them in place — no block frees, no copies, COW and prefix
+sharing untouched.  A per-slot acceptance EWMA adapts each slot's draft
+budget down to zero on incompressible text (the batch then stays on the
+untouched pipelined plain path, re-probing occasionally), so
+speculation composes with the pipeline instead of fighting it: verify
+steps are the one place the scheduler deliberately syncs, because the
+next dispatch's fill depends on how many drafts landed.
+
 Greedy requests reproduce the one-shot ``generation.generate_tokens``
 trajectory token-for-token (tested bitwise on CPU fp32, the same
-equivalence bar the PLD path meets), pipelined or not.
+equivalence bar the PLD path meets), pipelined or not, speculative or
+not.
 """
 
 from __future__ import annotations
@@ -165,6 +189,23 @@ class EngineConfig:
     #                               lower to trade worst-case headroom for
     #                               more concurrent mixed-length requests
     #                               at the same HBM (bench serving_paged).
+    spec_draft_len: int = 0       # speculative decoding: max draft tokens
+    #                               per slot per verify step, proposed by
+    #                               a host-side n-gram matcher over the
+    #                               request's own context (prompt lookup)
+    #                               and checked in ONE batched multi-token
+    #                               verify forward.  Greedy requests only;
+    #                               accepted tokens are bitwise the ones
+    #                               plain decode would have produced, and
+    #                               a per-slot acceptance EWMA backs the
+    #                               draft budget off to zero on text that
+    #                               doesn't repeat.  0 = off (default: the
+    #                               verify executable costs W model
+    #                               passes' FLOPs per step, which only
+    #                               pays off on repetitive traffic).
+    spec_ngram: int = 3           # trailing n-gram length the drafter
+    #                               matches on (longer = fewer, better
+    #                               drafts)
     sanitize: bool = False        # runtime sanitizers (analysis/
     #                               sanitizers.py): per-iteration block-
     #                               pool ledger checks, a leak report at
@@ -375,6 +416,76 @@ _decode_plain = functools.partial(
     jax.jit, static_argnames=("cfg", "use_fused"))(_decode_impl)
 
 
+def _verify_impl(cfg: ModelConfig, params, k_pool, v_pool, tables, window,
+                 fills, bids, offs, seeds, counters, greedy, temps, top_ks,
+                 top_ps, *, use_fused: bool):
+    """One speculative verify step over every slot: feed each slot's
+    ``[pending, draft...]`` window at its own fill positions and score
+    ALL window positions in one forward
+    (models/model.py:forward_cached_paged_verify).  Position 0 samples
+    exactly like ``_decode_impl`` — same ``_sample_slots``, same RNG
+    fold — so a slot riding with an empty draft (non-greedy request, no
+    n-gram match) takes a bitwise-unchanged plain step.  Positions >= 1
+    only ever commit under greedy acceptance, so their pad-masked argmax
+    is the whole sampling story.  Returns ``([S, W] tokens, [S, W]
+    logprobs, pools)``; the host ignores columns past each slot's
+    accepted prefix."""
+    rope = model_lib.rope_tables(cfg)
+    logits, k_pool, v_pool = model_lib.forward_cached_paged_verify(
+        cfg, params, window, k_pool, v_pool, tables, fills, bids, offs,
+        rope=rope, use_fused=use_fused)
+    tok0, tok0_lp = _sample_slots(logits[:, 0], seeds, counters, greedy,
+                                  temps, top_ks, top_ps, cfg.vocab_size)
+    V = logits.shape[-1]
+    pad = jnp.arange(V) >= cfg.vocab_size
+    masked = jnp.where(pad[None, None, :], NEG_INF, logits)
+    g_tok = jnp.argmax(masked, axis=-1).astype(jnp.int32)       # [S, W]
+    lp = jax.nn.log_softmax(masked, axis=-1)
+    g_lp = jnp.take_along_axis(lp, g_tok[..., None], axis=-1)[..., 0]
+    g_tok = g_tok.at[:, 0].set(tok0)
+    g_lp = g_lp.at[:, 0].set(tok0_lp)
+    return g_tok, g_lp, k_pool, v_pool
+
+
+_verify_donated = functools.partial(
+    jax.jit, static_argnames=("cfg", "use_fused"),
+    donate_argnums=(2, 3))(_verify_impl)
+_verify_plain = functools.partial(
+    jax.jit, static_argnames=("cfg", "use_fused"))(_verify_impl)
+
+
+# speculative decoding policy: weight of the newest per-slot acceptance
+# observation in the EWMA that scales the draft budget, and how many
+# zero-draft iterations a collapsed slot waits before probing again with
+# a single draft token (so a repetitive stretch later in the generation
+# can re-engage speculation)
+_SPEC_EWMA_ALPHA = 0.3
+_SPEC_PROBE_INTERVAL = 16
+
+
+def _ngram_draft_host(ctx: Sequence[int], ngram: int,
+                      draft_len: int) -> List[int]:
+    """Host-side prompt-lookup draft — the numpy mirror of the jitted
+    ``generation/speculative.py:_ngram_draft``: find the most recent
+    *earlier* occurrence of the context's trailing ``ngram`` tokens and
+    propose the tokens that followed it.  Draft quality only moves
+    throughput — any draft verifies exactly — so unlike the fixed-arity
+    device version this returns a variable-length (possibly empty) list
+    instead of clip-padding a miss."""
+    n = len(ctx)
+    if draft_len < 1 or n < ngram + 1:
+        return []
+    a = np.asarray(ctx, np.int64)
+    tail = a[-ngram:]
+    # windows over a[:-1] so the trailing n-gram can't match itself
+    wins = np.lib.stride_tricks.sliding_window_view(a[:-1], ngram)
+    hits = np.flatnonzero((wins == tail).all(axis=1))
+    if hits.size == 0:
+        return []
+    j = int(hits[-1])
+    return [int(t) for t in a[j + ngram:j + ngram + draft_len]]
+
+
 @jax.jit
 def _gather_lease_impl(k_pool, v_pool, table):
     """Materialize a prefix lease's shared blocks as a batch-1 dense
@@ -450,6 +561,14 @@ class _SlotState:
         self.fresh = True         # pending must override the device vector
         self.lease = None         # PrefixLease pinning this request's
         #                           cached prefix blocks until retirement
+        self.spec_ewma = 1.0      # EWMA of this slot's draft acceptance
+        #                           fraction, scaling the next verify
+        #                           step's draft budget (1.0 at admission
+        #                           = optimistic engagement)
+        self.spec_stall = 0       # consecutive iterations this slot
+        #                           carried no draft — drives the
+        #                           periodic re-probe once the budget
+        #                           collapses to zero
 
 
 class _Inflight:
@@ -523,6 +642,8 @@ class ServingEngine:
         self._active: dict[int, _SlotState] = {}    # slot -> state
         self._decode = (_decode_plain if jax.default_backend() == "cpu"
                         else _decode_donated)
+        self._verify = (_verify_plain if jax.default_backend() == "cpu"
+                        else _verify_donated)
         self._prefill_chunk_fn = (
             _prefill_chunk_plain if jax.default_backend() == "cpu"
             else _prefill_chunk_donated)
@@ -553,6 +674,7 @@ class ServingEngine:
         # predicate is static in cfg/params/cache shape) and used to
         # attribute each decode iteration to fused_steps/fallback_steps
         self._fused_decode = False
+        self._fused_verify = False  # same, for the multi-token verify step
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -588,6 +710,13 @@ class ServingEngine:
                     self.cfg, self.params, pool.k_pool,
                     cfg_e.max_batch_size, self.slots.table_blocks,
                     jax.default_backend())
+                if cfg_e.spec_draft_len > 0:
+                    from ..kernels.decode_step import (
+                        fused_paged_verify_eligible)
+                    self._fused_verify = fused_paged_verify_eligible(
+                        self.cfg, self.params, pool.k_pool,
+                        cfg_e.max_batch_size, cfg_e.spec_draft_len + 1,
+                        self.slots.table_blocks, jax.default_backend())
                 self._update_pool_gauges()
                 if self._sanitize:
                     self._sanitizer = sanitizers.LedgerSanitizer()
@@ -1132,7 +1261,19 @@ class ServingEngine:
 
         Non-pipelined mode runs the same code with the processing moved
         after the dispatch of the SAME step, i.e. the classic
-        dispatch -> sync -> commit loop."""
+        dispatch -> sync -> commit loop.
+
+        With speculative decoding enabled, an iteration where some slot
+        can carry a draft takes the verify path instead: the pipeline is
+        flushed (drafts must match against fully committed context, and
+        the next fill depends on how many land), one multi-token verify
+        forward runs, and up to draft_len+1 tokens commit per slot."""
+        if self.config.spec_draft_len > 0 and self._plan_spec():
+            self._flush_inflight()
+            drafts = self._build_drafts()
+            if drafts:
+                self._spec_step(drafts)
+                return
         it0 = time.perf_counter()
         t = self.metrics.timers("serving-decode", 2)
         t.start()
@@ -1155,6 +1296,202 @@ class ServingEngine:
             args={"batch": len(inflight.slots),
                   "route": "fused" if self._fused_decode else "fallback",
                   "pipelined": self.config.pipeline_decode})
+
+    def _spec_budget(self, st: _SlotState) -> int:
+        """Draft-token budget from the slot's acceptance EWMA; a slot
+        the policy collapsed to zero re-probes with one token every
+        ``_SPEC_PROBE_INTERVAL`` iterations so a repetitive stretch
+        later in the generation can re-engage speculation."""
+        k = int(round(st.spec_ewma * self.config.spec_draft_len))
+        if k < 1:
+            return 1 if st.spec_stall >= _SPEC_PROBE_INTERVAL else 0
+        return k
+
+    def _plan_spec(self) -> bool:
+        """Per-iteration speculative gate, run BEFORE breaking the
+        decode pipeline: stall bookkeeping plus a stale-context n-gram
+        probe, so the engine only pays a pipeline flush when some slot
+        can plausibly carry a draft.  The host context is missing at
+        most the one in-flight token; the authoritative drafts are
+        rebuilt after the flush (``_build_drafts``)."""
+        if not self._active:
+            return False
+        W = self.config.spec_draft_len + 1
+        if any(st.fill + W > self.slots.width
+               for st in self._active.values()):
+            # a slot is within W rows of its table width: every rider's
+            # verify forward writes (masked, later overwritten) rows at
+            # fill..fill+W-1, so the whole batch takes plain steps for
+            # this tail stretch — at most W iterations per request
+            return False
+        want = False
+        for st in self._active.values():
+            if not st.req.greedy or st.count > st.req.max_new_tokens - 2:
+                continue
+            if self._spec_budget(st) < 1:
+                st.spec_stall += 1
+                continue
+            if _ngram_draft_host(st.req.prompt + st.req.generated,
+                                 self.config.spec_ngram, 1):
+                want = True
+            else:
+                st.spec_stall += 1
+        return want
+
+    def _build_drafts(self) -> dict:
+        """slot -> draft tokens for this verify step.  Authoritative:
+        the pipeline is flushed, so every context is fully committed and
+        the remaining-token budgets are exact."""
+        drafts = {}
+        for slot, st in self._active.items():
+            if not st.req.greedy:
+                continue
+            rem = st.req.max_new_tokens - len(st.req.generated)
+            k_cap = min(self.config.spec_draft_len, self._spec_budget(st),
+                        rem - 1)
+            if k_cap < 1:
+                continue
+            d = _ngram_draft_host(st.req.prompt + st.req.generated,
+                                  self.config.spec_ngram, k_cap)
+            if d:
+                drafts[slot] = d
+                st.spec_stall = 0
+        return drafts
+
+    # tpulint: hot-path
+    def _spec_step(self, drafts: dict) -> None:
+        """One speculative verify iteration (pipeline already flushed):
+        feed every slot's ``[pending, draft...]`` window through the
+        verify forward, accept the longest draft prefix matching what
+        greedy decode would have produced, commit accepted+1 tokens, and
+        roll the rest back by simply not advancing ``fill`` past them —
+        rejected rows sit beyond the fill level, masked out of
+        attention, and later steps overwrite them in place.  No block
+        churn: the row targeting went through the same
+        ``append_block_id`` path as plain decode, COW included."""
+        assert self._inflight is None
+        it0 = time.perf_counter()
+        t = self.metrics.timers("serving-decode", 2)
+        t.start()
+        S = self.config.max_batch_size
+        W = self.config.spec_draft_len + 1
+        window = np.zeros((S, W), np.int32)
+        fills = np.zeros((S,), np.int32)
+        seeds = np.zeros((S,), np.uint32)
+        counters = np.zeros((S,), np.int32)
+        greedy = np.ones((S,), bool)
+        temps = np.ones((S,), np.float32)
+        top_ks = np.zeros((S,), np.int32)
+        top_ps = np.zeros((S,), np.float32)
+        bids = np.zeros((S * W,), np.int32)  # default: the trash block
+        offs = np.zeros((S * W,), np.int32)
+        bk = self.slots.pool.block_size
+        for slot, st in self._active.items():
+            d = drafts.get(slot, ())
+            window[slot, 0] = st.pending
+            window[slot, 1:1 + len(d)] = d
+            fills[slot] = st.fill
+            seeds[slot] = st.req.seed
+            counters[slot] = st.count
+            greedy[slot] = st.req.greedy
+            temps[slot] = st.req.temperature
+            top_ks[slot] = st.req.top_k
+            top_ps[slot] = st.req.top_p
+            st.fresh = False
+            # every window row that may commit needs its destination
+            # block resolved (lazily allocated / COWed) BEFORE the
+            # tables snapshot, exactly like the plain path's single row;
+            # rows past the draft stay routed to the trash block
+            for j in range(len(d) + 1):
+                pos = st.fill + j
+                self.slots.append_block_id(slot, pos)
+                bids[slot * W + j] = self.slots.tables[slot][pos // bk]
+                offs[slot * W + j] = pos % bk
+        tables = jnp.asarray(self.slots.tables)
+
+        t0 = time.perf_counter()
+        if self._last_dispatch_t is not None:
+            wall = t0 - self._last_dispatch_t
+            if wall > 0 and self._last_ready_t is not None:
+                gap = min(wall, t0 - self._last_ready_t)
+                self.metrics.observe_step_breakdown(gap_frac=gap / wall)
+        self._last_dispatch_t = t0
+        self.metrics.inc(
+            "fused_steps" if self._fused_verify else "fallback_steps")
+        with device_annotation("verify"):
+            g_tok, g_lp, k_pool, v_pool = self._verify(
+                self.cfg, self.params, self.slots.k_pool,
+                self.slots.v_pool, tables, jnp.asarray(window),
+                jnp.asarray(fills), jnp.asarray(bids), jnp.asarray(offs),
+                jnp.asarray(seeds), jnp.asarray(counters),
+                jnp.asarray(greedy), jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                use_fused=self._fused_verify)
+        self.slots.set_pools(k_pool, v_pool)
+        # tpulint: allow[host-sync] verify steps are synchronous by
+        # design: the next dispatch's fill vector depends on how many
+        # drafts were accepted, so there is nothing to overlap
+        g_tok = np.asarray(g_tok)
+        g_lp = np.asarray(g_lp)  # tpulint: allow[host-sync] same fetch
+        t_ready = time.perf_counter()
+        self._last_ready_t = t_ready
+        device_s = t_ready - t0
+
+        total_committed = 0
+        proposed = 0
+        accepted_total = 0
+        per_slot_committed = []
+        for slot, st in list(self._active.items()):
+            d = drafts.get(slot, ())
+            k_i = len(d)
+            acc = 0
+            # tpulint: allow[host-sync] numpy row, fetched above
+            while acc < k_i and int(g_tok[slot, acc]) == d[acc]:
+                acc += 1
+            proposed += k_i
+            accepted_total += acc
+            if k_i:
+                st.spec_ewma = ((1.0 - _SPEC_EWMA_ALPHA) * st.spec_ewma
+                                + _SPEC_EWMA_ALPHA * acc / k_i)
+            # dispatch-time semantics, span-sized: rows for the pending
+            # token and the accepted drafts landed; the bonus token's
+            # row is the NEXT step's write
+            st.fill += acc + 1
+            st.count += acc + 1
+            st.fresh = True
+            committed_here = 0
+            for j in range(acc + 1):
+                if self._active.get(slot) is not st:
+                    break  # EOS / budget retired the slot mid-window
+                # tpulint: allow[host-sync] numpy row, fetched above
+                st.pending = int(g_tok[slot, j])
+                committed_here += 1
+                # tpulint: allow[host-sync] numpy row, fetched above
+                self._commit_token(slot, st.pending, float(g_lp[slot, j]))
+            total_committed += committed_here
+            if k_i:
+                per_slot_committed.append(committed_here)
+            if self.trace.enabled:
+                self.trace.add("decode", t0, t_ready,
+                               request_id=st.req.rid, tid=st.req.id,
+                               args={"slot": slot, "spec": True,
+                                     "proposed": k_i, "accepted": acc,
+                                     "committed": committed_here})
+        t.stop()
+        self.metrics.observe_spec_step(proposed, accepted_total,
+                                       per_slot_committed)
+        self.metrics.observe_decode_iteration(total_committed, device_s)
+        self.metrics.observe_step_breakdown(device_s=device_s)
+        host_s = max(0.0, (time.perf_counter() - it0) - (t_ready - t0))
+        self.metrics.observe_step_breakdown(host_s=host_s)
+        self.metrics.set_gauges(slots_active=self.slots.active_slots)
+        self.trace.add(
+            "engine_step", it0, time.perf_counter(), tid=0,
+            args={"batch": len(drafts),
+                  "route": ("spec_fused" if self._fused_verify
+                            else "spec_fallback"),
+                  "pipelined": False, "proposed": proposed,
+                  "accepted": accepted_total})
 
     # tpulint: hot-path
     def _dispatch_decode(self) -> _Inflight:

@@ -128,6 +128,14 @@ _COUNTERS = (
     # owned blocks); anything nonzero on a pure prefix-hit workload means
     # zero-copy sharing broke (tests/serving/test_prefix_cache.py).
     "cow_copies_total",
+    # speculative decoding (serving/engine.py): draft tokens proposed by
+    # the host n-gram drafter vs draft tokens the batched verify step
+    # accepted, plus verify iterations run.  The acceptance ratio is the
+    # whole economics of speculation — on incompressible traffic it
+    # collapses toward zero and the per-slot EWMA policy stops drafting,
+    # so spec_steps flat-lining while decode_iterations climbs is the
+    # policy working, not a bug.
+    "spec_proposed", "spec_accepted", "spec_steps",
 )
 
 # (attribute, prometheus family name, help) for the latency reservoirs
@@ -142,6 +150,8 @@ _PROM_SUMMARIES = (
      "scheduler host bookkeeping per iteration"),
     ("prefix_hit_tokens", "serving_prefix_hit_tokens",
      "tokens per admission served from the prefix cache"),
+    ("accepted_per_step", "serving_accepted_tokens_per_step",
+     "tokens committed per participating slot per speculative verify step"),
 )
 
 
@@ -179,6 +189,10 @@ class ServingMetrics:
         # generic; samples here are token counts, not seconds)
         self.prefix_hit_tokens = LatencyHistogram()
         self.prefix_blocks = 0   # gauge: blocks resident in the cache
+        # tokens committed per participating slot per speculative verify
+        # step (accepted draft prefix + the bonus token; samples are
+        # token counts, not seconds)
+        self.accepted_per_step = LatencyHistogram()
         # paged KV pool gauges (engine._update_pool_gauges): free/used
         # block counts and the allocated-token / pool-token fraction
         self.blocks_free = 0
@@ -246,6 +260,19 @@ class ServingMetrics:
         with self._lock:
             self.prefix_hit_tokens.observe(float(tokens))
 
+    def observe_spec_step(self, proposed: int, accepted: int,
+                          committed: Sequence[int]) -> None:
+        """One speculative verify step: ``proposed`` draft tokens across
+        the batch, ``accepted`` of them confirmed against greedy decode,
+        ``committed`` tokens landed per participating slot (the accepted
+        prefix plus the bonus token, truncated by EOS/budget)."""
+        with self._lock:
+            self.counters["spec_steps"] += 1
+            self.counters["spec_proposed"] += proposed
+            self.counters["spec_accepted"] += accepted
+            for n in committed:
+                self.accepted_per_step.observe(float(n))
+
     def observe_ttft(self, seconds: float) -> None:
         with self._lock:
             self.ttft.observe(seconds)
@@ -291,6 +318,13 @@ class ServingMetrics:
                 "blocks_free": self.blocks_free,
                 "blocks_used": self.blocks_used,
                 "kv_cache_util": self.kv_cache_util,
+                # speculative decoding (histogram samples are token
+                # counts per participating slot per verify step)
+                "spec_acceptance_rate": (
+                    self.counters["spec_accepted"]
+                    / max(1, self.counters["spec_proposed"])),
+                "accepted_tokens_per_step":
+                    self.accepted_per_step.snapshot(suffix=""),
             })
         out["slo"] = self.slo.snapshot()
         return out
@@ -336,7 +370,11 @@ class ServingMetrics:
                      self.blocks_used),
                     ("serving_kv_cache_util",
                      "allocated-token fraction of the KV pool",
-                     self.kv_cache_util)):
+                     self.kv_cache_util),
+                    ("serving_spec_acceptance_rate",
+                     "speculative draft tokens accepted / proposed",
+                     self.counters["spec_accepted"]
+                     / max(1, self.counters["spec_proposed"]))):
                 fams.append(MetricFamily(gname, "gauge", help_).add(value))
             for attr, pname, help_ in _PROM_SUMMARIES:
                 hist: LatencyHistogram = getattr(self, attr)
@@ -373,6 +411,11 @@ class ServingMetrics:
                           iteration)
         writer.add_scalar("serving/prefix_hit_tokens_mean",
                           snap["prefix_hit_tokens"]["mean"], iteration)
+        writer.add_scalar("serving/spec_acceptance_rate",
+                          snap["spec_acceptance_rate"], iteration)
+        writer.add_scalar("serving/accepted_tokens_per_step_mean",
+                          snap["accepted_tokens_per_step"]["mean"],
+                          iteration)
         for hist, key in ((self.ttft, "ttft"),
                           (self.per_token, "per_token_latency"),
                           (self.e2e, "e2e_latency"),

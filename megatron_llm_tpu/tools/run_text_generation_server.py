@@ -35,6 +35,12 @@ def _start_metrics_logger(service, interval_s: float):
                 "prefix_misses": snap["prefix_misses"],
                 "prefix_hit_rate": round(snap["prefix_hit_rate"], 4),
                 "prefix_blocks": snap["prefix_blocks"],
+                "spec_proposed": snap["spec_proposed"],
+                "spec_accepted": snap["spec_accepted"],
+                "spec_acceptance_rate": round(
+                    snap["spec_acceptance_rate"], 4),
+                "accepted_tokens_per_step_mean": round(
+                    snap["accepted_tokens_per_step"]["mean"], 3),
             }}), flush=True)
 
     t = threading.Thread(target=loop, name="serving-metrics-log",
@@ -144,6 +150,21 @@ def main(argv=None) -> int:
                     help="prompt-lookup speculative decoding for greedy "
                          "requests (multi-token decode steps; "
                          "generation/speculative.py)")
+    ap.add_argument("--draft_len", type=int, default=0,
+                    help="engine-side speculative decoding: max draft "
+                         "tokens per slot per step, proposed by the host "
+                         "n-gram drafter and checked in one batched "
+                         "verify forward (docs/serving.md, 'Speculative "
+                         "decoding').  Composes with continuous batching, "
+                         "paged KV, and the int8 cache; a per-slot "
+                         "acceptance EWMA backs it off to plain decode on "
+                         "text that doesn't repeat.  0 = off")
+    ap.add_argument("--spec_ngram", type=int, default=3,
+                    help="trailing n-gram length the speculative drafter "
+                         "matches on (with --draft_len)")
+    ap.add_argument("--no_spec", action="store_true",
+                    help="force engine-side speculative decoding off "
+                         "(overrides --draft_len; diagnostic)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel shards for serving")
     ap.add_argument("--pp", type=int, default=1,
@@ -216,6 +237,8 @@ def main(argv=None) -> int:
         prefix_cache_blocks=prefix_blocks,
         kv_block_size=args.kv_block_size,
         kv_pool_blocks=args.kv_pool_blocks,
+        spec_draft_len=0 if args.no_spec else args.draft_len,
+        spec_ngram=args.spec_ngram,
         trace=not args.no_trace)
     if prefix_blocks:
         block_tokens = args.prefill_chunk or max(1, args.prefill_bucket)
@@ -228,6 +251,10 @@ def main(argv=None) -> int:
         print(f"paged KV: block_size={args.kv_block_size or 'auto'} "
               f"pool_blocks={args.kv_pool_blocks or 'auto'} "
               "(GET /kv; tools/dump_kv_pool.py)")
+    if args.draft_len and not args.no_spec:
+        print(f"speculative decoding: draft_len={args.draft_len} "
+              f"ngram={args.spec_ngram} (greedy requests; "
+              "docs/serving.md 'Speculative decoding')")
     print("tracing: " + ("disabled (--no_trace)" if args.no_trace
                          else "on (GET /trace; tools/dump_trace.py)"))
     if args.metrics_interval_s > 0:

@@ -471,6 +471,178 @@ def test_fused_paged_matches_dense_fused():
          cache_update(v_cache, want_v, fills)))
 
 
+def _verify_helpers():
+    from megatron_llm_tpu.kernels.decode_step import (
+        fused_decode_verify_paged,
+    )
+    from megatron_llm_tpu.models.model import (
+        forward_cached_paged,
+        forward_cached_paged_verify,
+    )
+    from megatron_llm_tpu.ops.kv_quant import (
+        is_quantized_cache,
+        quantize_rows,
+    )
+    return (fused_decode_verify_paged, forward_cached_paged,
+            forward_cached_paged_verify, is_quantized_cache, quantize_rows)
+
+
+def _verify_setup(int8, bk, key=1, fill=128, b=3, max_len=256):
+    """Params + shuffled paged pools for a verify-step parity case,
+    ragged fills including a block boundary (128) and a near-empty
+    slot (1)."""
+    cfg = _cfg(num_attention_heads=4, num_kv_heads=2,
+               **(dict(kv_cache_quant="int8") if int8 else {}))
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    if int8:
+        from megatron_llm_tpu.ops.quant import quantize_params
+
+        params = quantize_params(params)
+    k_cache, v_cache, rope = _prefill_cache(
+        cfg, params, b, max_len, fill, jax.random.key(key))
+    rng = np.random.default_rng(7)
+    tables = _shuffled_tables(b, max_len // bk, rng)
+    k_pool = _pool_from_cache(k_cache, bk, tables)
+    v_pool = _pool_from_cache(v_cache, bk, tables)
+    return cfg, params, rope, tables, k_pool, v_pool
+
+
+@pytest.mark.parametrize(
+    "int8",
+    [False, pytest.param(True, marks=pytest.mark.slow)],
+    ids=["fp32", "int8"],
+)
+def test_fused_verify_matches_sequential_steps(int8):
+    """The fused verify kernel (one call, W hidden states + W K/V rows
+    per slot) must be BITWISE equal to W sequential fused single-token
+    steps with a host append between each — per-row variable position,
+    block-boundary fill (128) and near-empty fill (1) included.  This
+    is the property the serving engine's accept/rollback logic leans
+    on: position j's output is exactly the single-token step's output
+    after rows 0..j-1 landed."""
+    (fused_verify, _, _, is_q, quant_rows) = _verify_helpers()
+    bk, W, b = 128, 3, 3
+    cfg, params, rope, tables, k_pool, v_pool = _verify_setup(int8, bk)
+    fills = np.asarray([37, 128, 1], np.int32)
+    x = jax.random.normal(jax.random.key(2), (b, W, cfg.hidden_size),
+                          jnp.float32)
+    jt = jnp.asarray(tables)
+
+    ks, vs = k_pool, v_pool
+    want_h = []
+    for j in range(W):
+        fj = jnp.asarray(fills + j, jnp.int32)
+        h, kr, vr = fused_decode_step_paged(
+            cfg, params["layers"], x[:, j], ks, vs, jt, fj, rope,
+            interpret=True)
+        if is_q(ks):
+            kr, vr = quant_rows(kr), quant_rows(vr)
+        bids = jnp.asarray(tables[np.arange(b), (fills + j) // bk],
+                           jnp.int32)
+        offs = jnp.asarray((fills + j) % bk, jnp.int32)
+        ks = cache_append_rows(ks, kr, bids, offs)
+        vs = cache_append_rows(vs, vr, bids, offs)
+        want_h.append(h)
+
+    got_h, k_rows, v_rows = fused_verify(
+        cfg, params["layers"], x, k_pool, v_pool, jt,
+        jnp.asarray(fills), rope, interpret=True)
+    for j in range(W):
+        np.testing.assert_array_equal(np.asarray(got_h[:, j]),
+                                      np.asarray(want_h[j]))
+    if is_q(k_pool):
+        k_rows, v_rows = quant_rows(k_rows), quant_rows(v_rows)
+    bids = jnp.asarray(
+        [tables[s, (fills[s] + j) // bk] for s in range(b)
+         for j in range(W)], jnp.int32)
+    offs = jnp.asarray([(fills[s] + j) % bk for s in range(b)
+                        for j in range(W)], jnp.int32)
+    kp = cache_append_rows(k_pool, k_rows, bids, offs)
+    vp = cache_append_rows(v_pool, v_rows, bids, offs)
+    jax.tree.map(lambda g, w: np.testing.assert_array_equal(
+        np.asarray(g), np.asarray(w)), (kp, vp), (ks, vs))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("int8", [False, True], ids=["fp32", "int8"])
+def test_composed_verify_matches_sequential_forwards(int8):
+    """forward_cached_paged_verify's composed arm (use_fused=False, the
+    CPU-CI route the serving engine takes off-TPU) vs W sequential
+    single-token forward_cached_paged calls: logits at every window
+    position and both post-append pools bitwise equal, at a small
+    block size so windows straddle block edges."""
+    (_, fwd_paged, fwd_verify, _, _) = _verify_helpers()
+    bk, W, b = 64, 4, 3
+    cfg, params, rope, tables, k_pool, v_pool = _verify_setup(int8, bk)
+    fills = np.asarray([37, 128, 200], np.int32)
+    window = jax.random.randint(jax.random.key(5), (b, W), 0,
+                                cfg.vocab_size)
+    jt = jnp.asarray(tables)
+
+    ks, vs = k_pool, v_pool
+    want_logits = []
+    for j in range(W):
+        logits, ks, vs = fwd_paged(
+            cfg, params, window[:, j:j + 1], ks, vs, jt,
+            jnp.asarray(fills + j, jnp.int32), rope=rope, use_fused=False)
+        want_logits.append(np.asarray(logits[:, 0]))
+
+    bids = np.asarray([[tables[s, (fills[s] + j) // bk] for j in range(W)]
+                       for s in range(b)], np.int32)
+    offs = np.asarray([[(fills[s] + j) % bk for j in range(W)]
+                       for s in range(b)], np.int32)
+    got_logits, kp, vp = fwd_verify(
+        cfg, params, window, k_pool, v_pool, jt, jnp.asarray(fills),
+        jnp.asarray(bids.reshape(-1)), jnp.asarray(offs.reshape(-1)),
+        rope=rope, use_fused=False)
+    for j in range(W):
+        np.testing.assert_array_equal(np.asarray(got_logits[:, j]),
+                                      want_logits[j])
+    jax.tree.map(lambda g, w: np.testing.assert_array_equal(
+        np.asarray(g), np.asarray(w)), (kp, vp), (ks, vs))
+
+
+@pytest.mark.parametrize(
+    "int8",
+    [False, pytest.param(True, marks=pytest.mark.slow)],
+    ids=["fp32", "int8"],
+)
+def test_fused_verify_vs_composed_cross(int8):
+    """The two verify arms against each other through the model-level
+    entry point (fused arm interpret-forced): same logits within the
+    usual fused-vs-composed tolerance."""
+    (_, _, fwd_verify, _, _) = _verify_helpers()
+    bk, W, b = 128, 3, 3
+    cfg, params, rope, tables, k_pool, v_pool = _verify_setup(int8, bk)
+    fills = np.asarray([37, 128, 1], np.int32)
+    window = jax.random.randint(jax.random.key(5), (b, W), 0,
+                                cfg.vocab_size)
+    jt = jnp.asarray(tables)
+    bids = np.asarray([[tables[s, (fills[s] + j) // bk] for j in range(W)]
+                       for s in range(b)], np.int32).reshape(-1)
+    offs = np.asarray([[(fills[s] + j) % bk for j in range(W)]
+                       for s in range(b)], np.int32).reshape(-1)
+
+    want, _, _ = fwd_verify(
+        cfg, params, window, k_pool, v_pool, jt, jnp.asarray(fills),
+        jnp.asarray(bids), jnp.asarray(offs), rope=rope, use_fused=False)
+
+    import megatron_llm_tpu.kernels.decode_step as ds
+    orig = ds.fused_decode_verify_paged
+    try:
+        ds.fused_decode_verify_paged = lambda *a, **kw: orig(
+            *a, **{**kw, "interpret": True})
+        got, _, _ = fwd_verify(
+            cfg, params, window, k_pool, v_pool, jt, jnp.asarray(fills),
+            jnp.asarray(bids), jnp.asarray(offs), rope=rope,
+            use_fused=True)
+    finally:
+        ds.fused_decode_verify_paged = orig
+    tol = (dict(rtol=3e-2, atol=3e-2) if int8
+           else dict(rtol=2e-4, atol=2e-4))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **tol)
+
+
 def test_fused_paged_matches_dense_fused_int8():
     """Same bitwise bar, fully int8-resident: int8 weights and the
     {q, scale} pool pytree — quantized codes gathered through the table

@@ -412,3 +412,199 @@ class TestPagedEquivalence:
             assert r.tokens == _reference(cfg, params, p, 8)
         snap = engine.metrics.snapshot()
         assert snap["max_decode_batch"] <= 3  # the pool really bounded it
+
+
+class TestSpeculative:
+    """Speculative decoding acceptance matrix (docs/serving.md,
+    'Speculative decoding'): with per-slot prompt-lookup drafts, a
+    batched variable-length verify step, and rollback over paged
+    blocks, every committed token must equal the one-shot
+    ``generate_tokens`` trajectory bitwise — spec on/off x fp32/int8 x
+    paged/fixed-stride x pipelined/sync.  The repetitive prompts below
+    are chosen so the random-init model settles into a cycle and the
+    drafter actually engages (asserted via ``spec_steps``), so the
+    accept-and-commit path — not just the gate — is what's equal."""
+
+    REP_PROMPTS = [[5, 9, 3, 5, 9, 3, 5, 9, 3, 5, 9],
+                   [7, 7, 7, 7, 7, 7, 7],
+                   [4, 8, 2, 4, 8, 2, 4, 8],
+                   [11, 6, 11, 6, 11, 6, 11]]
+    MAX_NEW = 20
+
+    @pytest.fixture(scope="class")
+    def tiny_int8(self, tiny):
+        import dataclasses
+
+        from megatron_llm_tpu.ops.quant import quantize_params
+
+        cfg, params = tiny
+        return (dataclasses.replace(cfg, kv_cache_quant="int8"),
+                quantize_params(params))
+
+    def _drive(self, cfg, params, draft_len=3, prompts=None,
+               max_new=None, **overrides):
+        kw = dict(max_batch_size=4, max_seq_len=64, max_queue_size=16,
+                  spec_draft_len=draft_len)
+        kw.update(overrides)
+        prompts = prompts or self.REP_PROMPTS
+        max_new = max_new or self.MAX_NEW
+        engine = ServingEngine(cfg, params, EngineConfig(**kw)).start()
+        try:
+            handles = [engine.submit(p, max_new_tokens=max_new,
+                                     use_eos_stop=False) for p in prompts]
+            results = [h.result(timeout=600) for h in handles]
+        finally:
+            engine.shutdown()
+        return results, engine.metrics.snapshot()
+
+    def _check(self, cfg, params, **overrides):
+        results, snap = self._drive(cfg, params, **overrides)
+        for p, r in zip(self.REP_PROMPTS, results):
+            assert r.finish_reason == "length"
+            assert r.tokens == _reference(cfg, params, p, self.MAX_NEW)
+        assert snap["spec_steps"] > 0, "drafter never engaged"
+        assert 0 < snap["spec_acceptance_rate"] <= 1
+        assert 1 <= snap["accepted_tokens_per_step"]["mean"] <= \
+            overrides.get("draft_len", 3) + 1
+        return snap
+
+    @pytest.mark.parametrize("pipeline", [True, False],
+                             ids=["pipelined", "sync"])
+    def test_fp32_paged(self, tiny, pipeline):
+        self._check(*tiny, kv_block_size=8, pipeline_decode=pipeline)
+
+    @pytest.mark.parametrize("pipeline", [True, False],
+                             ids=["pipelined", "sync"])
+    def test_fp32_fixed_stride(self, tiny, pipeline):
+        """kv_block_size == max_seq_len: the pre-paging dense layout,
+        same engine code path (one block per slot)."""
+        self._check(*tiny, kv_block_size=64, pipeline_decode=pipeline)
+
+    @pytest.mark.slow
+    def test_int8_paged(self, tiny_int8):
+        self._check(*tiny_int8, kv_block_size=8)
+
+    def test_int8_fixed_stride_sync(self, tiny_int8):
+        self._check(*tiny_int8, kv_block_size=64, pipeline_decode=False)
+
+    @pytest.mark.slow
+    def test_composes_with_chunked_prefill_and_prefix_cache(self, tiny):
+        self._check(*tiny, kv_block_size=8, prefill_chunk=8,
+                    prefix_cache_blocks=16)
+
+    def test_sampled_riders_unchanged(self, tiny):
+        """Sampled requests carry empty drafts but ride verify batches
+        (position-0 sampling with the same seed/counter stream), so
+        their trajectories must be bitwise identical spec on vs off."""
+        cfg, params = tiny
+        reqs = [dict(prompt=self.REP_PROMPTS[0], max_new_tokens=12,
+                     temperature=0.8, top_k=8, seed=123,
+                     use_eos_stop=False),
+                dict(prompt=self.REP_PROMPTS[1], max_new_tokens=12,
+                     use_eos_stop=False)]
+
+        def run(draft_len):
+            engine = ServingEngine(cfg, params, EngineConfig(
+                max_batch_size=4, max_seq_len=64,
+                spec_draft_len=draft_len)).start()
+            try:
+                hs = [engine.submit(**r) for r in reqs]
+                toks = [h.result(timeout=600).tokens for h in hs]
+            finally:
+                engine.shutdown()
+            return toks, engine.metrics.snapshot()
+
+        on, snap = run(3)
+        off, _ = run(0)
+        assert on == off
+        assert snap["spec_steps"] > 0  # the greedy rider did speculate
+
+    def test_eos_mid_window(self, tiny):
+        """EOS landing inside an accepted draft span: the request must
+        stop at exactly the token plain decode stops at — the commit
+        loop retires the slot mid-window and discards the rest."""
+        cfg, params = tiny
+        prompt = [9, 2, 9, 2, 9, 2, 9]
+        ref = _reference(cfg, params, prompt, 20)
+        eos = int(ref[-1])
+
+        def run(draft_len):
+            engine = ServingEngine(cfg, params, EngineConfig(
+                max_batch_size=2, max_seq_len=64,
+                spec_draft_len=draft_len)).start()
+            try:
+                return engine.submit(prompt, max_new_tokens=20,
+                                     eos_id=eos,
+                                     use_eos_stop=True).result(timeout=600)
+            finally:
+                engine.shutdown()
+
+        r_on, r_off = run(4), run(0)
+        assert r_on.tokens == r_off.tokens
+        assert r_on.finish_reason == r_off.finish_reason
+
+    def test_capacity_tail_gate(self, tiny):
+        """Generation running to the sequence cap: within W rows of the
+        table width the whole batch must fall back to plain steps (the
+        verify forward writes masked rows at fill..fill+W-1), and the
+        trajectory stays identical to spec-off."""
+        cfg, params = tiny
+        prompt = self.REP_PROMPTS[0][:8]
+
+        def run(draft_len):
+            engine = ServingEngine(cfg, params, EngineConfig(
+                max_batch_size=2, max_seq_len=32,
+                spec_draft_len=draft_len)).start()
+            try:
+                return engine.submit(prompt, max_new_tokens=24,
+                                     use_eos_stop=False
+                                     ).result(timeout=600).tokens
+            finally:
+                engine.shutdown()
+
+        assert run(4) == run(0)
+
+    def test_block_boundary_rollback(self, tiny):
+        """Rejected drafts across block edges: with 4-token blocks and
+        draft windows of 4, verify windows constantly straddle block
+        boundaries and imperfect acceptance leaves rejected rows in
+        freshly allocated blocks.  Rollback is fill arithmetic — the
+        trajectory stays exact, no COW copies fire (no sharing here),
+        and the sanitizer's block ledger stays balanced through
+        drain."""
+        cfg, params = tiny
+        engine = ServingEngine(cfg, params, EngineConfig(
+            max_batch_size=4, max_seq_len=64, max_queue_size=16,
+            kv_block_size=4, spec_draft_len=3, sanitize=True)).start()
+        try:
+            handles = [engine.submit(p, max_new_tokens=self.MAX_NEW,
+                                     use_eos_stop=False)
+                       for p in self.REP_PROMPTS]
+            results = [h.result(timeout=600) for h in handles]
+            engine.drain(timeout=60)
+            assert engine.sanitizer_report == []
+        finally:
+            engine.shutdown()
+        for p, r in zip(self.REP_PROMPTS, results):
+            assert r.tokens == _reference(cfg, params, p, self.MAX_NEW)
+        snap = engine.metrics.snapshot()
+        assert snap["spec_steps"] > 0
+        assert snap["spec_accepted"] < snap["spec_proposed"], \
+            "no rejection ever happened; the rollback path went untested"
+        assert snap["cow_copies_total"] == 0
+
+    def test_spec_metrics_shape(self, tiny):
+        """The serving metrics surface for speculation: counters,
+        derived acceptance rate, and the accepted-per-step histogram
+        all present in snapshot() and consistent with each other."""
+        _, snap = self._drive(*tiny, kv_block_size=8)
+        assert snap["spec_proposed"] >= snap["spec_accepted"] >= 0
+        assert snap["spec_steps"] > 0
+        hist = snap["accepted_tokens_per_step"]
+        assert hist["count"] > 0
+        # per participating slot-step, committed = accepted + 1 bonus
+        # (mid-window EOS retirement can only truncate, never add)
+        total_committed = hist["mean"] * hist["count"]
+        assert total_committed <= \
+            snap["spec_accepted"] + hist["count"] + 1e-6
+        assert hist["mean"] >= 1.0
