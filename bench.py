@@ -583,6 +583,34 @@ def _serving_spec_point():
         gen_len=gen_len, slots=8, draft_len=4, ngram=3)
 
 
+def _serving_cluster_point():
+    """Multi-chip serving point (serving/cluster/, docs/serving.md
+    "Multi-chip serving"): mixed traffic through ``build_cluster`` at 1
+    vs 2 engine replicas on disjoint device slices, plus per-device
+    resident param bytes at tp=1 vs tp=2 under the serving re-layout.
+    Headlines ``serving_cluster_qps_ratio`` (acceptance bar ≥ 1.8x at 2
+    replicas on real multi-chip hardware; on the CPU device-count
+    simulation all "devices" share the host cores, so the simulated
+    ratio only tracks plumbing cost) and
+    ``serving_cluster_tp_model_size_ratio`` (≈ 2.0: a 2x larger model
+    per chip) gate in --compare."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.serving.bench import run_cluster_serving_bench
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"serving_cluster_skipped":
+                f"needs >= 2 devices, have {n_dev}"}
+    gen_len, max_prompt_len = 32, 128
+    cfg = _bench_model(max_prompt_len + gen_len, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return run_cluster_serving_bench(
+        cfg, params, num_requests=16, gen_len=gen_len, slots=4,
+        max_prompt_len=max_prompt_len, replicas=2, tp=2)
+
+
 def _transient_error_types():
     """The error classes worth retrying: the axon-tunneled compile service
     occasionally throws a transient remote-compile XlaRuntimeError.
@@ -625,7 +653,12 @@ _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
                      "serving_prefix.serving_prefix_hit_rate",
                      "serving_paged.serving_paged_max_concurrency",
                      "serving_spec.serving_spec_itl_speedup",
-                     "serving_spec.serving_spec_acceptance_rate")
+                     "serving_spec.serving_spec_acceptance_rate",
+                     # multi-chip serving: replica QPS scaling (≥ 1.8x at
+                     # 2 replicas on real hardware) and the tp=2 per-chip
+                     # model-size win (≈ 2.0)
+                     "serving_cluster.serving_cluster_qps_ratio",
+                     "serving_cluster.serving_cluster_tp_model_size_ratio")
 _REGRESSION_TOLERANCE = 0.10
 # Tracing must stay effectively free on the serving hot path: the mixed
 # point's ITL p50 with the span recorder on may exceed the untraced rerun
@@ -635,7 +668,8 @@ _TRACE_OVERHEAD_TOLERANCE = 0.10
 # Bumped when the record's shape changes (new points / renamed keys) so
 # --compare across old records is interpretable.
 # v3: + serving_spec point (speculative decoding ITL speedup + acceptance)
-_BENCH_SCHEMA_VERSION = 3
+# v4: + serving_cluster point (replica QPS scaling + tp model-size ratio)
+_BENCH_SCHEMA_VERSION = 4
 
 
 def _run_metadata(platform: str, device_count: int) -> dict:
@@ -824,12 +858,15 @@ def _child_main(spec_json: str) -> None:
         out = _retry(_serving_paged_point)
     elif kind == "serving_spec":
         out = _retry(_serving_spec_point)
+    elif kind == "serving_cluster":
+        out = _retry(_serving_cluster_point)
     else:  # pragma: no cover - parent and child ship together
         raise ValueError(f"unknown point kind {kind!r}")
     print(_CHILD_MARK + json.dumps(out), flush=True)
 
 
-def _point(label: str, spec: dict, timeout_s: int = 900):
+def _point(label: str, spec: dict, timeout_s: int = 900,
+           env: dict | None = None):
     """Run one measurement in a fresh subprocess → parsed result or None.
 
     Isolation is the point: a crashed, hung, or HBM-leaking measurement
@@ -845,7 +882,8 @@ def _point(label: str, spec: dict, timeout_s: int = 900):
             [sys.executable, os.path.abspath(__file__), "--point",
              json.dumps(spec)],
             capture_output=True, text=True, timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=(None if env is None else {**os.environ, **env}))
     except subprocess.TimeoutExpired as e:
         # surface the child's progress lines so the hung stage (compile /
         # warmup / timed window) is identifiable without a rerun
@@ -1010,6 +1048,20 @@ def main() -> None:
                           {"kind": "serving_spec",
                            "platform": platform},
                           timeout_s=1800)
+    # CPU runs simulate 8 devices so the replica/tp topology exercises
+    # end to end; on real hardware the flag is inert (jax ignores the
+    # host-platform count when an accelerator is present)
+    cluster_env = None
+    if platform == "cpu":
+        import os as _os
+
+        cluster_env = {"XLA_FLAGS": (
+            _os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8").strip()}
+    serving_cluster = _point("serving/cluster",
+                             {"kind": "serving_cluster",
+                              "platform": platform},
+                             timeout_s=1800, env=cluster_env)
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     record = {
@@ -1066,6 +1118,8 @@ def main() -> None:
         record["serving_paged"] = serving_paged
     if serving_spec is not None:
         record["serving_spec"] = serving_spec
+    if serving_cluster is not None:
+        record["serving_cluster"] = serving_cluster
     if headline is not None:
         record.update({
             "value": round(mfu, 4),

@@ -72,7 +72,12 @@ class GenerationService:
                  kv_pool_blocks: int | None = None,
                  spec_draft_len: int = 0,
                  spec_ngram: int = 3,
-                 trace: bool = True):
+                 trace: bool = True,
+                 tensor_parallel: int = 1,
+                 pipeline_parallel: int = 1,
+                 replicas: int = 1,
+                 router: bool = False,
+                 router_config=None):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -116,6 +121,18 @@ class GenerationService:
         # per-request span tracing (obs/trace.py, GET /trace); the CLI's
         # --no_trace escape hatch lands here
         self.trace_enabled = trace
+        # multi-chip serving (serving/cluster/, docs/serving.md): shard
+        # each engine over a pp·tp submesh and/or replicate engines on
+        # disjoint device slices behind the health-aware router.  The
+        # Router presents the engine surface (submit_many / drain /
+        # metrics / trace / kv_snapshot), so everything below it is
+        # topology-blind.  router=True forces the router front-end even
+        # at replicas=1 (uniform ops surface: GET /cluster, drain API).
+        self.tensor_parallel = tensor_parallel
+        self.pipeline_parallel = pipeline_parallel
+        self.replicas = replicas
+        self.router = router
+        self.router_config = router_config
         # the lock now guards only the legacy one-shot paths (beam search,
         # scoring, PLD); standard generation goes through the engine
         self.lock = make_lock("server.generate")
@@ -138,20 +155,34 @@ class GenerationService:
                     extra["kv_block_size"] = self.kv_block_size
                 if self.kv_pool_blocks is not None:
                     extra["kv_pool_blocks"] = self.kv_pool_blocks
-                self._engine = ServingEngine(
-                    self.cfg, self.params,
-                    EngineConfig(max_batch_size=self.max_batch_size,
-                                 max_seq_len=self.engine_max_seq_len,
-                                 max_queue_size=self.queue_size,
-                                 retry_after_s=self.retry_after_s,
-                                 default_deadline_s=self.request_deadline_s,
-                                 prefill_bucket=self.prefill_bucket,
-                                 prefill_chunk=self.prefill_chunk,
-                                 pipeline_decode=self.pipeline_decode,
-                                 spec_draft_len=self.spec_draft_len,
-                                 spec_ngram=self.spec_ngram,
-                                 trace=self.trace_enabled,
-                                 **extra))
+                engine_config = EngineConfig(
+                    max_batch_size=self.max_batch_size,
+                    max_seq_len=self.engine_max_seq_len,
+                    max_queue_size=self.queue_size,
+                    retry_after_s=self.retry_after_s,
+                    default_deadline_s=self.request_deadline_s,
+                    prefill_bucket=self.prefill_bucket,
+                    prefill_chunk=self.prefill_chunk,
+                    pipeline_decode=self.pipeline_decode,
+                    spec_draft_len=self.spec_draft_len,
+                    spec_ngram=self.spec_ngram,
+                    trace=self.trace_enabled,
+                    **extra)
+                shards = self.tensor_parallel * self.pipeline_parallel
+                if self.router or self.replicas > 1 or shards > 1:
+                    from ..config import ParallelConfig
+                    from ..serving import build_cluster
+
+                    self._engine = build_cluster(
+                        self.cfg, self.params, engine_config,
+                        replicas=self.replicas,
+                        parallel=ParallelConfig(
+                            pipeline_parallel=self.pipeline_parallel,
+                            tensor_parallel=self.tensor_parallel),
+                        router_config=self.router_config)
+                else:
+                    self._engine = ServingEngine(self.cfg, self.params,
+                                                 engine_config)
             return self._engine
 
     def metrics_snapshot(self) -> dict:
@@ -199,6 +230,25 @@ class GenerationService:
         if engine is None:
             return {"pool": None, "slots": {}}
         return engine.kv_snapshot()
+
+    def cluster_snapshot(self) -> dict:
+        """Cluster topology + health view (GET /cluster): router
+        dispatch/failover counters and per-replica probes when serving
+        through the cluster router, a single-engine summary otherwise.
+        An engine that was never created reports an empty cluster."""
+        with self._engine_init_lock:
+            engine = self._engine
+        if engine is None:
+            return {"router": None, "replicas": []}
+        if hasattr(engine, "replicas"):  # serving.cluster.Router
+            return engine.snapshot()
+        return {"router": None, "replicas": [{
+            "id": "engine-0",
+            "alive": engine._scheduler_error is None,
+            "queue_depth": len(engine.queue),
+            "slots_active": (engine.slots.active_slots
+                             if engine.slots is not None else 0),
+        }]}
 
     def drain(self, timeout: float | None = 30.0) -> bool:
         """Stop accepting generation requests and wait for the in-flight
@@ -507,6 +557,11 @@ class _Handler(BaseHTTPRequestHandler):
             # paged KV pool debug view: block tables, ref counts,
             # fragmentation (serving/block_pool.py, tools/dump_kv_pool.py)
             self._respond(200, self.service.kv_snapshot())
+            return
+        if route == "/cluster":
+            # multi-chip topology + health: router dispatch/failover
+            # counters, per-replica probes (serving/cluster/router.py)
+            self._respond(200, self.service.cluster_snapshot())
             return
         self._respond(404, "not found")
 

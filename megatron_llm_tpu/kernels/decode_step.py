@@ -652,17 +652,45 @@ def fused_decode_eligible(cfg, params, k_cache, s: int,
     return _pick_block_k(cfg, b, max_len, w_item, kc.dtype.itemsize) >= 128
 
 
+def _mesh_shards_stack(mesh) -> bool:
+    """True when ``mesh`` carries a >1 head-sharding factor (pp·tp).
+
+    The whole-stack fused kernels are single-device programs: the
+    residual stream crosses every layer inside one dispatch, so a
+    head-sharded stack would need in-kernel collectives after wo/w_down.
+    The shard-aware dispatch therefore declines whole-stack fusion on a
+    sharded engine and keeps the composed stack, whose per-op paged
+    attention runs the kernel per-shard under shard_map
+    (ops/attention.py:_sharded_paged_flash_decode) with replicated int32
+    tables and the int8 {q, scale} pool leaves moving verbatim."""
+    if mesh is None:
+        return False
+    from ..parallel.mesh import PIPELINE_AXIS, TENSOR_AXIS
+
+    factor = 1
+    for a in (PIPELINE_AXIS, TENSOR_AXIS):
+        if a in mesh.axis_names:
+            factor *= mesh.shape[a]
+    return factor > 1
+
+
 def fused_paged_decode_eligible(cfg, params, k_pool, n_slots: int,
-                                table_blocks: int, platform: str) -> bool:
+                                table_blocks: int, platform: str,
+                                mesh=None) -> bool:
     """Static predicate for the PAGED fused path (fused_decode_step_paged).
 
     Same stack scope as fused_decode_eligible, with the shape checks on
     the pool geometry: the kernel's cache tile IS the pool block, so the
     block size must be a legal (>= 128, lane-aligned) Mosaic tile and one
-    block per (batch-row, layer) must fit the VMEM estimate."""
+    block per (batch-row, layer) must fit the VMEM estimate.  ``mesh``
+    (the sharded serving engine's submesh, engine.start()) makes the
+    dispatch shard-aware: a head-sharding mesh keeps the composed stack
+    (see ``_mesh_shards_stack``); tp=1 meshes change nothing."""
     from ..ops.kv_quant import is_quantized_cache
 
     if n_slots < 1 or table_blocks < 1:
+        return False
+    if _mesh_shards_stack(mesh):
         return False
     wq8 = _stack_eligible(cfg, params, platform)
     if wq8 is None:
@@ -682,15 +710,19 @@ def fused_paged_decode_eligible(cfg, params, k_pool, n_slots: int,
 
 def fused_paged_verify_eligible(cfg, params, k_pool, n_slots: int,
                                 window: int, table_blocks: int,
-                                platform: str) -> bool:
+                                platform: str, mesh=None) -> bool:
     """Static predicate for the speculative verify kernel
     (fused_decode_verify_paged): the paged predicate with the row batch
     widened to ``n_slots * window`` — the flattened (slot, window-pos)
     rows all carry q/kn/vn scratch, so the VMEM estimate scales with the
-    window even though cache traffic still streams one block per tick."""
+    window even though cache traffic still streams one block per tick.
+    ``mesh`` makes the dispatch shard-aware exactly as in
+    ``fused_paged_decode_eligible``."""
     from ..ops.kv_quant import is_quantized_cache
 
     if n_slots < 1 or window < 1 or table_blocks < 1:
+        return False
+    if _mesh_shards_stack(mesh):
         return False
     wq8 = _stack_eligible(cfg, params, platform)
     if wq8 is None:

@@ -196,6 +196,55 @@ def shard_for_serving(params: Params, cfg: ModelConfig,
     return shard_params(params, specs, mesh), mesh
 
 
+def serving_head_axes(cfg: ModelConfig, mesh: Mesh):
+    """Mesh axes carrying the kv-head sharding under the serving
+    re-layout, or None when the pool must stay replicated.
+
+    Serving meshes join pp into tp (``serving_param_specs``), so the
+    head-sharding factor is the product of both axes' sizes.  MQA/GQA
+    pools whose kv-head count does not divide that factor replicate —
+    the same rule as ``kv_shard_axes`` for the K/V projections, derived
+    from the mesh instead of a ParallelConfig so the serving engine can
+    resolve it from the mesh it was handed."""
+    axes = tuple(a for a in (PP, TP)
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+    if not axes:
+        return None
+    factor = 1
+    for a in axes:
+        factor *= mesh.shape[a]
+    if cfg.kv_heads % factor != 0:
+        return None
+    return axes
+
+
+def kv_pool_specs(cfg: ModelConfig, mesh: Mesh) -> tuple:
+    """(k_spec, v_spec) PartitionSpec pytrees for the paged KV block pool
+    ``[L, n_blocks, kv_heads, block, d]`` (models/model.py:init_kv_pool).
+
+    Heads shard over the serving tp axes; the layer/block/row/depth dims
+    stay unsharded so block ids remain global integers — the slot block
+    tables are replicated host int32 and move verbatim.  For an int8
+    pool, the ``{"q", "scale"}`` leaves shard on the same kv-head axis
+    (scale is ``[L, n_blocks, kv_heads, block]``)."""
+    ax = serving_head_axes(cfg, mesh)
+    if cfg.kv_cache_quant == "int8":
+        spec = {"q": P(None, None, ax, None, None),
+                "scale": P(None, None, ax, None)}
+    else:
+        spec = P(None, None, ax, None, None)
+    return spec, spec
+
+
+def shard_kv_pool(k_pool, v_pool, cfg: ModelConfig, mesh: Mesh):
+    """Place a freshly-allocated block pool onto the serving mesh
+    according to :func:`kv_pool_specs`."""
+    k_spec, v_spec = kv_pool_specs(cfg, mesh)
+    put = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))  # noqa: E731
+    return (jax.tree.map(put, k_pool, k_spec),
+            jax.tree.map(put, v_pool, v_spec))
+
+
 def shard_params(params: Params, specs: Params, mesh: Mesh) -> Params:
     """Place a param pytree onto the mesh according to the spec tree."""
     return jax.tree.map(

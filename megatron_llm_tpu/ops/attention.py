@@ -106,7 +106,7 @@ def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
     """
     from jax.sharding import PartitionSpec as P
     from .kv_quant import is_quantized_cache
-    from ..parallel.mesh import PIPELINE_AXIS, TENSOR_AXIS
+    from ..parallel.mesh import TENSOR_AXIS
 
     if TENSOR_AXIS not in mesh.axis_names:
         return None
@@ -114,10 +114,6 @@ def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
         # already inside a manual-tp shard_map: shapes are per-shard and
         # the pallas_call sees local arrays — call straight through.
         return _kernel_decode(q, k_cache, v_cache, cache_len, softmax_scale)
-    combined = tuple(a for a in (PIPELINE_AXIS, TENSOR_AXIS)
-                     if a in mesh.axis_names
-                     and a not in getattr(mesh, "manual_axes", ())
-                     and mesh.shape[a] > 1)
     kv_q = is_quantized_cache(k_cache)
     n_heads = q.shape[2]
     kv_heads = (k_cache["q"] if kv_q else k_cache).shape[1]
@@ -126,16 +122,7 @@ def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
     # layers there, not heads) keeps its tp-only kernel path.  The
     # shard_map in_specs respec the operands, so either choice is
     # correct — this only picks the layout that avoids resharding.
-    axes = None
-    for cand in (combined, (TENSOR_AXIS,)):
-        if not cand:
-            continue
-        shards = 1
-        for a in cand:
-            shards *= mesh.shape[a]
-        if n_heads % shards == 0 and kv_heads % shards == 0:
-            axes = cand
-            break
+    axes = _head_shard_axes(mesh, n_heads, kv_heads)
     if axes is None:
         return None
 
@@ -153,6 +140,91 @@ def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
         check_vma=False,
     )
     return wrapped(q, k_cache, v_cache, jnp.asarray(cache_len, jnp.int32))
+
+
+def _head_shard_axes(mesh, n_heads: int, kv_heads: int):
+    """Mesh axes to shard decode heads over, or None.
+
+    Shared by the dense and paged sharded-kernel wrappers: prefer the
+    serving re-layout's combined (pp, tp) factor, fall back to tp alone
+    (training layout), give up when neither divides both head counts
+    (MQA keeps K/V replicated; the einsum path is already correct)."""
+    from ..parallel.mesh import PIPELINE_AXIS, TENSOR_AXIS
+
+    combined = tuple(a for a in (PIPELINE_AXIS, TENSOR_AXIS)
+                     if a in mesh.axis_names
+                     and a not in getattr(mesh, "manual_axes", ())
+                     and mesh.shape[a] > 1)
+    for cand in (combined, (TENSOR_AXIS,)):
+        if not cand or any(a not in mesh.axis_names for a in cand):
+            continue
+        shards = 1
+        for a in cand:
+            shards *= mesh.shape[a]
+        if n_heads % shards == 0 and kv_heads % shards == 0:
+            return cand
+    return None
+
+
+def _sharded_paged_flash_decode(q, k_pool, v_pool, tables, cache_len,
+                                softmax_scale, mesh):
+    """Run the PAGED Pallas decode kernel under an active mesh, or None.
+
+    The paged analogue of ``_sharded_flash_decode``: GSPMD cannot
+    partition the ``pallas_call`` over a kv-head-sharded pool, so the
+    kernel is wrapped in a ``shard_map`` manual over the head-sharding
+    axes.  Attention is embarrassingly parallel over kv heads, so each
+    shard walks its own head slice of every pool block; the int32 block
+    tables and fill levels are replicated (``P(None, None)`` /
+    ``P(None)``) — block ids stay global, no table translation — and an
+    int8 pool's ``{"q", "scale"}`` leaves move verbatim with the same
+    head-axis spec the pool was placed with
+    (models/sharding.py:kv_pool_specs).
+    """
+    from jax.sharding import PartitionSpec as P
+    from .kv_quant import is_quantized_cache
+    from ..parallel.mesh import TENSOR_AXIS
+
+    if TENSOR_AXIS not in mesh.axis_names:
+        return None
+    kv_q = is_quantized_cache(k_pool)
+
+    def _call(q_, kp, vp, tbl, ln):
+        if kv_q:
+            from ..kernels.flash_decode import flash_decode_paged_int8
+
+            return flash_decode_paged_int8(
+                q_[:, 0], kp["q"], kp["scale"], vp["q"], vp["scale"],
+                tbl, ln + 1, softmax_scale=softmax_scale)[:, None]
+        from ..kernels.flash_decode import flash_decode_paged
+
+        return flash_decode_paged(
+            q_[:, 0], kp, vp, tbl, ln + 1,
+            softmax_scale=softmax_scale)[:, None]
+
+    if TENSOR_AXIS in getattr(mesh, "manual_axes", ()):
+        # already inside a manual-tp shard_map: arrays are per-shard
+        return _call(q, k_pool, v_pool, tables,
+                     jnp.asarray(cache_len, jnp.int32))
+    n_heads = q.shape[2]
+    kv_heads = (k_pool["q"] if kv_q else k_pool).shape[1]
+    axes = _head_shard_axes(mesh, n_heads, kv_heads)
+    if axes is None:
+        return None
+    pool_spec = ({"q": P(None, axes, None, None), "scale": P(None, axes,
+                                                             None)}
+                 if kv_q else P(None, axes, None, None))
+    wrapped = jax.shard_map(
+        _call,
+        mesh=mesh,
+        in_specs=(P(None, None, axes, None), pool_spec, pool_spec,
+                  P(None, None), P()),
+        out_specs=P(None, None, axes, None),
+        axis_names=set(axes),
+        check_vma=False,
+    )
+    return wrapped(q, k_pool, v_pool, tables,
+                   jnp.asarray(cache_len, jnp.int32))
 
 
 def _warn_flash_fallback():
@@ -327,9 +399,18 @@ def paged_decode_attention(
     b, s, n_heads, d = q.shape
     _, kv_heads, block, _ = k_arr.shape
 
-    if paged_decode_kernel_eligible(s, d, block, _backend()) \
-            and not _mesh_active():
-        if kv_q:
+    if paged_decode_kernel_eligible(s, d, block, _backend()):
+        mesh = _active_mesh()
+        if mesh is not None:
+            # sharded pool: the kernel runs per-shard inside a shard_map
+            # manual over the head axes (replicated tables, head-sharded
+            # pool); head counts dividing nothing fall through to the
+            # gather path, which GSPMD partitions from the pool sharding
+            out = _sharded_paged_flash_decode(
+                q, k_pool, v_pool, tables, cache_len, softmax_scale, mesh)
+            if out is not None:
+                return out
+        elif kv_q:
             from ..kernels.flash_decode import flash_decode_paged_int8
 
             out = flash_decode_paged_int8(
@@ -338,13 +419,14 @@ def paged_decode_attention(
                 jnp.asarray(cache_len, jnp.int32) + 1,
                 softmax_scale=softmax_scale)
             return out[:, None]
-        from ..kernels.flash_decode import flash_decode_paged
+        else:
+            from ..kernels.flash_decode import flash_decode_paged
 
-        out = flash_decode_paged(
-            q[:, 0], k_pool, v_pool, tables,
-            jnp.asarray(cache_len, jnp.int32) + 1,
-            softmax_scale=softmax_scale)
-        return out[:, None]
+            out = flash_decode_paged(
+                q[:, 0], k_pool, v_pool, tables,
+                jnp.asarray(cache_len, jnp.int32) + 1,
+                softmax_scale=softmax_scale)
+            return out[:, None]
 
     # fallback: gather the dense per-row view and reuse decode_attention
     t = tables.shape[1]

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def is_quantized_cache(cache) -> bool:
@@ -44,7 +45,11 @@ def init_quantized_cache(shape: tuple) -> dict:
 # exactly-rounded op everywhere, while XLA lowers a constant *divide*
 # differently across fusion contexts (reciprocal-multiply rewrite), which
 # showed up as a 1-ulp scale split between the two paths.
-_RCP127 = float(jnp.float32(1.0) / jnp.float32(127.0))
+# numpy, not jnp: this module can be lazily imported from inside a jit
+# trace (models/model.py imports kernels.decode_step under jit), where a
+# module-level jnp op would be staged as a tracer; IEEE fp32 division is
+# exactly rounded, so the bits match the device computation either way.
+_RCP127 = float(np.float32(1.0) / np.float32(127.0))
 
 
 def quantize_rows(rows: jax.Array) -> dict:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -147,25 +148,64 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-_MESH_STACK: list[Mesh] = []
+class _MeshStack(threading.local):
+    """Per-thread mesh stack.  ``Mesh.__enter__`` is already thread-local
+    in jax; this stack must match, or two sharded serving engines whose
+    scheduler threads each sit inside their own ``use_mesh`` would read
+    each other's mesh through ``current_mesh()`` (the router runs one
+    engine thread per replica submesh)."""
+
+    def __init__(self):
+        self.stack: list[Mesh] = []
+
+
+_MESH_STACK = _MeshStack()
 
 
 @contextlib.contextmanager
 def use_mesh(mesh: Mesh):
     """Context manager establishing the active mesh (and jax's own
     ``jax.sharding.use_mesh`` scope when available)."""
-    _MESH_STACK.append(mesh)
+    _MESH_STACK.stack.append(mesh)
     try:
         with mesh:
             yield mesh
     finally:
-        _MESH_STACK.pop()
+        _MESH_STACK.stack.pop()
 
 
 def current_mesh() -> Optional[Mesh]:
-    if _MESH_STACK:
-        return _MESH_STACK[-1]
+    if _MESH_STACK.stack:
+        return _MESH_STACK.stack[-1]
     return None
+
+
+def replica_submeshes(parallel: ParallelConfig, replicas: int,
+                      devices: Optional[Sequence[jax.Device]] = None,
+                      ) -> list[Mesh]:
+    """Partition the device list into ``replicas`` disjoint submeshes of
+    ``parallel``'s per-replica geometry (serving: pp·tp devices each).
+
+    The replicated-router serving topology is dp-at-the-front: instead of
+    one mesh with a dp axis (which would make every dispatch a global
+    program over all replicas), each engine replica gets its own
+    independent mesh over a contiguous device slice, so replicas fail,
+    drain, and compile independently — the sharded-worker / replicated-
+    frontend split (serving/cluster/).
+    """
+    if devices is None:
+        devices = jax.devices()
+    per = (parallel.pipeline_parallel * parallel.tensor_parallel
+           * parallel.context_parallel * parallel.expert_parallel
+           * parallel.data_parallel)
+    if replicas < 1:
+        raise ValueError(f"replicas must be >= 1, got {replicas}")
+    if replicas * per > len(devices):
+        raise ValueError(
+            f"{replicas} replicas of {per} devices each need "
+            f"{replicas * per} devices, have {len(devices)}")
+    return [build_mesh(parallel, devices=devices[i * per:(i + 1) * per])
+            for i in range(replicas)]
 
 
 # ---------------------------------------------------------------------------

@@ -11,8 +11,14 @@
   Prometheus export (docs/observability.md).
 - ``bench.py`` — serving-throughput measurement (requests/s, token
   latency), consumed by the repo-level ``bench.py``.
+- ``cluster/`` — multi-chip serving: engines sharded over tp submeshes
+  (``cluster/sharded.py``) behind a replicated health-aware router with
+  drain-based failover (``cluster/router.py``); see docs/serving.md,
+  'Multi-chip serving'.
 """
 
+from .cluster import Router, RouterConfig, RouterHandle, build_cluster, \
+    build_sharded_engine
 from .engine import (
     EngineConfig,
     FinishedRequest,
@@ -26,6 +32,11 @@ from .slots import SlotAllocator
 
 __all__ = [
     "EngineConfig",
+    "Router",
+    "RouterConfig",
+    "RouterHandle",
+    "build_cluster",
+    "build_sharded_engine",
     "FinishedRequest",
     "LatencyHistogram",
     "PrefixCache",

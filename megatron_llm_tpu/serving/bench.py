@@ -529,6 +529,122 @@ def run_spec_serving_bench(cfg, params, *, num_requests: int = 12,
     }
 
 
+def run_cluster_serving_bench(cfg, params, *, num_requests: int = 16,
+                              gen_len: int = 32, slots: int = 4,
+                              max_prompt_len: int = 64, replicas: int = 2,
+                              tp: int = 2, seed: int = 0) -> dict:
+    """Multi-chip serving point (serving/cluster/, docs/serving.md
+    "Multi-chip serving"): the two claims the cluster subsystem makes.
+
+    - **QPS scaling** — the same mixed traffic wave through
+      ``build_cluster`` at 1 replica vs ``replicas`` replicas on
+      disjoint device slices.  ``serving_cluster_qps_ratio`` is the
+      headline the ``--compare`` gate watches (acceptance bar ≥ 1.8x at
+      2 replicas on real multi-chip hardware).  NOTE: under the CPU
+      device-count simulation every "device" shares the host's physical
+      cores, so the ratio is only meaningful on hardware where replicas
+      own disjoint compute — simulated runs record the plumbing cost,
+      not the scaling claim.
+    - **max model size** — per-device resident parameter bytes at tp=1
+      vs tp=``tp`` under the serving re-layout
+      (models/sharding.py:serving_param_specs).
+      ``serving_cluster_tp_model_size_ratio`` ≈ tp: a tp-times larger
+      model fits the same per-chip HBM.
+
+    Tokens are bitwise invariant to both knobs (tests/serving/
+    test_cluster.py), so all runs do identical per-request work.
+    """
+    import jax
+    import numpy as np
+
+    from ..config import ParallelConfig
+    from .cluster import build_cluster
+    from .cluster.sharded import build_sharded_engine
+    from .engine import EngineConfig
+
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(8, max_prompt_len + 1, num_requests)
+    prompts = [rng.integers(1, cfg.vocab_size, int(n)).tolist()
+               for n in lens]
+    ec = EngineConfig(
+        max_batch_size=slots,
+        max_seq_len=min(max_prompt_len + gen_len,
+                        cfg.max_position_embeddings),
+        max_queue_size=max(num_requests, slots),
+        prefill_bucket=max_prompt_len,
+    )
+
+    def one_run(n_replicas: int) -> dict:
+        router = build_cluster(cfg, params, ec, replicas=n_replicas,
+                               parallel=ParallelConfig()).start()
+        itl, make_stream = _itl_recorder()
+        try:
+            # warmup: one request per replica compiles every replica's
+            # executables outside the window (least-loaded dispatch
+            # spreads an idle-cluster burst one per replica)
+            warm = router.submit_many([
+                dict(prompt=prompts[0], max_new_tokens=2,
+                     use_eos_stop=False, seed=0)
+                for _ in range(n_replicas)])
+            for h in warm:
+                h.result(timeout=600)
+
+            t0 = time.perf_counter()
+            handles = router.submit_many([
+                dict(prompt=p, max_new_tokens=gen_len, use_eos_stop=False,
+                     seed=i, on_token=make_stream())
+                for i, p in enumerate(prompts)])
+            results = [h.result(timeout=600) for h in handles]
+            dt = time.perf_counter() - t0
+        finally:
+            router.shutdown()
+        n_tokens = sum(len(r.tokens) - r.prompt_len for r in results)
+        return {
+            "qps": round(num_requests / dt, 3),
+            "tokens_per_sec": round(n_tokens / dt, 1),
+            "itl_ms_p50": round(itl.percentile(50) * 1e3, 3),
+        }
+
+    def per_device_param_bytes(tp_ways: int) -> int:
+        if tp_ways == 1:
+            return sum(np.asarray(l).nbytes for l in jax.tree.leaves(params))
+        eng = build_sharded_engine(
+            cfg, params,
+            EngineConfig(max_batch_size=slots, max_seq_len=ec.max_seq_len),
+            parallel=ParallelConfig(tensor_parallel=tp_ways),
+            devices=jax.devices()[:tp_ways])
+        total = 0
+        for leaf in jax.tree.leaves(eng.params):
+            total += leaf.addressable_shards[0].data.nbytes
+        return total
+
+    single = one_run(1)
+    multi = one_run(replicas)
+    tp1_bytes = per_device_param_bytes(1)
+    tpn_bytes = per_device_param_bytes(tp)
+    return {
+        "serving_cluster_qps_1r": single["qps"],
+        f"serving_cluster_qps_{replicas}r": multi["qps"],
+        "serving_cluster_qps_ratio": round(
+            multi["qps"] / max(1e-9, single["qps"]), 3),
+        "serving_cluster_tokens_per_sec_1r": single["tokens_per_sec"],
+        f"serving_cluster_tokens_per_sec_{replicas}r":
+            multi["tokens_per_sec"],
+        "serving_cluster_itl_ms_p50_1r": single["itl_ms_p50"],
+        f"serving_cluster_itl_ms_p50_{replicas}r": multi["itl_ms_p50"],
+        "serving_cluster_tp1_param_bytes_per_device": tp1_bytes,
+        f"serving_cluster_tp{tp}_param_bytes_per_device": tpn_bytes,
+        "serving_cluster_tp_model_size_ratio": round(
+            tp1_bytes / max(1, tpn_bytes), 3),
+        "serving_cluster_replicas": replicas,
+        "serving_cluster_tp": tp,
+        "serving_cluster_num_requests": num_requests,
+        "serving_cluster_slots": slots,
+        "serving_cluster_max_prompt_len": max_prompt_len,
+        "serving_cluster_gen_len": gen_len,
+    }
+
+
 def main() -> None:
     """Smoke run on the tiny test config (CPU-safe)."""
     import json
@@ -556,6 +672,11 @@ def main() -> None:
     out.update(run_spec_serving_bench(cfg, params, num_requests=6,
                                       prompt_len=32, gen_len=16,
                                       slots=2, draft_len=3))
+    if len(jax.devices()) >= 2:
+        out.update(run_cluster_serving_bench(cfg, params, num_requests=6,
+                                             gen_len=8, slots=2,
+                                             max_prompt_len=32,
+                                             replicas=2, tp=2))
     print(json.dumps(out))
 
 

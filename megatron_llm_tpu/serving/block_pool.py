@@ -91,6 +91,18 @@ class BlockPool:
                       else _copy_block_donated)
         self.cow_copies = 0
 
+    def place(self, mesh) -> None:
+        """Re-place the pool arrays onto a serving submesh, kv heads
+        sharded over the tp axes (models/sharding.py:kv_pool_specs).
+
+        Called once by the sharded engine before any block is written:
+        the host-side ledger (block ids, free list, refs) is sharding-
+        agnostic — block ids stay global integers on every shard."""
+        from ..models import sharding as shard_lib
+
+        self.k_pool, self.v_pool = shard_lib.shard_kv_pool(
+            self.k_pool, self.v_pool, self.cfg, mesh)
+
     # ------------------------------------------------------------------
     # capacity / reservations
     # ------------------------------------------------------------------

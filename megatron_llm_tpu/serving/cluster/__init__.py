@@ -1,0 +1,28 @@
+"""Multi-chip serving: sharded engines over tp submeshes behind a
+replicated router.
+
+Two independent layers (the sharded-worker / replicated-frontend split):
+
+* ``sharded.build_sharded_engine`` — one ``ServingEngine`` over a
+  pp·tp submesh: params in the serving re-layout
+  (models/sharding.py:serving_param_specs), the paged block pool
+  head-sharded (kv_pool_specs), block tables replicated, dispatches
+  under ``use_mesh`` on the scheduler thread.
+* ``router.Router`` — least-loaded, health-aware dispatch over
+  dp-replicated engines with sticky streams and drain/kill failover
+  that resubmits not-yet-finished requests deterministically.
+
+``sharded.build_cluster`` composes the two: N replicas on disjoint
+device slices (parallel/mesh.py:replica_submeshes) behind one Router.
+"""
+
+from .router import Router, RouterConfig, RouterHandle
+from .sharded import build_cluster, build_sharded_engine
+
+__all__ = [
+    "Router",
+    "RouterConfig",
+    "RouterHandle",
+    "build_cluster",
+    "build_sharded_engine",
+]

@@ -1,0 +1,573 @@
+"""Replicated-engine router: health-aware dispatch + drain/kill failover.
+
+The front-end half of the multi-chip serving split (the back half is
+``sharded.py``).  The router owns N independent ``ServingEngine``
+replicas and presents the engine's own submission surface
+(``submit_many`` → handles with ``result()/cancel()/rid``), so
+``generation/server.py`` serves through a Router exactly as it serves
+through one engine.
+
+Dispatch is least-loaded over per-replica ``ServingMetrics``/
+``SLOTracker`` snapshots: replicas whose SLO burn says unhealthy are
+deprioritized (not excluded — a degraded replica beats a dropped
+request), draining/dead replicas are excluded, and ties break on
+(queue depth + active slots, -free blocks).  Streamed requests are
+sticky by construction — a request is dispatched to one replica and its
+tokens stream from there — and an optional ``sticky_key`` spec field
+pins related requests (e.g. one conversation hitting the same replica's
+prefix cache) together while it stays usable.
+
+Failover reuses the engine's own machinery:
+
+* ``drain_replica`` pulls not-yet-started requests straight out of the
+  replica's queue (``RequestQueue.remove`` — atomic, so the scheduler
+  either owns a request or the router does, never both), resubmits them
+  elsewhere, then runs ``engine.drain`` so in-flight streams finish in
+  place.
+* A replica whose scheduler died (``result()`` raises / health probe
+  sees the thread gone) gets every unfinished request resubmitted.
+  Requests are resubmitted with their original resolved seed, so the
+  per-request RNG stream — independent of slot placement and batch
+  composition by design — replays the identical trajectory; tokens the
+  client already received are suppressed by count, making the client-
+  visible stream bitwise-equal to an uninterrupted run.
+
+Every router lock comes from ``analysis.sanitizers.make_lock`` so the
+lock-order cycle detector covers the router ↔ engine interleavings, and
+every hop is correlated by the engine-assigned ``request_id`` in both
+EVENT_LOG lines (``routed`` / ``replica_draining`` /
+``replica_drained`` / ``replica_dead`` / ``resubmitted``) and router
+trace spans (``route`` / ``failover`` / ``drain``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+from ...analysis import sanitizers
+from ...obs import REGISTRY
+from ...obs.logging import EVENT_LOG
+from ...obs.registry import MetricFamily
+from ...obs.trace import TraceRecorder
+from ..engine import FinishedRequest, RequestHandle, ServingEngine
+from ..queue import QueueFull
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    probe_interval_s: float = 0.05   # health probe + completion sweep
+    max_resubmits: int = 2           # per-request failover budget
+    slo_max_burn: float = 2.0        # healthy() threshold for dispatch
+    sticky: bool = True              # honor spec["sticky_key"]
+    drain_timeout_s: float = 30.0    # per-replica engine.drain bound
+    trace: bool = True
+    trace_capacity: int = 4096
+
+
+class Replica:
+    """One engine instance + the router's view of its health."""
+
+    def __init__(self, rid: str, engine: ServingEngine):
+        self.id = rid
+        self.engine = engine
+        self.draining = False
+        self.dead = False
+        self.dispatched = 0
+        self.completed = 0
+
+    def alive(self) -> bool:
+        e = self.engine
+        if self.dead or e._scheduler_error is not None:
+            return False
+        t = e._thread
+        return not (e._started.is_set() and (t is None or not t.is_alive()))
+
+    def load(self) -> tuple:
+        """(queue_depth + active, -blocks_free) — lower is less loaded."""
+        e = self.engine
+        active = e.slots.active_slots if e.slots is not None else 0
+        free = (e.slots.pool.free_blocks if e.slots is not None
+                else 1 << 30)
+        return (len(e.queue) + active, -free)
+
+    def healthy(self, max_burn: float) -> bool:
+        return self.alive() and self.engine.metrics.slo.healthy(max_burn)
+
+    def probe(self, max_burn: float) -> dict:
+        e = self.engine
+        s = (e.slots.pool.stats() if e.slots is not None
+             else {"blocks_free": None, "blocks_used": None})
+        return {
+            "id": self.id,
+            "alive": self.alive(),
+            "healthy": self.healthy(max_burn),
+            "draining": self.draining,
+            "queue_depth": len(e.queue),
+            "slots_active": (e.slots.active_slots
+                             if e.slots is not None else 0),
+            "blocks_free": s["blocks_free"],
+            "dispatched": self.dispatched,
+            "completed": self.completed,
+            "slo": e.metrics.slo.snapshot(),
+        }
+
+
+class _Routed:
+    """Router-side request record: survives replica failover."""
+
+    __slots__ = ("spec", "user_on_token", "sticky_key", "handle",
+                 "replica", "delivered", "skip", "resubmits", "final",
+                 "done_event", "failed")
+
+    def __init__(self, spec: dict, user_on_token, sticky_key,
+                 handle: RequestHandle, replica: Replica):
+        self.spec = spec                  # seed resolved; no on_token
+        self.user_on_token = user_on_token
+        self.sticky_key = sticky_key
+        self.handle = handle              # current engine handle
+        self.replica = replica
+        self.delivered = 0                # tokens the client has seen
+        self.skip = 0                     # replayed tokens to suppress
+        self.resubmits = 0
+        self.final: Optional[FinishedRequest] = None
+        self.failed: Optional[str] = None
+        self.done_event = threading.Event()
+
+
+class RouterHandle:
+    """Client-side view of a routed request; same surface as
+    ``RequestHandle`` plus failover transparency."""
+
+    def __init__(self, router: "Router", rr: _Routed):
+        self._router = router
+        self._rr = rr
+
+    @property
+    def rid(self) -> str:
+        """Engine correlation id of the CURRENT attempt (changes on
+        failover; each EVENT_LOG ``resubmitted`` line links old → new)."""
+        return self._rr.handle.rid
+
+    @property
+    def request_id(self) -> int:
+        return self._rr.handle.request_id
+
+    def done(self) -> bool:
+        return self._rr.done_event.is_set()
+
+    def cancel(self) -> None:
+        self._rr.handle.cancel()
+
+    def result(self, timeout: Optional[float] = None) -> FinishedRequest:
+        deadline = (None if timeout is None
+                    else time.perf_counter() + float(timeout))
+        rr = self._rr
+        while True:
+            if rr.done_event.is_set():
+                if rr.final is not None:
+                    return rr.final
+                raise RuntimeError(
+                    f"request failed after {rr.resubmits} resubmits: "
+                    f"{rr.failed}")
+            h = rr.handle
+            remaining = (None if deadline is None
+                         else deadline - time.perf_counter())
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError(
+                    f"routed request {h.rid} not finished within "
+                    f"{timeout}s")
+            wait = 0.1 if remaining is None else min(0.1, remaining)
+            # wait on the engine-level completion of the current attempt;
+            # the short timeout re-reads rr.handle after a failover swap
+            if h._req.done_event.wait(wait):
+                self._router._settle(rr)
+
+
+class Router:
+    """Least-loaded, health-aware front end over engine replicas."""
+
+    def __init__(self, engines: Sequence[ServingEngine],
+                 config: Optional[RouterConfig] = None):
+        if not engines:
+            raise ValueError("Router needs at least one engine replica")
+        self.config = config or RouterConfig()
+        self.replicas: List[Replica] = [
+            Replica(f"replica-{i}", e) for i, e in enumerate(engines)]
+        self.trace = TraceRecorder(capacity=self.config.trace_capacity,
+                                   enabled=self.config.trace)
+        self._lock = sanitizers.make_lock("router.state")
+        self._pending: dict[int, _Routed] = {}  # id(rr) -> rr
+        self._sticky: dict[str, str] = {}       # sticky_key -> replica id
+        self._draining = False
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self.routed_total = 0
+        self.resubmitted_total = 0
+        self.failovers_total = 0
+        self.completed_total = 0
+        self.metrics = _RouterMetrics(self)
+        REGISTRY.register_collector("cluster", self.metrics.collect)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Router":
+        with self._lock:
+            if self._probe_thread is None:
+                for r in self.replicas:
+                    r.engine.start()
+                self._probe_thread = threading.Thread(
+                    target=self._probe_loop, name="cluster-router",
+                    daemon=True)
+                self._probe_thread.start()
+        return self
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        with self._lock:
+            t, self._probe_thread = self._probe_thread, None
+        if t is not None:
+            t.join(timeout)
+        for r in self.replicas:
+            r.engine.shutdown(timeout)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Server-wide graceful drain: stop accepting, drain every
+        replica in place (no resubmission — there is nowhere to go)."""
+        self._draining = True
+        ok = True
+        for r in self.replicas:
+            r.draining = True
+            ok = r.engine.drain(timeout) and ok
+        return ok
+
+    # -- submission (any thread) ------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, **kw) -> RouterHandle:
+        return self.submit_many([dict(prompt=prompt,
+                                      max_new_tokens=max_new_tokens,
+                                      **kw)])[0]
+
+    def submit_many(self, specs: Sequence[dict]) -> List[RouterHandle]:
+        """Route each spec to the least-loaded usable replica.
+
+        Mirrors the engine contract (``ValueError`` for never-fits,
+        ``QueueFull`` under backpressure); on a mid-batch failure the
+        already-routed prefix is cancelled so the batch stays
+        all-or-nothing from the caller's view."""
+        self.start()
+        if self._draining:
+            raise QueueFull("router is draining; not accepting requests")
+        handles: List[RouterHandle] = []
+        try:
+            for spec in specs:
+                handles.append(self._route_one(dict(spec)))
+        except Exception:
+            for h in handles:
+                h.cancel()
+            raise
+        return handles
+
+    def _route_one(self, spec: dict) -> RouterHandle:
+        # resolve the seed NOW: a resubmitted request must replay the
+        # same per-request RNG stream to be bitwise-identical
+        if spec.get("seed") is None:
+            spec["seed"] = int.from_bytes(os.urandom(4), "little")
+        sticky_key = spec.pop("sticky_key", None)
+        user_on_token = spec.pop("on_token", None)
+        t0 = time.perf_counter()
+        with self._lock:
+            replica = self._pick(sticky_key)
+            if replica is None:
+                raise QueueFull("no usable replica (all draining/dead)")
+            rr = _Routed(spec, user_on_token, sticky_key, None, replica)
+            espec = dict(spec, on_token=_stream(rr))
+            [handle] = replica.engine.submit_many([espec])
+            rr.handle = handle
+            self._pending[id(rr)] = rr
+            replica.dispatched += 1
+            self.routed_total += 1
+            if sticky_key is not None and self.config.sticky:
+                self._sticky[sticky_key] = replica.id
+            qd = len(replica.engine.queue)
+        self.trace.add("route", t0, time.perf_counter(),
+                       request_id=handle.rid,
+                       args={"replica": replica.id, "queue_depth": qd})
+        EVENT_LOG.emit("router", "routed", request_id=handle.rid,
+                       replica=replica.id, queue_depth=qd)
+        return RouterHandle(self, rr)
+
+    def _pick(self, sticky_key: Optional[str]) -> Optional[Replica]:
+        """Least-loaded usable replica (router lock held)."""
+        usable = [r for r in self.replicas
+                  if not r.draining and r.alive()]
+        if not usable:
+            return None
+        if sticky_key is not None and self.config.sticky:
+            rid = self._sticky.get(sticky_key)
+            for r in usable:
+                if r.id == rid:
+                    return r
+        burn = self.config.slo_max_burn
+        return min(usable,
+                   key=lambda r: (not r.healthy(burn),) + r.load())
+
+    # -- completion / failover --------------------------------------------
+
+    def _settle(self, rr: _Routed) -> None:
+        """The request's current engine attempt finished: complete it or
+        fail it over.  Idempotent; callable from any thread."""
+        with self._lock:
+            if rr.done_event.is_set():
+                return
+            h = rr.handle
+            if not h._req.done_event.is_set():
+                return
+            res = h._req.result
+            if res is not None and res.finish_reason != "error":
+                self._complete(rr, res)
+                return
+            self._failover(rr, f"scheduler error on {rr.replica.id}")
+
+    def _complete(self, rr: _Routed, res: FinishedRequest) -> None:
+        rr.final = res
+        rr.replica.completed += 1
+        self.completed_total += 1
+        self._pending.pop(id(rr), None)
+        rr.done_event.set()
+
+    def _fail(self, rr: _Routed, why: str) -> None:
+        rr.failed = why
+        self._pending.pop(id(rr), None)
+        rr.done_event.set()
+
+    def _failover(self, rr: _Routed, why: str) -> None:
+        """Resubmit ``rr`` to another replica (router lock held)."""
+        if rr.done_event.is_set():
+            return
+        old_rid = rr.handle.rid
+        old_replica = rr.replica.id
+        if rr.resubmits >= self.config.max_resubmits:
+            self._fail(rr, f"{why}; resubmit budget exhausted")
+            return
+        target = self._pick(None)
+        if target is None or target.id == old_replica:
+            target = next((r for r in self.replicas
+                           if r.id != old_replica and not r.draining
+                           and r.alive()), target)
+        if target is None:
+            self._fail(rr, f"{why}; no usable replica left")
+            return
+        rr.resubmits += 1
+        self.failovers_total += 1
+        # replay suppression: tokens the client already received stream
+        # again (same seed → same trajectory) and are dropped by count
+        rr.skip = rr.delivered
+        t0 = time.perf_counter()
+        espec = dict(rr.spec, on_token=_stream(rr))
+        try:
+            [handle] = target.engine.submit_many([espec])
+        except Exception as e:  # noqa: BLE001 — target refused (full/
+            self._fail(rr, f"{why}; resubmit refused: {e!r}")  # draining)
+            return
+        rr.handle = handle
+        rr.replica = target
+        # tpulint: allow[lock-discipline] every _failover call site
+        # (_settle, drain_replica, kill_replica, _settle_dead) holds
+        # self._lock; the contract is in the docstring above
+        self._pending[id(rr)] = rr
+        target.dispatched += 1
+        self.resubmitted_total += 1
+        if rr.sticky_key is not None and self.config.sticky:
+            # tpulint: allow[lock-discipline] same: router lock held by
+            # the caller per the _failover contract
+            self._sticky[rr.sticky_key] = target.id
+        self.trace.add("failover", t0, time.perf_counter(),
+                       request_id=handle.rid,
+                       args={"from": old_replica, "to": target.id,
+                             "prev_rid": old_rid,
+                             "replayed": rr.skip})
+        EVENT_LOG.emit("router", "resubmitted", request_id=handle.rid,
+                       prev_request_id=old_rid, from_replica=old_replica,
+                       to_replica=target.id, replayed_tokens=rr.skip)
+
+    # -- replica-level operations -----------------------------------------
+
+    def drain_replica(self, replica_id: str,
+                      timeout: Optional[float] = None, *,
+                      wait: bool = True) -> bool:
+        """Drain one replica: queued (not-yet-started) requests move to
+        other replicas immediately; in-flight streams finish in place."""
+        r = self._replica(replica_id)
+        t0 = time.perf_counter()
+        with self._lock:
+            r.draining = True
+            moved = []
+            for rr in list(self._pending.values()):
+                if rr.replica is r and r.engine.queue.remove(rr.handle._req):
+                    moved.append(rr)  # atomically ours: engine never saw it
+            for rr in moved:
+                self._failover(rr, f"{r.id} draining")
+        EVENT_LOG.emit("router", "replica_draining", replica=r.id,
+                       resubmitted=len(moved))
+        timeout = (self.config.drain_timeout_s
+                   if timeout is None else timeout)
+
+        def _finish_drain() -> bool:
+            ok = r.engine.drain(timeout)
+            self.trace.add("drain", t0, time.perf_counter(),
+                           args={"replica": r.id, "ok": ok,
+                                 "resubmitted": len(moved)})
+            EVENT_LOG.emit("router", "replica_drained", replica=r.id,
+                           ok=ok, resubmitted=len(moved))
+            return ok
+
+        if wait:
+            return _finish_drain()
+        threading.Thread(target=_finish_drain, name=f"drain-{r.id}",
+                         daemon=True).start()
+        return True
+
+    def kill_replica(self, replica_id: str, timeout: float = 10.0) -> int:
+        """Hard-kill a replica (crash simulation / test hook): shut its
+        engine down and fail over every unfinished request it held.
+        Returns the number of resubmitted requests."""
+        r = self._replica(replica_id)
+        with self._lock:
+            r.dead = True
+        r.engine.shutdown(timeout)  # joins the scheduler: no more
+        #                             callbacks race the resubmission
+        EVENT_LOG.emit("router", "replica_dead", replica=r.id)
+        with self._lock:
+            orphans = [rr for rr in self._pending.values()
+                       if rr.replica is r and not rr.done_event.is_set()]
+            for rr in orphans:
+                self._failover(rr, f"{r.id} killed")
+        return len(orphans)
+
+    def _replica(self, replica_id: str) -> Replica:
+        for r in self.replicas:
+            if r.id == replica_id:
+                return r
+        raise KeyError(f"unknown replica {replica_id!r}")
+
+    # -- health probe thread ----------------------------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.config.probe_interval_s):
+            for r in self.replicas:
+                if not r.dead and not r.alive():
+                    with self._lock:
+                        r.dead = True
+                    EVENT_LOG.emit("router", "replica_dead", replica=r.id)
+                    with self._lock:
+                        for rr in list(self._pending.values()):
+                            if rr.replica is r:
+                                self._settle_dead(rr)
+            # completion sweep: requests finish even when nobody is
+            # blocked in result() (fire-and-forget streaming clients)
+            for rr in list(self._pending.values()):
+                if rr.handle._req.done_event.is_set():
+                    self._settle(rr)
+
+    def _settle_dead(self, rr: _Routed) -> None:
+        """Dead-replica sweep (router lock held): engine-finished
+        requests settle normally, the rest fail over."""
+        if rr.done_event.is_set():
+            return
+        res = rr.handle._req.result
+        if rr.handle._req.done_event.is_set() and res is not None \
+                and res.finish_reason != "error":
+            self._complete(rr, res)
+        else:
+            self._failover(rr, f"{rr.replica.id} dead")
+
+    # -- introspection (any thread; GET /cluster) --------------------------
+
+    def snapshot(self) -> dict:
+        burn = self.config.slo_max_burn
+        return {
+            "router": {
+                "replicas": len(self.replicas),
+                "usable": sum(1 for r in self.replicas
+                              if not r.draining and r.alive()),
+                "draining": self._draining,
+                "routed_total": self.routed_total,
+                "resubmitted_total": self.resubmitted_total,
+                "failovers_total": self.failovers_total,
+                "completed_total": self.completed_total,
+                "pending": len(self._pending),
+                "sticky_keys": len(self._sticky),
+            },
+            "replicas": [r.probe(burn) for r in self.replicas],
+        }
+
+    def kv_snapshot(self) -> dict:
+        return {r.id: r.engine.kv_snapshot() for r in self.replicas}
+
+
+def _stream(rr: _Routed) -> Callable[[int], None]:
+    """Per-attempt on_token wrapper: drops the replayed prefix after a
+    failover, forwards the rest to the client callback."""
+
+    def on_token(tok: int) -> None:
+        if rr.skip > 0:
+            rr.skip -= 1
+            return
+        rr.delivered += 1
+        if rr.user_on_token is not None:
+            rr.user_on_token(tok)
+
+    return on_token
+
+
+class _RouterMetrics:
+    """Engine-metrics-shaped facade: ``snapshot()`` for the JSON
+    /metrics route, ``collect()`` registered as the ``"cluster"``
+    collector for Prometheus exposition."""
+
+    def __init__(self, router: Router):
+        self._router = router
+
+    @property
+    def slo(self):
+        # healthiest replica's tracker: the server-level availability
+        # question is "can SOMEONE serve", not "is everyone pristine"
+        return self._router.replicas[0].engine.metrics.slo
+
+    def snapshot(self) -> dict:
+        r = self._router
+        out = r.snapshot()
+        out["per_replica"] = {
+            rep.id: rep.engine.metrics.snapshot() for rep in r.replicas}
+        return out
+
+    def collect(self) -> List[MetricFamily]:
+        r = self._router
+        fams = [
+            MetricFamily("cluster_replicas", "gauge",
+                         "engine replicas behind the router"
+                         ).add(len(r.replicas)),
+            MetricFamily("cluster_replicas_usable", "gauge",
+                         "replicas accepting dispatch"
+                         ).add(sum(1 for x in r.replicas
+                                   if not x.draining and x.alive())),
+            MetricFamily("cluster_routed_total", "counter",
+                         "requests dispatched").add(r.routed_total),
+            MetricFamily("cluster_resubmitted_total", "counter",
+                         "requests moved by failover"
+                         ).add(r.resubmitted_total),
+            MetricFamily("cluster_failovers_total", "counter",
+                         "failover decisions").add(r.failovers_total),
+            MetricFamily("cluster_completed_total", "counter",
+                         "requests completed").add(r.completed_total),
+        ]
+        qd = MetricFamily("cluster_replica_queue_depth", "gauge",
+                          "per-replica queue depth")
+        for rep in r.replicas:
+            qd.add(len(rep.engine.queue), labels={"replica": rep.id})
+        fams.append(qd)
+        return fams

@@ -1,0 +1,99 @@
+"""Sharded serving engines over tp(/pp) submeshes.
+
+One engine instance = one submesh.  The existing partition rules do all
+the layout work: params re-shard with ``serving_param_specs`` (pp joins
+tp, weights resident, int8 ``{"q", "scale"}`` subtrees via
+``quantize_specs``), the paged block pool shards its kv-head axis
+(``kv_pool_specs``), and the slot block tables stay replicated host
+int32 — block ids are global on every shard, so the engine's entire
+ledger (free list, refs, reservations, prefix trie) is untouched.
+
+At tp=1 this builds the plain single-chip engine — same executable,
+bitwise-identical tokens — so the cluster path has no single-chip tax.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from ...config import ModelConfig, ParallelConfig
+from ..engine import EngineConfig, ServingEngine
+from ..metrics import ServingMetrics
+
+
+def build_sharded_engine(cfg: ModelConfig, params,
+                         engine_config: Optional[EngineConfig] = None,
+                         parallel: Optional[ParallelConfig] = None,
+                         devices: Optional[Sequence[jax.Device]] = None,
+                         metrics: Optional[ServingMetrics] = None,
+                         ) -> ServingEngine:
+    """One engine over one submesh.
+
+    ``devices`` is the submesh's device slice (defaults to the first
+    pp·tp of ``jax.devices()``); ``params`` are re-laid-out onto it with
+    the serving re-layout.  With pp·tp == 1 and no explicit devices this
+    returns the ordinary single-chip engine (mesh=None) so the fused
+    single-device kernels stay eligible.
+    """
+    from ...models import sharding as shard_lib
+    from ...parallel import mesh as mesh_lib
+
+    parallel = parallel or ParallelConfig()
+    tp_eff = parallel.pipeline_parallel * parallel.tensor_parallel
+    if tp_eff == 1 and devices is None:
+        return ServingEngine(cfg, params, engine_config, metrics=metrics)
+    assert cfg.num_attention_heads % tp_eff == 0, (
+        f"serving re-layout shards heads over pp·tp = {tp_eff}, which "
+        f"must divide num_attention_heads = {cfg.num_attention_heads}")
+    mesh = mesh_lib.build_mesh(parallel, devices=devices)
+    specs = shard_lib.serving_param_specs(cfg, parallel)
+    from ...ops import quant
+
+    if any(quant.is_quantized(w)
+           for w in jax.tree.leaves(params, is_leaf=quant.is_quantized)
+           if isinstance(w, dict)):
+        specs = quant.quantize_specs(specs)
+    sharded = shard_lib.shard_params(params, specs, mesh)
+    return ServingEngine(cfg, sharded, engine_config, metrics=metrics,
+                         mesh=mesh)
+
+
+def build_cluster(cfg: ModelConfig, params,
+                  engine_config: Optional[EngineConfig] = None,
+                  *, replicas: int = 1,
+                  parallel: Optional[ParallelConfig] = None,
+                  router_config=None,
+                  devices: Optional[Sequence[jax.Device]] = None):
+    """N sharded engine replicas on disjoint device slices behind one
+    :class:`~..cluster.router.Router`.
+
+    Replica metrics are constructed with ``register=False`` so they
+    don't fight over the process-wide ``"serving"`` collector; the
+    router registers one ``"cluster"`` collector aggregating them.
+    """
+    from ...parallel import mesh as mesh_lib
+    from .router import Router, RouterConfig
+
+    parallel = parallel or ParallelConfig()
+    engine_config = engine_config or EngineConfig()
+    tp_eff = parallel.pipeline_parallel * parallel.tensor_parallel
+    if devices is None:
+        devices = jax.devices()
+    engines = []
+    if replicas == 1 and tp_eff == 1:
+        engines.append(ServingEngine(
+            cfg, params, engine_config,
+            metrics=ServingMetrics(engine_config.max_batch_size,
+                                   register=False)))
+    else:
+        meshes = mesh_lib.replica_submeshes(parallel, replicas,
+                                            devices=devices)
+        for mesh in meshes:
+            engines.append(build_sharded_engine(
+                cfg, params, engine_config, parallel,
+                devices=mesh.devices.flatten().tolist(),
+                metrics=ServingMetrics(engine_config.max_batch_size,
+                                       register=False)))
+    return Router(engines, router_config or RouterConfig())

@@ -617,9 +617,17 @@ class ServingEngine:
 
     def __init__(self, cfg: ModelConfig, params,
                  engine_config: Optional[EngineConfig] = None,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 mesh=None):
         self.cfg = cfg
         self.params = params
+        # Serving submesh (serving/cluster/): params arrive pre-sharded
+        # (models/sharding.py:shard_for_serving layout), the paged pool
+        # is placed head-sharded at start(), and the scheduler thread
+        # runs its dispatches inside ``use_mesh(mesh)`` so sharding
+        # constraints and the shard-aware kernel dispatch resolve.  None
+        # = the unchanged single-chip engine.
+        self.mesh = mesh
         self.config = engine_config or EngineConfig()
         assert self.config.max_seq_len <= cfg.max_position_embeddings, (
             f"max_seq_len {self.config.max_seq_len} exceeds the model's "
@@ -696,6 +704,8 @@ class ServingEngine:
                 pool = BlockPool(
                     self.cfg, n_blocks, bk,
                     on_cow=lambda: self.metrics.inc("cow_copies_total"))
+                if self.mesh is not None:
+                    pool.place(self.mesh)
                 self.slots = SlotAllocator(self.cfg,
                                            cfg_e.max_batch_size,
                                            cfg_e.max_seq_len, pool)
@@ -709,14 +719,15 @@ class ServingEngine:
                 self._fused_decode = fused_paged_decode_eligible(
                     self.cfg, self.params, pool.k_pool,
                     cfg_e.max_batch_size, self.slots.table_blocks,
-                    jax.default_backend())
+                    jax.default_backend(), mesh=self.mesh)
                 if cfg_e.spec_draft_len > 0:
                     from ..kernels.decode_step import (
                         fused_paged_verify_eligible)
                     self._fused_verify = fused_paged_verify_eligible(
                         self.cfg, self.params, pool.k_pool,
                         cfg_e.max_batch_size, cfg_e.spec_draft_len + 1,
-                        self.slots.table_blocks, jax.default_backend())
+                        self.slots.table_blocks, jax.default_backend(),
+                        mesh=self.mesh)
                 self._update_pool_gauges()
                 if self._sanitize:
                     self._sanitizer = sanitizers.LedgerSanitizer()
@@ -868,6 +879,17 @@ class ServingEngine:
     # -- scheduler loop (engine thread only) -------------------------------
 
     def _loop(self) -> None:
+        if self.mesh is not None:
+            # the scheduler thread owns all device dispatch; entering the
+            # submesh here covers every jitted step (mesh contexts are
+            # thread-local, so concurrent replicas don't interleave)
+            from ..parallel import mesh as mesh_lib
+
+            with mesh_lib.use_mesh(self.mesh):
+                return self._loop_body()
+        return self._loop_body()
+
+    def _loop_body(self) -> None:
         try:
             while not self._stop.is_set():
                 # Cancellations and deadline expiry run even while paused:

@@ -25,6 +25,12 @@ def _start_metrics_logger(service, interval_s: float):
         while True:
             time.sleep(interval_s)
             snap = service.metrics_snapshot()
+            if "router" in snap:
+                # cluster mode: the router-shaped snapshot (GET /cluster
+                # has the full per-replica view)
+                print(json.dumps({"cluster_metrics": snap["router"]}),
+                      flush=True)
+                continue
             print(json.dumps({"serving_metrics": {
                 "completed": snap["completed"],
                 "running": snap["running"],
@@ -172,6 +178,18 @@ def main(argv=None) -> int:
                          "JOINS tp (models/sharding.py:serving_param_specs) "
                          "so a tp×pp training topology serves at tp·pp-way "
                          "tensor parallelism with weights resident")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="engine replicas on disjoint pp·tp device slices "
+                         "behind the health-aware cluster router "
+                         "(serving/cluster/; docs/serving.md 'Multi-chip "
+                         "serving'): least-loaded dispatch, sticky streams, "
+                         "drain-based failover.  Needs replicas x tp x pp "
+                         "<= visible devices")
+    ap.add_argument("--router", action="store_true",
+                    help="route through the cluster router even with a "
+                         "single replica (uniform ops surface: GET "
+                         "/cluster, per-replica drain); implied by "
+                         "--replicas > 1")
     args = ap.parse_args(argv)
 
     from ..checkpointing import load_params_for_inference
@@ -199,8 +217,17 @@ def main(argv=None) -> int:
         params = quantize_params(params)
         print("weights quantized to int8 (per-output-channel)")
 
+    cluster = args.replicas > 1 or args.router
     mesh_ctx = None
-    if args.tp > 1 or args.pp > 1:
+    if cluster:
+        # cluster mode: each replica engine shards its own params onto
+        # its submesh (serving/cluster/sharded.py) and runs under that
+        # mesh on its scheduler thread — no ambient process-wide mesh
+        print(f"cluster: {args.replicas} replica(s) x "
+              f"{args.tp * args.pp}-way tensor sharding behind the "
+              "router (GET /cluster; docs/serving.md 'Multi-chip "
+              "serving')")
+    elif args.tp > 1 or args.pp > 1:
         from ..config import ParallelConfig
         from ..models.sharding import shard_for_serving
         from ..parallel import mesh as mesh_lib
@@ -239,7 +266,11 @@ def main(argv=None) -> int:
         kv_pool_blocks=args.kv_pool_blocks,
         spec_draft_len=0 if args.no_spec else args.draft_len,
         spec_ngram=args.spec_ngram,
-        trace=not args.no_trace)
+        trace=not args.no_trace,
+        tensor_parallel=args.tp if cluster else 1,
+        pipeline_parallel=args.pp if cluster else 1,
+        replicas=args.replicas,
+        router=args.router)
     if prefix_blocks:
         block_tokens = args.prefill_chunk or max(1, args.prefill_bucket)
         print(f"prefix cache: {prefix_blocks} blocks x {block_tokens} "

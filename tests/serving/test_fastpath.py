@@ -136,7 +136,7 @@ def test_int8_slot_batch_routes_through_fused_kernel(tiny_int8):
         # force the fused paged path (CPU would reject on platform alone;
         # fused_decode_step_paged defaults to interpret mode off-TPU);
         # kv_block_size keeps the interpret-mode attend grid small
-        ds.fused_paged_decode_eligible = lambda *a: True
+        ds.fused_paged_decode_eligible = lambda *a, **k: True
 
         # one-slot engine: each request decodes alone through the fused
         # kernel — the committed-trajectory reference

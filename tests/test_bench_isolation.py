@@ -23,7 +23,7 @@ import bench
 def _stub_point(train=None, decode=None, pld=None, prefill=None,
                 serving=None):
     """A fake bench._point dispatching on the spec kind."""
-    def point(label, spec, timeout_s=900):
+    def point(label, spec, timeout_s=900, env=None):
         kind = spec["kind"]
         try:
             if kind == "train":
