@@ -213,30 +213,83 @@ def _decode_roofline_tps(cfg, param_bytes: int, batch: int,
 
 def _audited_decode_bytes(cfg, params, batch: int, avg_cache_len: int):
     """Per-step bytes a decode step actually streams → (weight_bytes,
-    kv_bytes).  The naive roofline denominator (sum of every stored
-    param byte + analytic KV bytes) overstates int8 decode traffic in
-    one place: the word-embedding table.  Decode *gathers* ``batch``
-    rows of it per step — the full table only streams when it doubles
-    as the unembedding matrix (tied embeddings).  Weight leaves are
-    counted at stored width, so an int8 {q, scale} subtree contributes
-    1 byte/element + its fp32 scales; KV bytes come from the cache's own
-    per-position leaf sizes (exact {q, scale} traffic for int8 caches)
-    rather than an analytic elt-size formula."""
+    kv_bytes, by_class).  The naive roofline denominator (sum of every
+    stored param byte + analytic KV bytes) overstates quantized decode
+    traffic in one place: the word-embedding table.  Decode *gathers*
+    ``batch`` rows of it per step — the full table only streams when it
+    doubles as the unembedding matrix (tied embeddings).  Weight leaves
+    are counted at stored width, so an int8 {q, scale} subtree
+    contributes 1 byte/element + its scales and an int4 one ½ byte +
+    group scales; KV bytes come from the cache's own per-position leaf
+    sizes (exact {q, scale} traffic for int8 caches) rather than an
+    analytic elt-size formula.
+
+    ``by_class`` splits the weight term per tensor class — attn / mlp /
+    embedding / norms / other — each as {"bytes", "precision"}, so a
+    record shows *where* the decode bytes gap lives (round 9: with int8
+    attn+MLP the embedding table and norms dominate the residual)."""
     import jax
 
     from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.ops import quant
 
-    weight_bytes = sum(p.size * p.dtype.itemsize
-                       for p in jax.tree.leaves(params))
+    def stored(leaf) -> int:
+        if isinstance(leaf, dict):
+            return sum(a.size * a.dtype.itemsize
+                       for a in jax.tree.leaves(leaf))
+        return leaf.size * leaf.dtype.itemsize
+
+    def precision(leaf) -> str:
+        if isinstance(leaf, dict):
+            return f"int{quant.weight_bits(leaf)}"
+        return str(leaf.dtype)
+
+    by_class: dict = {}
+
+    def tally(cls: str, nbytes: int, prec: str) -> None:
+        row = by_class.setdefault(cls, {"bytes": 0, "precision": set()})
+        row["bytes"] += int(nbytes)
+        row["precision"].add(prec)
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=quant.is_quantized)
+    weight_bytes = 0
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        if "embedding" in name or "lm_head" in name:
+            cls = "embedding"
+        elif "norm" in name:
+            cls = "norms"
+        elif "attn" in name:
+            cls = "attn"
+        elif "mlp" in name:
+            cls = "mlp"
+        else:
+            cls = "other"
+        nbytes = stored(leaf)
+        weight_bytes += nbytes
+        tally(cls, nbytes, precision(leaf))
+
     word = params["embedding"]["word"]
     if not cfg.tie_embed_logits:
-        weight_bytes -= word.size * word.dtype.itemsize
-        weight_bytes += batch * word.shape[-1] * word.dtype.itemsize
+        stored_word = stored(word)
+        if isinstance(word, dict):
+            # int8-resident table: gather streams batch quantized rows
+            # plus their per-row scales (ops/quant.py:embedding_lookup)
+            gathered = batch * (
+                word["q"].shape[-1] * word["q"].dtype.itemsize
+                + word["scale"].dtype.itemsize)
+        else:
+            gathered = batch * word.shape[-1] * word.dtype.itemsize
+        weight_bytes += gathered - stored_word
+        by_class["embedding"]["bytes"] += gathered - stored_word
+    for row in by_class.values():
+        row["precision"] = "+".join(sorted(row["precision"]))
     # one cache position's stored bytes across all layers/heads/sides
     k1, v1 = model_lib.init_kv_cache(cfg, batch, 1)
     per_pos = sum(a.size * a.dtype.itemsize
                   for a in jax.tree.leaves((k1, v1)))
-    return int(weight_bytes), int(per_pos * avg_cache_len)
+    return int(weight_bytes), int(per_pos * avg_cache_len), by_class
 
 
 def _min_time(run, n=3):
@@ -253,11 +306,13 @@ def _min_time(run, n=3):
     return best
 
 
-def _decode_point(hbm_bw: float, quantize: bool = False,
+def _decode_point(hbm_bw: float, quantize=False,
                   wide_layers: int = 0):
     """→ dict with decode tokens/sec, roofline tokens/sec, prefill
-    tokens/sec.  With ``quantize`` both the weights (ops/quant.py) AND the
-    KV cache (ops/kv_quant.py) are int8, and both roofline terms shrink.
+    tokens/sec.  ``quantize`` names a weight precision policy
+    (ops/quant.py:POLICIES — "int8", "int4", "mixed"; ``True`` is
+    accepted as "int8" for pre-v5 specs); any policy also puts the KV
+    cache (ops/kv_quant.py) at int8, and every roofline term shrinks.
     With ``wide_layers`` the model is 7B-width at that depth (the fused
     decode kernel bows out on VMEM fit; the composed path serves)."""
     import jax
@@ -265,6 +320,9 @@ def _decode_point(hbm_bw: float, quantize: bool = False,
 
     from megatron_llm_tpu.models import model as model_lib
     from megatron_llm_tpu.generation.generation import generate_tokens
+
+    if quantize is True:
+        quantize = "int8"
 
     # gen 512 (not 128): the decode rate is derived by subtracting a
     # separately-timed prefill from the full-generate window; at 512
@@ -279,9 +337,10 @@ def _decode_point(hbm_bw: float, quantize: bool = False,
         cfg = dataclasses.replace(cfg, kv_cache_quant="int8").validate()
     params = model_lib.init_params(jax.random.key(0), cfg)
     if quantize:
-        from megatron_llm_tpu.ops.quant import quantize_params
+        from megatron_llm_tpu.ops.quant import (quantize_params,
+                                                resolve_policy)
 
-        params = quantize_params(params)
+        params = quantize_params(params, resolve_policy(quantize))
 
     rng = np.random.default_rng(1)
     tokens = np.zeros((b, prompt_len + gen_len), np.int32)
@@ -328,17 +387,18 @@ def _decode_point(hbm_bw: float, quantize: bool = False,
         "model_params": n_params,
     }
     if quantize:
-        # per-step bytes-moved audit for the int8 point: the naive
+        # per-step bytes-moved audit for the quantized points: the naive
         # denominator streams the (untied, gathered-not-streamed) word
         # embedding table every step, understating roofline_frac; the
         # audited denominator counts actual {q, scale} traffic
         # (docs/inference.md files the residual gap as a measured number)
-        weight_bytes, kv_bytes = _audited_decode_bytes(
+        weight_bytes, kv_bytes, by_class = _audited_decode_bytes(
             cfg, params, b, prompt_len + gen_len // 2)
         roof_a = b * hbm_bw / (weight_bytes + kv_bytes)
         result.update({
             "step_weight_bytes": weight_bytes,
             "step_kv_bytes": kv_bytes,
+            "step_bytes_by_class": by_class,
             "naive_roofline_frac": result["roofline_frac"],
             "roofline_tokens_per_sec": round(roof_a, 1),
             "roofline_frac": round(tps / roof_a, 4),
@@ -649,6 +709,11 @@ def _retry(fn, *args, **kw):
 # record's "value" field (surfaced under its real name by _flatten_metrics).
 _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
                      "decode_int8_roofline_frac",
+                     # round 9 decode-bytes-gap points: int4 weight
+                     # residency and the mixed (int8 attn / int4 MLP)
+                     # policy must keep beating the int8 audited roofline
+                     "decode_int4_roofline_frac",
+                     "decode_mixed_roofline_frac",
                      "serving_prefix.serving_prefix_ttft_speedup",
                      "serving_prefix.serving_prefix_hit_rate",
                      "serving_paged.serving_paged_max_concurrency",
@@ -658,7 +723,11 @@ _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
                      # 2 replicas on real hardware) and the tp=2 per-chip
                      # model-size win (≈ 2.0)
                      "serving_cluster.serving_cluster_qps_ratio",
-                     "serving_cluster.serving_cluster_tp_model_size_ratio")
+                     "serving_cluster.serving_cluster_tp_model_size_ratio",
+                     # same ≈ tp gate over the mixed-precision tree
+                     # (quantized subtrees + int8 embedding must shard)
+                     "serving_cluster."
+                     "serving_cluster_tp_quant_model_size_ratio")
 _REGRESSION_TOLERANCE = 0.10
 # Tracing must stay effectively free on the serving hot path: the mixed
 # point's ITL p50 with the span recorder on may exceed the untraced rerun
@@ -669,7 +738,9 @@ _TRACE_OVERHEAD_TOLERANCE = 0.10
 # --compare across old records is interpretable.
 # v3: + serving_spec point (speculative decoding ITL speedup + acceptance)
 # v4: + serving_cluster point (replica QPS scaling + tp model-size ratio)
-_BENCH_SCHEMA_VERSION = 4
+# v5: + decode int4/mixed points, per-tensor-class step-bytes breakdown,
+#     decode specs carry a precision-policy string in "quantize"
+_BENCH_SCHEMA_VERSION = 5
 
 
 def _run_metadata(platform: str, device_count: int) -> dict:
@@ -1016,7 +1087,13 @@ def main() -> None:
     decode = _point("decode", {"kind": "decode", "platform": platform})
     decode_q = _point("decode/int8", {"kind": "decode",
                                       "platform": platform,
-                                      "quantize": True})
+                                      "quantize": "int8"})
+    decode_i4 = _point("decode/int4", {"kind": "decode",
+                                       "platform": platform,
+                                       "quantize": "int4"})
+    decode_mx = _point("decode/mixed", {"kind": "decode",
+                                        "platform": platform,
+                                        "quantize": "mixed"})
     decode_7b = _point("decode/7b-width-L8",
                        {"kind": "decode", "platform": platform,
                         "wide_layers": 8}, timeout_s=1200)
@@ -1082,21 +1159,28 @@ def main() -> None:
             "decode_roofline_frac": decode["roofline_frac"],
             "prefill_tokens_per_sec": decode["prefill_tokens_per_sec"],
         })
-    if decode_q is not None:
+    for tag, dq in (("int8", decode_q), ("int4", decode_i4),
+                    ("mixed", decode_mx)):
+        if dq is None:
+            continue
         record.update({
-            "decode_tokens_per_sec_int8": decode_q["tokens_per_sec"],
-            "decode_int8_roofline_frac": decode_q["roofline_frac"],
+            f"decode_tokens_per_sec_{tag}": dq["tokens_per_sec"],
+            f"decode_{tag}_roofline_frac": dq["roofline_frac"],
         })
-        if "step_weight_bytes" in decode_q:
+        if "step_weight_bytes" in dq:
             # bytes-moved audit (definition change vs pre-audit records:
             # roofline_frac now uses the audited denominator; the naive
-            # value rides along for continuity — docs/inference.md)
+            # value rides along for continuity — docs/inference.md) plus
+            # the v5 per-tensor-class breakdown showing where the
+            # residual decode bytes live
             record.update({
-                "decode_int8_step_weight_bytes":
-                    decode_q["step_weight_bytes"],
-                "decode_int8_step_kv_bytes": decode_q["step_kv_bytes"],
-                "decode_int8_naive_roofline_frac":
-                    decode_q["naive_roofline_frac"],
+                f"decode_{tag}_step_weight_bytes":
+                    dq["step_weight_bytes"],
+                f"decode_{tag}_step_kv_bytes": dq["step_kv_bytes"],
+                f"decode_{tag}_step_bytes_by_class":
+                    dq["step_bytes_by_class"],
+                f"decode_{tag}_naive_roofline_frac":
+                    dq["naive_roofline_frac"],
             })
     if decode_7b is not None:
         record["decode_7b_width"] = decode_7b

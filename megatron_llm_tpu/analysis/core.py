@@ -49,6 +49,11 @@ RULES: Dict[str, str] = {
     "lock-discipline": (
         "attribute written under a class's threading.Lock/Condition in "
         "one method but written without the lock in another"),
+    "dequant-hot-path": (
+        "dequantize_weight/dequantize_cache call in a kernels/ file or "
+        "a # tpulint: hot-path function — materializes the full fp "
+        "tensor, erasing the quantized-residency bytes win; dequantize "
+        "per tile inside the kernel instead"),
     "suppression": (
         "malformed tpulint suppression (unknown rule id or missing "
         "reason) — suppressions must document why"),

@@ -1,4 +1,4 @@
-"""tpulint rule families R1-R5, tuned to this codebase's idioms.
+"""tpulint rule families R1-R6, tuned to this codebase's idioms.
 
 The module model (``ModuleContext``) understands the repo's jit
 conventions before any rule runs:
@@ -674,8 +674,50 @@ def _check_class_locks(ctx: ModuleContext, cls: ast.ClassDef,
     return findings
 
 
+# -- R6: whole-tensor dequantization on the hot path ------------------------
+
+_DEQUANT_FNS = ("dequantize_weight", "dequantize_cache")
+
+
+def rule_dequant_hot_path(ctx: ModuleContext) -> List[Finding]:
+    """The quantized-residency bytes win exists only while the packed
+    form is what streams from HBM: the fused decode kernels dequantize
+    int8/int4 *tiles* inside the tile load
+    (kernels/decode_step.py:_int4_tile), never the whole tensor.  A
+    ``dequantize_weight`` / ``dequantize_cache`` call in a kernels/
+    file or a ``tpulint: hot-path`` function re-materializes the full
+    fp tensor every step — the exact traffic quantization was bought
+    to eliminate.  Cold paths (checkpoint export, tests, debugging)
+    are exempt."""
+    findings: List[Finding] = []
+    in_kernels = f"/{ctx.config.kernel_dir}/" in f"/{ctx.path}"
+    seen: Set[Tuple[int, int]] = set()
+    for fn in _functions(ctx.tree):
+        if not (in_kernels or ctx.is_hot_function(fn)):
+            continue
+        qual = ctx.qualname_of(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            p = dotted_path(node.func)
+            if (p is None or p[-1] not in _DEQUANT_FNS
+                    or (node.lineno, node.col_offset) in seen):
+                continue
+            seen.add((node.lineno, node.col_offset))
+            where = ("a kernels/ file" if in_kernels
+                     else "a hot-path function")
+            findings.append(Finding(
+                ctx.path, node.lineno, node.col_offset,
+                "dequant-hot-path",
+                f"{p[-1]} materializes the full-precision tensor inside "
+                f"{where} — dequantize per tile in the kernel instead",
+                qual))
+    return findings
+
+
 ALL_RULES = (rule_recompile, rule_host_sync, rule_donation,
-             rule_tracer_leak, rule_lock_discipline)
+             rule_tracer_leak, rule_lock_discipline,
+             rule_dequant_hot_path)
 
 
 def run_all(ctx: ModuleContext) -> List[Finding]:

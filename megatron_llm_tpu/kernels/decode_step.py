@@ -15,20 +15,36 @@ VMEM scratch carried across grid steps.  One kernel launch per decode
 step puts the step on the HBM-bandwidth roofline instead of the
 op-dispatch latency wall.
 
-Scope (eligibility enforced by :func:`fused_decode_eligible`): dense
-pre-LN RMSNorm GLU decoder layers (the Llama family), rotary positions,
-no biases, single new token, no active mesh, per-layer working set
-within the VMEM budget.  Weights may be bf16/f32 OR the
-``{"q": int8, "scale": fp32}`` form of ops/quant.py — int8 tiles stream
-into VMEM and the per-output-column scale is an epilogue after each dot
-(the algebra of ops/quant.py:mm), applied to q/k BEFORE RoPE because
-the rotation mixes adjacent columns carrying different scales.  The KV
-cache may be plain bf16/f32 OR the int8 ``{"q", "scale"}`` form of
-ops/kv_quant.py — dequantization is fused at the attention tile load
-(the fp copy exists only in registers), and the new token's K/V are
-requantized in-register so their in-kernel attention fold matches what
-later steps read back from the quantized cache.  Everything else —
-prefill, meshes, BERT/T5, 7B-width layers, partially-quantized stacks —
+This file holds THREE kernels sharing that design: the dense
+whole-stack step (``fused_decode_step``, fixed-stride caches), its
+paged twin reading the serving block pool through per-slot block
+tables (``fused_decode_step_paged``), and the batched variable-length
+speculative verify (``fused_decode_verify_paged``, a W-wide window per
+slot with in-flight K/V splicing).  Scope (eligibility enforced by
+:func:`fused_decode_eligible` / :func:`fused_paged_decode_eligible` /
+:func:`fused_paged_verify_eligible`): dense pre-LN RMSNorm GLU decoder
+layers (the Llama family), rotary positions, no biases, single new
+token (per window row), no active mesh / no head-sharding submesh,
+per-layer working set within the VMEM budget.
+
+Weight precision is a per-class matrix (ops/quant.py:PrecisionPolicy):
+the attention and MLP projection classes are each bf16/f32, int8
+per-output-channel, or int4 group-wise, in any combination — both
+classes plain, or both quantized (int8×int8, int4×int4, and the mixed
+int8×int4 pairs).  int8 tiles stream into VMEM and the
+per-output-column scale is an epilogue after each dot (the algebra of
+ops/quant.py:mm), applied to q/k BEFORE RoPE because the rotation
+mixes adjacent columns carrying different scales.  int4 tiles stream
+PACKED (two nibbles per byte) and unpack + group-scale-dequantize in
+the tile load (``_int4_tile``) — group scales vary along the
+contraction axis, so they cannot be an output epilogue; the fp copy
+exists only in VMEM/registers and HBM stays at the half-byte width.
+The KV cache may be plain bf16/f32 OR the int8 ``{"q", "scale"}`` form
+of ops/kv_quant.py — dequantization is fused at the attention tile
+load, and the new token's K/V are requantized in-register so their
+in-kernel attention fold matches what later steps read back from the
+quantized cache.  Everything else — prefill, meshes, BERT/T5, 7B-width
+layers, partially-quantized classes, non-uniform int4 group sizes —
 keeps the composed path (models/transformer.py:stack_forward_cached).
 The reference's serving loop runs one token per python-level
 ForwardStep through the whole module tree
@@ -104,7 +120,30 @@ _GLU_BASE = {
 }
 
 
-def _decode_step_kernel(per_row: bool, wq8: bool, cq8: bool,
+def _int4_tile(ref, s_ref, cdt, gsz: int):
+    """Unpack an int4-packed weight tile and fuse its group-scale dequant
+    into the tile load: packed int8 ``(rows/2, cols)`` + fp32 scales
+    ``(rows/gsz, cols)`` → a ``(rows, cols)`` tile in the compute dtype.
+
+    Nibble order matches ops/quant.py:pack_int4 (even input row in the
+    low nibble); sign extension is the same ``(p << 28) >> 28`` int32
+    arithmetic as ops/quant.py:unpack_int4, so the kernel's dequantized
+    values agree bitwise with the composed path's.  Unlike the int8
+    path there is no output epilogue — group scales vary along the
+    contraction axis — so the dot consumes a full-precision tile that
+    exists only in VMEM/registers while HBM traffic stays at the packed
+    half-byte width."""
+    p32 = ref[0].astype(jnp.int32)
+    low = (p32 << 28) >> 28
+    high = (p32 << 24) >> 28
+    r2, cols = p32.shape
+    v = jnp.stack([low, high], axis=1).reshape(2 * r2, cols)
+    v = v.astype(jnp.float32).reshape(-1, gsz, cols) * s_ref[0][:, None, :]
+    return v.reshape(2 * r2, cols).astype(cdt)
+
+
+def _decode_step_kernel(per_row: bool, aq: int, mq: int, gsz: int,
+                        cq8: bool,
                         nk: int, nm: int, block_k: int,
                         b: int, nq: int, nkv: int, g: int, d: int,
                         eps: float, scale: float, act,
@@ -117,17 +156,23 @@ def _decode_step_kernel(per_row: bool, wq8: bool, cq8: bool,
     # fill (drives the per-row attention mask).  RoPE at per-row
     # positions arrives as precomputed cos/sin row vectors plus the fixed
     # pair-swap permutation in ``rot_ref`` (see fused_decode_step).
-    # wq8: every projection weight is int8 with [L, 1, out] fp32 scale
-    # operands riding behind the weights.  cq8: the cache refs are int8
-    # with [L, b, kv, block_k, 1] fp32 per-row scale refs behind them.
+    # aq/mq: HBM-resident bits of the attention / MLP projection class
+    # (0 = plain, 8 = int8 + [L, 1, out] scale epilogue operands, 4 =
+    # packed int4 + [L, n_groups, out] group-scale operands consumed by
+    # _int4_tile; gsz is the int4 group size).  cq8: the cache refs are
+    # int8 with [L, b, kv, block_k, 1] fp32 per-row scale refs behind
+    # them.
     if per_row:
         cos_ref, sin_ref, *refs = refs
     (in_nw_ref, post_nw_ref,
      wq_ref, wk_ref, wv_ref, wo_ref,
      wg_ref, wu_ref, wd_ref, *refs) = refs
-    if wq8:
-        (qs_ref, ks_ref, vs_ref, os_ref,
-         gs_ref, us_ref, ds_ref, *refs) = refs
+    qs_ref = ks_ref = vs_ref = os_ref = None
+    if aq:
+        (qs_ref, ks_ref, vs_ref, os_ref, *refs) = refs
+    gs_ref = us_ref = ds_ref = None
+    if mq:
+        (gs_ref, us_ref, ds_ref, *refs) = refs
     kc_ref, vc_ref, *refs = refs
     if cq8:
         kcs_ref, vcs_ref, *refs = refs
@@ -140,8 +185,19 @@ def _decode_step_kernel(per_row: bool, wq8: bool, cq8: bool,
     pos = lens_ref[0]
     f32 = jnp.float32
     # compute dtype of the projection dots: mirrors ops/quant.py:mm for
-    # int8 weights (inner dot int8→x.dtype, scale as output epilogue)
-    cdt = x_ref.dtype if wq8 else wq_ref.dtype
+    # quantized weights (int8: inner dot int8→x.dtype, scale as output
+    # epilogue; int4: dequantized tile in x.dtype)
+    cdt = x_ref.dtype if (aq or mq) else wq_ref.dtype
+
+    def wmat_a(ref, s_ref):  # attention-class tile in compute dtype
+        if aq == 4:
+            return _int4_tile(ref, s_ref, cdt, gsz)
+        return ref[0].astype(cdt) if aq else ref[0]
+
+    def wmat_m(ref, s_ref):  # MLP-class tile in compute dtype
+        if mq == 4:
+            return _int4_tile(ref, s_ref, cdt, gsz)
+        return ref[0].astype(cdt) if mq else ref[0]
 
     @pl.when(jnp.logical_and(li == 0, ki == 0))
     def _first():
@@ -170,19 +226,17 @@ def _decode_step_kernel(per_row: bool, wq8: bool, cq8: bool,
                 return y * cos_ref[...] + z * sin_ref[...]
             return z
 
-        def wmat(ref):  # int8 tiles convert in-register; HBM stays int8
-            return ref[0].astype(cdt) if wq8 else ref[0]
-
-        q = jax.lax.dot_general(xnc, wmat(wq_ref), dims,
+        q = jax.lax.dot_general(xnc, wmat_a(wq_ref, qs_ref), dims,
                                 preferred_element_type=f32)
-        k = jax.lax.dot_general(xnc, wmat(wk_ref), dims,
+        k = jax.lax.dot_general(xnc, wmat_a(wk_ref, ks_ref), dims,
                                 preferred_element_type=f32)
-        v = jax.lax.dot_general(xnc, wmat(wv_ref), dims,
+        v = jax.lax.dot_general(xnc, wmat_a(wv_ref, vs_ref), dims,
                                 preferred_element_type=f32)
-        if wq8:
+        if aq == 8:
             # per-output-column scale epilogue (ops/quant.py:mm algebra),
             # BEFORE RoPE: the rotation mixes the (2i, 2i+1) column pair,
-            # whose scales differ
+            # whose scales differ (int4 group scales are already folded
+            # into the tile by _int4_tile)
             q = q * qs_ref[0]
             k = k * ks_ref[0]
             v = v * vs_ref[0]
@@ -268,11 +322,11 @@ def _decode_step_kernel(per_row: bool, wq8: bool, cq8: bool,
                 ctx_scr[:b, hq * d:(hq + 1) * d] = ctx[:, j, :]
 
         dims = (((1,), (0,)), ((), ()))
-        w_o = wo_ref[0].astype(cdt) if wq8 else wo_ref[0]
+        w_o = wmat_a(wo_ref, os_ref)
         attn = jax.lax.dot_general(
             ctx_scr[...].astype(cdt), w_o, dims,
             preferred_element_type=f32)                   # (b_pad, h)
-        if wq8:
+        if aq == 8:
             attn = attn * os_ref[0]
         x1 = x_scr[...] + attn
         nw2 = post_nw_ref[0].astype(f32)
@@ -290,22 +344,25 @@ def _decode_step_kernel(per_row: bool, wq8: bool, cq8: bool,
     def _mlp_chunk():
         dims = (((1,), (0,)), ((), ()))
         xn2c = xn2_scr[...].astype(cdt)
-        w_g = wg_ref[0].astype(cdt) if wq8 else wg_ref[0]
-        w_u = wu_ref[0].astype(cdt) if wq8 else wu_ref[0]
-        w_d = wd_ref[0].astype(cdt) if wq8 else wd_ref[0]
+        w_g = wmat_m(wg_ref, gs_ref)
+        w_u = wmat_m(wu_ref, us_ref)
+        w_d = wmat_m(wd_ref, ds_ref)
         gate = jax.lax.dot_general(xn2c, w_g, dims,
                                    preferred_element_type=f32)
         up = jax.lax.dot_general(xn2c, w_u, dims,
                                  preferred_element_type=f32)
-        if wq8:
-            # gate/up scales chunk with the ffn columns; the w_down scale
-            # is per output column, so scaling each partial sum is exact
+        if mq == 8:
+            # int8 gate/up scales chunk with the ffn columns; the w_down
+            # scale is per output column, so scaling each partial sum is
+            # exact.  (int4 group scales chunk with the ffn ROWS of
+            # w_down and are folded in by _int4_tile — exact for the
+            # same reason: whole groups live inside one chunk.)
             gate = gate * gs_ref[0]
             up = up * us_ref[0]
         hid = (act(gate) * up).astype(cdt)
         part = jax.lax.dot_general(hid, w_d, dims,
                                    preferred_element_type=f32)
-        if wq8:
+        if mq == 8:
             part = part * ds_ref[0]
         x_scr[...] = x_scr[...] + part
 
@@ -314,7 +371,8 @@ def _decode_step_kernel(per_row: bool, wq8: bool, cq8: bool,
         xo_ref[...] = x_scr[...].astype(xo_ref.dtype)
 
 
-def _decode_step_kernel_paged(wq8: bool, cq8: bool, W: int,
+def _decode_step_kernel_paged(aq: int, mq: int, gsz: int,
+                              cq8: bool, W: int,
                               ntb: int, nm: int, block_k: int,
                               b: int, nq: int, nkv: int, g: int, d: int,
                               eps: float, scale: float, act,
@@ -353,9 +411,12 @@ def _decode_step_kernel_paged(wq8: bool, cq8: bool, W: int,
     (in_nw_ref, post_nw_ref,
      wq_ref, wk_ref, wv_ref, wo_ref,
      wg_ref, wu_ref, wd_ref, *refs) = refs
-    if wq8:
-        (qs_ref, ks_ref, vs_ref, os_ref,
-         gs_ref, us_ref, ds_ref, *refs) = refs
+    qs_ref = ks_ref = vs_ref = os_ref = None
+    if aq:
+        (qs_ref, ks_ref, vs_ref, os_ref, *refs) = refs
+    gs_ref = us_ref = ds_ref = None
+    if mq:
+        (gs_ref, us_ref, ds_ref, *refs) = refs
     kc_ref, vc_ref, *refs = refs
     if cq8:
         kcs_ref, vcs_ref, *refs = refs
@@ -367,7 +428,17 @@ def _decode_step_kernel_paged(wq8: bool, cq8: bool, W: int,
     n_layers = pl.num_programs(0)
     nk = (b // W) * ntb                                 # attend ticks
     f32 = jnp.float32
-    cdt = x_ref.dtype if wq8 else wq_ref.dtype
+    cdt = x_ref.dtype if (aq or mq) else wq_ref.dtype
+
+    def wmat_a(ref, s_ref):  # attention-class tile in compute dtype
+        if aq == 4:
+            return _int4_tile(ref, s_ref, cdt, gsz)
+        return ref[0].astype(cdt) if aq else ref[0]
+
+    def wmat_m(ref, s_ref):  # MLP-class tile in compute dtype
+        if mq == 4:
+            return _int4_tile(ref, s_ref, cdt, gsz)
+        return ref[0].astype(cdt) if mq else ref[0]
 
     @pl.when(jnp.logical_and(li == 0, ki == 0))
     def _first():
@@ -390,16 +461,13 @@ def _decode_step_kernel_paged(wq8: bool, cq8: bool, W: int,
             z = jax.lax.dot_general(y, rot, dims, preferred_element_type=f32)
             return y * cos_ref[...] + z * sin_ref[...]
 
-        def wmat(ref):  # int8 tiles convert in-register; HBM stays int8
-            return ref[0].astype(cdt) if wq8 else ref[0]
-
-        q = jax.lax.dot_general(xnc, wmat(wq_ref), dims,
+        q = jax.lax.dot_general(xnc, wmat_a(wq_ref, qs_ref), dims,
                                 preferred_element_type=f32)
-        k = jax.lax.dot_general(xnc, wmat(wk_ref), dims,
+        k = jax.lax.dot_general(xnc, wmat_a(wk_ref, ks_ref), dims,
                                 preferred_element_type=f32)
-        v = jax.lax.dot_general(xnc, wmat(wv_ref), dims,
+        v = jax.lax.dot_general(xnc, wmat_a(wv_ref, vs_ref), dims,
                                 preferred_element_type=f32)
-        if wq8:
+        if aq == 8:
             q = q * qs_ref[0]
             k = k * ks_ref[0]
             v = v * vs_ref[0]
@@ -507,11 +575,11 @@ def _decode_step_kernel_paged(wq8: bool, cq8: bool, W: int,
                 ctx_scr[:b, hq * d:(hq + 1) * d] = ctx[:, j, :]
 
         dims = (((1,), (0,)), ((), ()))
-        w_o = wo_ref[0].astype(cdt) if wq8 else wo_ref[0]
+        w_o = wmat_a(wo_ref, os_ref)
         attn = jax.lax.dot_general(
             ctx_scr[...].astype(cdt), w_o, dims,
             preferred_element_type=f32)                   # (b_pad, h)
-        if wq8:
+        if aq == 8:
             attn = attn * os_ref[0]
         x1 = x_scr[...] + attn
         nw2 = post_nw_ref[0].astype(f32)
@@ -523,20 +591,20 @@ def _decode_step_kernel_paged(wq8: bool, cq8: bool, W: int,
     def _mlp_chunk():
         dims = (((1,), (0,)), ((), ()))
         xn2c = xn2_scr[...].astype(cdt)
-        w_g = wg_ref[0].astype(cdt) if wq8 else wg_ref[0]
-        w_u = wu_ref[0].astype(cdt) if wq8 else wu_ref[0]
-        w_d = wd_ref[0].astype(cdt) if wq8 else wd_ref[0]
+        w_g = wmat_m(wg_ref, gs_ref)
+        w_u = wmat_m(wu_ref, us_ref)
+        w_d = wmat_m(wd_ref, ds_ref)
         gate = jax.lax.dot_general(xn2c, w_g, dims,
                                    preferred_element_type=f32)
         up = jax.lax.dot_general(xn2c, w_u, dims,
                                  preferred_element_type=f32)
-        if wq8:
+        if mq == 8:
             gate = gate * gs_ref[0]
             up = up * us_ref[0]
         hid = (act(gate) * up).astype(cdt)
         part = jax.lax.dot_general(hid, w_d, dims,
                                    preferred_element_type=f32)
-        if wq8:
+        if mq == 8:
             part = part * ds_ref[0]
         x_scr[...] = x_scr[...] + part
 
@@ -583,11 +651,17 @@ def _pair_swap_matrix(d: int) -> jax.Array:
 def _stack_eligible(cfg, params, platform: str):
     """Config/params portion of the fused-decode predicates, shared by the
     dense and paged variants.  Returns None when the stack cannot fuse,
-    else the ``wq8`` flag (all seven projections int8-quantized)."""
+    else the ``(aq, mq, gsz)`` precision triple: the HBM-resident bits of
+    the attention and MLP projection classes (0 plain / 8 int8 / 4 int4
+    group-wise — the mixed-precision eligibility matrix) and the int4
+    group size (0 when no class is int4).  Each class must be internally
+    uniform, and either both classes are quantized or neither — a
+    half-quantized stack (quantize_params never produces one) keeps the
+    composed path instead of silently dequantizing."""
     from ..config import PositionEmbeddingType
     from ..ops.activations import is_glu
     from ..ops.attention import _mesh_active
-    from ..ops.quant import is_quantized
+    from ..ops.quant import int4_group_size, weight_bits
 
     if not getattr(cfg, "fused_decode", True) or platform != "tpu":
         return None
@@ -607,49 +681,90 @@ def _stack_eligible(cfg, params, platform: str):
         return None
     if not (is_glu(cfg.activation) and "w_gate" in layers["mlp"]):
         return None
-    # int8 weights fuse when ALL seven projections are quantized — a
-    # partially-quantized stack (quantize_params never produces one)
-    # would need per-projection kernel variants, so it keeps the
-    # composed path instead of silently dequantizing
-    projections = (layers["attn"]["wq"], layers["attn"]["wk"],
-                   layers["attn"]["wv"], layers["attn"]["wo"],
-                   layers["mlp"]["w_gate"], layers["mlp"]["w_up"],
-                   layers["mlp"]["w_down"])
-    quant_flags = {is_quantized(w) for w in projections}
-    if len(quant_flags) != 1:
+    # The mixed-precision matrix: each projection class (attention
+    # wq/wk/wv/wo, MLP w_gate/w_up/w_down) must be internally uniform —
+    # a class needing per-projection kernel variants keeps the composed
+    # path.  Classes may mix with each other (int8 attention × int4 MLP
+    # and the transposes), but plain×quantized mixes decline.
+    attn_ws = (layers["attn"]["wq"], layers["attn"]["wk"],
+               layers["attn"]["wv"], layers["attn"]["wo"])
+    mlp_ws = (layers["mlp"]["w_gate"], layers["mlp"]["w_up"],
+              layers["mlp"]["w_down"])
+
+    def class_bits(ws):
+        bits = {weight_bits(w) for w in ws}
+        return bits.pop() if len(bits) == 1 else None
+
+    aq, mq = class_bits(attn_ws), class_bits(mlp_ws)
+    if aq is None or mq is None or (aq == 0) != (mq == 0):
         return None
-    wq8 = quant_flags.pop()
+    gszs = {int4_group_size(w) for w in attn_ws + mlp_ws
+            if weight_bits(w) == 4}
+    if len(gszs) > 1:
+        return None
+    gsz = gszs.pop() if gszs else 0
     d = cfg.head_dim
     h = cfg.hidden_size
     if not (d % 128 == 0 and h % 128 == 0 and cfg.ffn_size % 128 == 0
             and (cfg.num_attention_heads * d) % 128 == 0
             and (cfg.kv_heads * d) % 128 == 0):
         return None
-    return wq8
+    # int4 tiles must split into whole scale groups: the attention tiles
+    # contract over h (wq/wk/wv) and nq·d (wo); the MLP gate/up tiles
+    # over h and the w_down CHUNKS over f_chunk rows each (the per-tick
+    # streaming of _mlp_chunks) — a group straddling a chunk boundary
+    # would need cross-tick scale state.
+    f_chunk = cfg.ffn_size // _mlp_chunks(cfg.ffn_size)
+    if aq == 4 and (h % gsz or (cfg.num_attention_heads * d) % gsz):
+        return None
+    if mq == 4 and (h % gsz or f_chunk % gsz):
+        return None
+    return aq, mq, gsz
+
+
+def _class_itemsizes(params, aq: int, mq: int) -> tuple[float, float]:
+    """Per-class HBM bytes/element of the projection weights: 0.5 for
+    packed int4, 1 for int8, else the plain dtype width.  Feeds the
+    shared ``_pick_block_k``/``_vmem_fit`` probe so the VMEM estimate
+    tracks what actually streams."""
+    wq = params["layers"]["attn"]["wq"]
+    wu = params["layers"]["mlp"]["w_up"]
+    attn_item = 0.5 if aq == 4 else 1 if aq == 8 else wq.dtype.itemsize
+    mlp_item = 0.5 if mq == 4 else 1 if mq == 8 else wu.dtype.itemsize
+    return attn_item, mlp_item
 
 
 def fused_decode_eligible(cfg, params, k_cache, s: int,
                           platform: str) -> bool:
-    """Static predicate for the fused path (see module docstring scope).
+    """Static predicate for the dense fused path: the module-docstring
+    scope (RMSNorm GLU rotary stack, single token, no mesh), the
+    per-class weight-precision matrix of ``_stack_eligible`` (plain /
+    int8 / int4 attention × MLP, plus a plain-or-int8 KV cache in any
+    combination), and the VMEM probe with the matching packed itemsizes.
 
     Factored out (same pattern as ops/attention.decode_kernel_eligible)
-    so CPU tests can assert both the accept and every reject arm.
+    so CPU tests can assert both the accept and every reject arm; the
+    paged and verify variants (``fused_paged_decode_eligible``,
+    ``fused_paged_verify_eligible``) share every stack check and differ
+    only in pool-geometry terms.
     """
     from ..ops.kv_quant import is_quantized_cache
 
     if s != 1:
         return False
-    wq8 = _stack_eligible(cfg, params, platform)
-    if wq8 is None:
+    elig = _stack_eligible(cfg, params, platform)
+    if elig is None:
         return False
+    aq, mq, _ = elig
     cq8 = is_quantized_cache(k_cache)
     kc = k_cache["q"] if cq8 else k_cache
     max_len = kc.shape[3]
     b = kc.shape[1]
     if max_len % 128 != 0:
         return False
-    w_item = 1 if wq8 else params["layers"]["attn"]["wq"].dtype.itemsize
-    return _pick_block_k(cfg, b, max_len, w_item, kc.dtype.itemsize) >= 128
+    attn_item, mlp_item = _class_itemsizes(params, aq, mq)
+    return _pick_block_k(cfg, b, max_len, attn_item, mlp_item,
+                         kc.dtype.itemsize) >= 128
 
 
 def _mesh_shards_stack(mesh) -> bool:
@@ -692,19 +807,20 @@ def fused_paged_decode_eligible(cfg, params, k_pool, n_slots: int,
         return False
     if _mesh_shards_stack(mesh):
         return False
-    wq8 = _stack_eligible(cfg, params, platform)
-    if wq8 is None:
+    elig = _stack_eligible(cfg, params, platform)
+    if elig is None:
         return False
+    aq, mq, _ = elig
     cq8 = is_quantized_cache(k_pool)
     kc = k_pool["q"] if cq8 else k_pool
     block_k = kc.shape[3]
     if block_k % 128 != 0:
         return False
-    w_item = 1 if wq8 else params["layers"]["attn"]["wq"].dtype.itemsize
+    attn_item, mlp_item = _class_itemsizes(params, aq, mq)
     # one row's single block streams per tick (cache_rows=1): the cache
     # VMEM term loses its batch factor, but the broadcast-reduce scratch
     # is still over all b rows (the masked no-op trick computes them all)
-    return _vmem_fit(cfg, n_slots, block_k, w_item,
+    return _vmem_fit(cfg, n_slots, block_k, attn_item, mlp_item,
                      1 if cq8 else kc.dtype.itemsize, cache_rows=1)
 
 
@@ -724,16 +840,17 @@ def fused_paged_verify_eligible(cfg, params, k_pool, n_slots: int,
         return False
     if _mesh_shards_stack(mesh):
         return False
-    wq8 = _stack_eligible(cfg, params, platform)
-    if wq8 is None:
+    elig = _stack_eligible(cfg, params, platform)
+    if elig is None:
         return False
+    aq, mq, _ = elig
     cq8 = is_quantized_cache(k_pool)
     kc = k_pool["q"] if cq8 else k_pool
     block_k = kc.shape[3]
     if block_k % 128 != 0:
         return False
-    w_item = 1 if wq8 else params["layers"]["attn"]["wq"].dtype.itemsize
-    return _vmem_fit(cfg, n_slots * window, block_k, w_item,
+    attn_item, mlp_item = _class_itemsizes(params, aq, mq)
+    return _vmem_fit(cfg, n_slots * window, block_k, attn_item, mlp_item,
                      1 if cq8 else kc.dtype.itemsize, cache_rows=1)
 
 
@@ -755,8 +872,8 @@ def _default_block_k(cache_int8: bool) -> int:
     return 512 if cache_int8 else 256
 
 
-def _pick_block_k(cfg, b: int, max_len: int, weight_itemsize: int,
-                  cache_itemsize: int) -> int:
+def _pick_block_k(cfg, b: int, max_len: int, attn_itemsize: float,
+                  mlp_itemsize: float, cache_itemsize: int) -> int:
     """Largest cache block that fits the VMEM estimate: start from the
     dtype-appropriate default and halve while the budget rejects it (the
     fp32 broadcast-reduce temporaries scale with block_k, so a wide int8
@@ -765,45 +882,55 @@ def _pick_block_k(cfg, b: int, max_len: int, weight_itemsize: int,
     bk = min(_default_block_k(cache_itemsize == 1), max_len)
     while max_len % bk:
         bk //= 2
-    while bk >= 128 and not _vmem_fit(cfg, b, bk, weight_itemsize,
-                                      cache_itemsize):
+    while bk >= 128 and not _vmem_fit(cfg, b, bk, attn_itemsize,
+                                      mlp_itemsize, cache_itemsize):
         bk //= 2
     return bk
 
 
-def _vmem_fit(cfg, b: int, block_k: int, weight_itemsize: int,
-              cache_itemsize: int,
+def _vmem_fit(cfg, b: int, block_k: int, attn_itemsize: float,
+              mlp_itemsize: float, cache_itemsize: int,
               budget: int = 100 * 1024 * 1024,
               cache_rows: int | None = None) -> bool:
     """Whole-layer-resident VMEM estimate: the kernel holds one layer's
     weights + two KV blocks, double-buffered, plus fp32 scratch.  Layers
     wider than the budget (e.g. 7B-width: ~354 MB/layer bf16) must keep
     the composed path — Mosaic would fail the scoped-vmem allocation.
-    Weight and cache itemsizes are independent (weight-only int8, int8
-    KV, or both); int8 roughly doubles the feasible block_k/batch on
-    whichever side is quantized.  The int8 scale vectors ([out] per
-    weight, one fp32 per cache row) are <1% of the blocks and ride
-    inside the budget slack."""
+    The attention-class, MLP-class, and cache itemsizes are independent
+    (the per-tensor precision policy: int8 halves, packed int4 quarters
+    the streamed bytes of its class).  int4 classes additionally charge
+    for the dequantized fp32 tiles ``_int4_tile`` materializes (plus the
+    int32 unpack intermediate) — those live in VMEM even though HBM
+    stays packed.  The int8/int4 scale tensors (≤ 1/group_size of the
+    blocks) ride inside the budget slack."""
     d = cfg.head_dim
     h = cfg.hidden_size
     nq, nkv, ffn = cfg.num_attention_heads, cfg.kv_heads, cfg.ffn_size
-    weight_elts = (h * nq * d + 2 * h * nkv * d + nq * d * h
-                   + (3 if cfg.is_glu else 2) * h * ffn // _mlp_chunks(ffn))
+    attn_elts = h * nq * d + 2 * h * nkv * d + nq * d * h
+    mlp_elts = (3 if cfg.is_glu else 2) * h * ffn // _mlp_chunks(ffn)
     # paged mode streams one row's block per tick (cache_rows=1); dense
     # mode streams all b rows' blocks together
     cache_elts = 2 * (b if cache_rows is None else cache_rows) \
         * nkv * block_k * d
-    blocks = (weight_elts * weight_itemsize
+    blocks = (attn_elts * attn_itemsize + mlp_elts * mlp_itemsize
               + cache_elts * cache_itemsize) * 2  # double-buffered
     b_pad = max(8, -(-b // 8) * 8)
     g = nq // nkv
     # quantized caches materialize scaled fp32 copies of both tile loads
     n_tmp = 5 if cache_itemsize == 1 else 3
+    int4_tmp = 0
+    if attn_itemsize == 0.5:
+        # _project materializes wq/wk/wv fp32 tiles at once (wo later,
+        # smaller); ×2 covers the int32 unpack intermediates
+        int4_tmp = max(int4_tmp, 2 * h * (nq + 2 * nkv) * d)
+    if mlp_itemsize == 0.5:
+        int4_tmp = max(int4_tmp, 2 * mlp_elts)
     scratch = 4 * (2 * b_pad * h + b_pad * nq * d
                    + g * b * nkv * (2 * d + 2 * 128) + 2 * b * nkv * d
+                   + int4_tmp
                    # the (b, nkv, block_k, d) broadcast-reduce temporaries
                    + n_tmp * b * nkv * block_k * d)
-    return blocks + scratch <= budget
+    return int(blocks + scratch) <= budget
 
 
 def fused_decode_step(
@@ -842,7 +969,7 @@ def fused_decode_step(
     deepest row's bytes) and each row masks attention at its own fill.
     """
     from ..ops.kv_quant import is_quantized_cache
-    from ..ops.quant import is_quantized
+    from ..ops.quant import int4_group_size, weight_bits
 
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
@@ -858,12 +985,17 @@ def fused_decode_step(
     scale = 1.0 / float(np.sqrt(d))
     act = _GLU_BASE[cfg.activation]
 
+    attn_p, mlp_p = stacked["attn"], stacked["mlp"]
+    aq = weight_bits(attn_p["wq"])
+    mq = weight_bits(mlp_p["w_gate"])
+    gsz = (int4_group_size(attn_p["wq"]) if aq == 4
+           else int4_group_size(mlp_p["w_gate"]) if mq == 4 else 0)
+
     if block_k is None:
         # same probe as fused_decode_eligible, so the block the predicate
         # accepted is the block the call actually launches with
-        wq = stacked["attn"]["wq"]
-        w_item = 1 if is_quantized(wq) else wq.dtype.itemsize
-        block_k = _pick_block_k(cfg, b, max_len, w_item,
+        attn_item, mlp_item = _class_itemsizes({"layers": stacked}, aq, mq)
+        block_k = _pick_block_k(cfg, b, max_len, attn_item, mlp_item,
                                 1 if cq8 else k_arr.dtype.itemsize)
     block_k = min(block_k, max_len)
     while max_len % block_k:
@@ -895,26 +1027,32 @@ def fused_decode_step(
         rot = rope_rotation_matrix(rope[0], rope[1], cache_len, d)
         lens = jnp.reshape(cache_len, (1,))
 
-    attn_p, mlp_p = stacked["attn"], stacked["mlp"]
-    wq8 = is_quantized(attn_p["wq"])
+    def wm_a(w):  # quantized weights ship their q payload; scales ride
+        return w["q"] if aq else w  # separately
 
-    def wm(w):  # int8 weights ship their q payload; scales ride separately
-        return w["q"] if wq8 else w
+    def wm_m(w):
+        return w["q"] if mq else w
 
     # norm scales ride as [L, 1, h]: a (1, 1, h) block keeps the last two
     # dims legal under the TPU (8, 128) tiling rule (a (1, h) block of an
     # [L, h] array has a size-1 sublane dim and is rejected by Mosaic)
     rope_rows = (c_rows, s_rows) if per_row else ()
     # int8 weight scales are [L, out] fp32 → ride as [L, 1, out] (same
-    # norm-scale tiling trick); order matches the kernel's unpacking
-    # (qs, ks, vs, os, gs, us, ds)
+    # norm-scale tiling trick); int4 group scales are already rank-3
+    # [L, n_groups, out] and ride as-is.  Per-class tuples concatenate in
+    # the kernel's unpacking order (qs, ks, vs, os, then gs, us, ds).
+    def class_scales(bits, ws):
+        if bits == 8:
+            return tuple(w["scale"][:, None, :] for w in ws)
+        if bits == 4:
+            return tuple(w["scale"] for w in ws)
+        return ()
+
     weight_scales = (
-        attn_p["wq"]["scale"][:, None, :], attn_p["wk"]["scale"][:, None, :],
-        attn_p["wv"]["scale"][:, None, :], attn_p["wo"]["scale"][:, None, :],
-        mlp_p["w_gate"]["scale"][:, None, :],
-        mlp_p["w_up"]["scale"][:, None, :],
-        mlp_p["w_down"]["scale"][:, None, :],
-    ) if wq8 else ()
+        class_scales(aq, (attn_p["wq"], attn_p["wk"], attn_p["wv"],
+                          attn_p["wo"]))
+        + class_scales(mq, (mlp_p["w_gate"], mlp_p["w_up"],
+                            mlp_p["w_down"])))
     # int8 cache scales are [L, b, kv, max_len] fp32 → a trailing unit dim
     # keeps the (block_k, 1) block legal (flash_decode _scale_block_spec)
     cache_scales = (k_cache["scale"][..., None],
@@ -923,9 +1061,9 @@ def fused_decode_step(
         x_p, rot, *rope_rows,
         stacked["input_norm"]["scale"][:, None, :],
         stacked["post_attn_norm"]["scale"][:, None, :],
-        wm(attn_p["wq"]), wm(attn_p["wk"]), wm(attn_p["wv"]),
-        wm(attn_p["wo"]),
-        wm(mlp_p["w_gate"]), wm(mlp_p["w_up"]), wm(mlp_p["w_down"]),
+        wm_a(attn_p["wq"]), wm_a(attn_p["wk"]), wm_a(attn_p["wv"]),
+        wm_a(attn_p["wo"]),
+        wm_m(mlp_p["w_gate"]), wm_m(mlp_p["w_up"]), wm_m(mlp_p["w_down"]),
         *weight_scales,
         k_arr, v_arr, *cache_scales,
     )
@@ -946,21 +1084,19 @@ def fused_decode_step(
             return (li, 0, 0, jnp.minimum(ki, last), 0)
         return pl.BlockSpec((1, b, nkv, block_k, d), idx)
 
-    def mlp_col_spec():
+    def mlp_col_spec(rows):
+        # gate/up tiles: `rows` is the contraction extent as stored (h,
+        # h // 2 packed int4, h // gsz for the group-scale operand)
         def idx(li, ki, lens):
             return (li, 0, jnp.clip(ki - nk, 0, nm - 1))
-        return pl.BlockSpec((1, h, f_chunk), idx)
+        return pl.BlockSpec((1, rows, f_chunk), idx)
 
-    def mlp_row_spec():
+    def mlp_row_spec(rows):
+        # w_down chunks walk the ffn axis: `rows` is one chunk's extent
+        # as stored (f_chunk, f_chunk // 2 packed, f_chunk // gsz scales)
         def idx(li, ki, lens):
             return (li, jnp.clip(ki - nk, 0, nm - 1), 0)
-        return pl.BlockSpec((1, f_chunk, h), idx)
-
-    def mlp_scale_spec():
-        # gate/up scales chunk with the ffn columns of mlp_col_spec
-        def idx(li, ki, lens):
-            return (li, 0, jnp.clip(ki - nk, 0, nm - 1))
-        return pl.BlockSpec((1, 1, f_chunk), idx)
+        return pl.BlockSpec((1, rows, h), idx)
 
     def cache_scale_spec():
         # same fill-clamped block walk as cache_spec, trailing unit dim
@@ -969,19 +1105,40 @@ def fused_decode_step(
             return (li, 0, 0, jnp.minimum(ki, last), 0)
         return pl.BlockSpec((1, b, nkv, block_k, 1), idx)
 
-    weight_scale_specs = [
-        per_layer((1, nq * d)), per_layer((1, nkv * d)),
-        per_layer((1, nkv * d)), per_layer((1, h)),
-        mlp_scale_spec(), mlp_scale_spec(), per_layer((1, h)),
-    ] if wq8 else []
+    # int8: one [1, out] scale row per projection; int4: group scales
+    # share the q payload's index walk with rows // gsz group rows
+    if aq == 8:
+        attn_scale_specs = [per_layer((1, nq * d)), per_layer((1, nkv * d)),
+                            per_layer((1, nkv * d)), per_layer((1, h))]
+    elif aq == 4:
+        attn_scale_specs = [per_layer((h // gsz, nq * d)),
+                            per_layer((h // gsz, nkv * d)),
+                            per_layer((h // gsz, nkv * d)),
+                            per_layer((nq * d // gsz, h))]
+    else:
+        attn_scale_specs = []
+    if mq == 8:
+        mlp_scale_specs = [mlp_col_spec(1), mlp_col_spec(1),
+                           per_layer((1, h))]
+    elif mq == 4:
+        mlp_scale_specs = [mlp_col_spec(h // gsz), mlp_col_spec(h // gsz),
+                           mlp_row_spec(f_chunk // gsz)]
+    else:
+        mlp_scale_specs = []
+    # packed int4 payloads store two rows per byte along the contraction
+    # axis, so their blocks are half-height
+    a_rows = h // 2 if aq == 4 else h
+    ao_rows = nq * d // 2 if aq == 4 else nq * d
+    m_rows = h // 2 if mq == 4 else h
+    md_rows = f_chunk // 2 if mq == 4 else f_chunk
     in_specs = [
         fixed((b_pad, h)), fixed((d, d)),
         *([fixed((b_pad, d))] * 2 if per_row else []),
         per_layer((1, h)), per_layer((1, h)),
-        per_layer((h, nq * d)), per_layer((h, nkv * d)),
-        per_layer((h, nkv * d)), per_layer((nq * d, h)),
-        mlp_col_spec(), mlp_col_spec(), mlp_row_spec(),
-        *weight_scale_specs,
+        per_layer((a_rows, nq * d)), per_layer((a_rows, nkv * d)),
+        per_layer((a_rows, nkv * d)), per_layer((ao_rows, h)),
+        mlp_col_spec(m_rows), mlp_col_spec(m_rows), mlp_row_spec(md_rows),
+        *attn_scale_specs, *mlp_scale_specs,
         cache_spec(), cache_spec(),
         *([cache_scale_spec(), cache_scale_spec()] if cq8 else []),
     ]
@@ -1014,7 +1171,7 @@ def fused_decode_step(
     compiler_params_cls = getattr(pltpu, "CompilerParams", None) \
         or pltpu.TPUCompilerParams
     hidden, k_rows, v_rows = pl.pallas_call(
-        functools.partial(_decode_step_kernel, per_row, wq8, cq8,
+        functools.partial(_decode_step_kernel, per_row, aq, mq, gsz, cq8,
                           nk, nm, block_k,
                           b, nq, nkv, g, d, eps, scale, act),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -1117,7 +1274,7 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
     the RoPE rows and the per-row attention limits; ``fills`` stays [S]
     per-slot for the lens[0] clamp parity."""
     from ..ops.kv_quant import is_quantized_cache
-    from ..ops.quant import is_quantized
+    from ..ops.quant import int4_group_size, weight_bits
 
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
@@ -1159,18 +1316,32 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
     rot = _pair_swap_matrix(d)
 
     attn_p, mlp_p = stacked["attn"], stacked["mlp"]
-    wq8 = is_quantized(attn_p["wq"])
+    aq = weight_bits(attn_p["wq"])
+    mq = weight_bits(mlp_p["w_gate"])
+    gsz = (int4_group_size(attn_p["wq"]) if aq == 4
+           else int4_group_size(mlp_p["w_gate"]) if mq == 4 else 0)
 
-    def wm(w):
-        return w["q"] if wq8 else w
+    def wm_a(w):
+        return w["q"] if aq else w
+
+    def wm_m(w):
+        return w["q"] if mq else w
+
+    # int8 weight scales ride as [L, 1, out]; int4 group scales are
+    # already rank-3 [L, n_groups, out] and ride as-is — per-class tuples
+    # concatenate in the kernel's unpacking order (see fused_decode_step)
+    def class_scales(bits, ws):
+        if bits == 8:
+            return tuple(w["scale"][:, None, :] for w in ws)
+        if bits == 4:
+            return tuple(w["scale"] for w in ws)
+        return ()
 
     weight_scales = (
-        attn_p["wq"]["scale"][:, None, :], attn_p["wk"]["scale"][:, None, :],
-        attn_p["wv"]["scale"][:, None, :], attn_p["wo"]["scale"][:, None, :],
-        mlp_p["w_gate"]["scale"][:, None, :],
-        mlp_p["w_up"]["scale"][:, None, :],
-        mlp_p["w_down"]["scale"][:, None, :],
-    ) if wq8 else ()
+        class_scales(aq, (attn_p["wq"], attn_p["wk"], attn_p["wv"],
+                          attn_p["wo"]))
+        + class_scales(mq, (mlp_p["w_gate"], mlp_p["w_up"],
+                            mlp_p["w_down"])))
     # int8 pool scales are [L, nb, kv, block] fp32 → trailing unit dim
     # keeps the (block_k, 1) block legal (flash_decode _scale_block_spec)
     cache_scales = (k_pool["scale"][..., None],
@@ -1179,9 +1350,9 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
         x_p, rot, c_rows, s_rows,
         stacked["input_norm"]["scale"][:, None, :],
         stacked["post_attn_norm"]["scale"][:, None, :],
-        wm(attn_p["wq"]), wm(attn_p["wk"]), wm(attn_p["wv"]),
-        wm(attn_p["wo"]),
-        wm(mlp_p["w_gate"]), wm(mlp_p["w_up"]), wm(mlp_p["w_down"]),
+        wm_a(attn_p["wq"]), wm_a(attn_p["wk"]), wm_a(attn_p["wv"]),
+        wm_a(attn_p["wo"]),
+        wm_m(mlp_p["w_gate"]), wm_m(mlp_p["w_up"]), wm_m(mlp_p["w_down"]),
         *weight_scales,
         k_arr, v_arr, *cache_scales,
     )
@@ -1214,34 +1385,50 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
             return (li, tbl[r, jnp.minimum(j, last)], 0, 0, 0)
         return pl.BlockSpec((1, 1, nkv, block_k, trailing), idx)
 
-    def mlp_col_spec():
+    def mlp_col_spec(rows):
+        # `rows` is the gate/up contraction extent as stored (h, h // 2
+        # packed int4, h // gsz for the group-scale operand)
         def idx(li, ki, *s):
             return (li, 0, jnp.clip(ki - nk, 0, nm - 1))
-        return pl.BlockSpec((1, h, f_chunk), idx)
+        return pl.BlockSpec((1, rows, f_chunk), idx)
 
-    def mlp_row_spec():
+    def mlp_row_spec(rows):
+        # w_down chunks walk the ffn axis: `rows` is one chunk's extent
+        # as stored (f_chunk, f_chunk // 2 packed, f_chunk // gsz scales)
         def idx(li, ki, *s):
             return (li, jnp.clip(ki - nk, 0, nm - 1), 0)
-        return pl.BlockSpec((1, f_chunk, h), idx)
+        return pl.BlockSpec((1, rows, h), idx)
 
-    def mlp_scale_spec():
-        def idx(li, ki, *s):
-            return (li, 0, jnp.clip(ki - nk, 0, nm - 1))
-        return pl.BlockSpec((1, 1, f_chunk), idx)
-
-    weight_scale_specs = [
-        per_layer((1, nq * d)), per_layer((1, nkv * d)),
-        per_layer((1, nkv * d)), per_layer((1, h)),
-        mlp_scale_spec(), mlp_scale_spec(), per_layer((1, h)),
-    ] if wq8 else []
+    if aq == 8:
+        attn_scale_specs = [per_layer((1, nq * d)), per_layer((1, nkv * d)),
+                            per_layer((1, nkv * d)), per_layer((1, h))]
+    elif aq == 4:
+        attn_scale_specs = [per_layer((h // gsz, nq * d)),
+                            per_layer((h // gsz, nkv * d)),
+                            per_layer((h // gsz, nkv * d)),
+                            per_layer((nq * d // gsz, h))]
+    else:
+        attn_scale_specs = []
+    if mq == 8:
+        mlp_scale_specs = [mlp_col_spec(1), mlp_col_spec(1),
+                           per_layer((1, h))]
+    elif mq == 4:
+        mlp_scale_specs = [mlp_col_spec(h // gsz), mlp_col_spec(h // gsz),
+                           mlp_row_spec(f_chunk // gsz)]
+    else:
+        mlp_scale_specs = []
+    a_rows = h // 2 if aq == 4 else h
+    ao_rows = nq * d // 2 if aq == 4 else nq * d
+    m_rows = h // 2 if mq == 4 else h
+    md_rows = f_chunk // 2 if mq == 4 else f_chunk
     in_specs = [
         fixed((b_pad, h)), fixed((d, d)),
         fixed((b_pad, d)), fixed((b_pad, d)),
         per_layer((1, h)), per_layer((1, h)),
-        per_layer((h, nq * d)), per_layer((h, nkv * d)),
-        per_layer((h, nkv * d)), per_layer((nq * d, h)),
-        mlp_col_spec(), mlp_col_spec(), mlp_row_spec(),
-        *weight_scale_specs,
+        per_layer((a_rows, nq * d)), per_layer((a_rows, nkv * d)),
+        per_layer((a_rows, nkv * d)), per_layer((ao_rows, h)),
+        mlp_col_spec(m_rows), mlp_col_spec(m_rows), mlp_row_spec(md_rows),
+        *attn_scale_specs, *mlp_scale_specs,
         cache_spec(d), cache_spec(d),
         *([cache_spec(1), cache_spec(1)] if cq8 else []),
     ]
@@ -1270,7 +1457,7 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
     compiler_params_cls = getattr(pltpu, "CompilerParams", None) \
         or pltpu.TPUCompilerParams
     hidden, k_rows, v_rows = pl.pallas_call(
-        functools.partial(_decode_step_kernel_paged, wq8, cq8, W,
+        functools.partial(_decode_step_kernel_paged, aq, mq, gsz, cq8, W,
                           ntb, nm, block_k,
                           b, nq, nkv, g, d, eps, scale, act),
         grid_spec=pltpu.PrefetchScalarGridSpec(

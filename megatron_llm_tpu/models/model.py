@@ -76,8 +76,14 @@ def embed(cfg: ModelConfig, params: Params, tokens: jax.Array,
           tokentype_ids: Optional[jax.Array] = None,
           dropout_rng=None, deterministic: bool = True) -> jax.Array:
     """Token (+position, +tokentype) embedding with embedding dropout
-    (reference: megatron/model/language_model.py:133-327)."""
-    x = params["embedding"]["word"][tokens]
+    (reference: megatron/model/language_model.py:133-327).
+
+    The word table may be the int8 per-row ``{"q", "scale"}`` form of
+    ops/quant.py:quantize_embedding — the gather dequantizes only the
+    looked-up rows, keeping the table int8-resident in HBM."""
+    from ..ops.quant import embedding_lookup
+
+    x = embedding_lookup(params["embedding"]["word"], tokens, cfg.dtype)
     if "position" in params["embedding"]:
         if position_ids is None:
             position_ids = jnp.arange(tokens.shape[1])[None, :]

@@ -184,15 +184,17 @@ def shard_for_serving(params: Params, cfg: ModelConfig,
         f"divide num_attention_heads = {cfg.num_attention_heads}")
     mesh = mesh_lib.build_mesh(parallel)
     specs = serving_param_specs(cfg, parallel)
-    # int8-quantized trees have {"q", "scale"} subtrees where the spec
-    # tree has one weight leaf; mirror the structure.
+    # quantized trees have {"q", "scale"} subtrees where the spec tree
+    # has one weight leaf; mirror the structure params-aware so int8,
+    # int4 group-wise, and the int8 embedding each get co-sharded scale
+    # specs (quantize_specs docstring).
     from ..ops import quant
 
     if any(quant.is_quantized(w)
            for w in jax.tree.leaves(params,
                                     is_leaf=quant.is_quantized)
            if isinstance(w, dict)):
-        specs = quant.quantize_specs(specs)
+        specs = quant.quantize_specs(specs, params)
     return shard_params(params, specs, mesh), mesh
 
 

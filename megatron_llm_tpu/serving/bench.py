@@ -605,11 +605,12 @@ def run_cluster_serving_bench(cfg, params, *, num_requests: int = 16,
             "itl_ms_p50": round(itl.percentile(50) * 1e3, 3),
         }
 
-    def per_device_param_bytes(tp_ways: int) -> int:
+    def per_device_param_bytes(tp_ways: int, tree=None) -> int:
+        tree = params if tree is None else tree
         if tp_ways == 1:
-            return sum(np.asarray(l).nbytes for l in jax.tree.leaves(params))
+            return sum(np.asarray(l).nbytes for l in jax.tree.leaves(tree))
         eng = build_sharded_engine(
-            cfg, params,
+            cfg, tree,
             EngineConfig(max_batch_size=slots, max_seq_len=ec.max_seq_len),
             parallel=ParallelConfig(tensor_parallel=tp_ways),
             devices=jax.devices()[:tp_ways])
@@ -622,6 +623,16 @@ def run_cluster_serving_bench(cfg, params, *, num_requests: int = 16,
     multi = one_run(replicas)
     tp1_bytes = per_device_param_bytes(1)
     tpn_bytes = per_device_param_bytes(tp)
+    # the same gate over the mixed-precision tree: quantized {q, scale}
+    # subtrees AND the int8 word embedding must split over tp (scales
+    # co-sharded with q — ops/quant.py:quantize_specs), so per-device
+    # quantized bytes at tp=N stay ≈ 1/N of tp=1 (docs/serving.md
+    # "Mixed precision")
+    from ..ops.quant import quantize_params, resolve_policy
+
+    qparams = quantize_params(params, resolve_policy("mixed"))
+    tp1_q_bytes = per_device_param_bytes(1, qparams)
+    tpn_q_bytes = per_device_param_bytes(tp, qparams)
     return {
         "serving_cluster_qps_1r": single["qps"],
         f"serving_cluster_qps_{replicas}r": multi["qps"],
@@ -636,6 +647,11 @@ def run_cluster_serving_bench(cfg, params, *, num_requests: int = 16,
         f"serving_cluster_tp{tp}_param_bytes_per_device": tpn_bytes,
         "serving_cluster_tp_model_size_ratio": round(
             tp1_bytes / max(1, tpn_bytes), 3),
+        "serving_cluster_tp1_quant_param_bytes_per_device": tp1_q_bytes,
+        f"serving_cluster_tp{tp}_quant_param_bytes_per_device":
+            tpn_q_bytes,
+        "serving_cluster_tp_quant_model_size_ratio": round(
+            tp1_q_bytes / max(1, tpn_q_bytes), 3),
         "serving_cluster_replicas": replicas,
         "serving_cluster_tp": tp,
         "serving_cluster_num_requests": num_requests,

@@ -54,7 +54,7 @@ def build_sharded_engine(cfg: ModelConfig, params,
     if any(quant.is_quantized(w)
            for w in jax.tree.leaves(params, is_leaf=quant.is_quantized)
            if isinstance(w, dict)):
-        specs = quant.quantize_specs(specs)
+        specs = quant.quantize_specs(specs, params)
     sharded = shard_lib.shard_params(params, specs, mesh)
     return ServingEngine(cfg, sharded, engine_config, metrics=metrics,
                          mesh=mesh)

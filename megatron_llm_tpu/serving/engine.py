@@ -683,6 +683,9 @@ class ServingEngine:
         # attribute each decode iteration to fused_steps/fallback_steps
         self._fused_decode = False
         self._fused_verify = False  # same, for the multi-token verify step
+        # weight precision route (ops/quant.py:precision_route) labelling
+        # the fused/fallback counters per precision — resolved at start()
+        self._precision_route = "fp32"
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -715,6 +718,8 @@ class ServingEngine:
                         max_blocks=cfg_e.prefix_cache_blocks,
                         max_seq_len=cfg_e.max_seq_len,
                         metrics=lambda: self.metrics)
+                from ..ops.quant import precision_route
+                self._precision_route = precision_route(self.params)
                 from ..kernels.decode_step import fused_paged_decode_eligible
                 self._fused_decode = fused_paged_decode_eligible(
                     self.cfg, self.params, pool.k_pool,
@@ -1438,8 +1443,7 @@ class ServingEngine:
                 gap = min(wall, t0 - self._last_ready_t)
                 self.metrics.observe_step_breakdown(gap_frac=gap / wall)
         self._last_dispatch_t = t0
-        self.metrics.inc(
-            "fused_steps" if self._fused_verify else "fallback_steps")
+        self.metrics.inc_step(self._fused_verify, self._precision_route)
         with device_annotation("verify"):
             g_tok, g_lp, k_pool, v_pool = self._verify(
                 self.cfg, self.params, self.slots.k_pool,
@@ -1569,8 +1573,7 @@ class ServingEngine:
                 self.metrics.observe_step_breakdown(gap_frac=gap / wall)
         self._last_dispatch_t = t0
 
-        self.metrics.inc(
-            "fused_steps" if self._fused_decode else "fallback_steps")
+        self.metrics.inc_step(self._fused_decode, self._precision_route)
         with device_annotation("decode"):
             tok, tok_lp, k_pool, v_pool = self._decode(
                 self.cfg, self.params, self.slots.k_pool,

@@ -198,6 +198,12 @@ class ServingMetrics:
         self.blocks_free = 0
         self.blocks_used = 0
         self.kv_cache_util = 0.0
+        # fused/fallback decode iterations keyed by the weight precision
+        # route (ops/quant.py:precision_route: fp32/int8/int4/mixed) —
+        # a per-precision regression to the composed path (e.g. an int4
+        # config losing kernel eligibility after a geometry change) is
+        # invisible in the aggregate counters but obvious here
+        self.step_routes: dict = {}
         self.timers = Timers(log_level=2)
         self.slo = SLOTracker(slo or SLOConfig())
         if register:
@@ -206,6 +212,16 @@ class ServingMetrics:
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
             self.counters[name] += by
+
+    def inc_step(self, fused: bool, route: str = "fp32") -> None:
+        """One decode/verify iteration: bumps the aggregate
+        fused_steps/fallback_steps counter AND its per-precision-route
+        breakdown (``route`` from ops/quant.py:precision_route)."""
+        with self._lock:
+            self.counters["fused_steps" if fused else "fallback_steps"] += 1
+            r = self.step_routes.setdefault(route,
+                                            {"fused": 0, "fallback": 0})
+            r["fused" if fused else "fallback"] += 1
 
     def set_gauges(self, *, slots_active: Optional[int] = None,
                    queue_depth: Optional[int] = None,
@@ -325,6 +341,13 @@ class ServingMetrics:
                     / max(1, self.counters["spec_proposed"])),
                 "accepted_tokens_per_step":
                     self.accepted_per_step.snapshot(suffix=""),
+                # decode-step routing by weight precision (inc_step)
+                "fused_steps_by_precision": {
+                    route: r["fused"]
+                    for route, r in sorted(self.step_routes.items())},
+                "fallback_steps_by_precision": {
+                    route: r["fallback"]
+                    for route, r in sorted(self.step_routes.items())},
             })
         out["slo"] = self.slo.snapshot()
         return out
@@ -343,6 +366,18 @@ class ServingMetrics:
                     pname, "counter",
                     f"serving lifecycle counter: {name}").add(
                         self.counters[name]))
+            if self.step_routes:
+                fused_fam = MetricFamily(
+                    "serving_fused_steps_by_precision_total", "counter",
+                    "fused decode iterations by weight precision route")
+                fb_fam = MetricFamily(
+                    "serving_fallback_steps_by_precision_total", "counter",
+                    "composed-path decode iterations by weight precision "
+                    "route")
+                for route, r in sorted(self.step_routes.items()):
+                    fused_fam.add(r["fused"], labels={"precision": route})
+                    fb_fam.add(r["fallback"], labels={"precision": route})
+                fams.extend([fused_fam, fb_fam])
             hits = self.counters["prefix_hits"]
             misses = self.counters["prefix_misses"]
             for gname, help_, value in (

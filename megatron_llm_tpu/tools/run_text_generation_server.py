@@ -141,12 +141,20 @@ def main(argv=None) -> int:
     ap.add_argument("--drain_timeout_s", type=float, default=30.0,
                     help="on SIGTERM, how long to let in-flight requests "
                          "finish before the listener stops")
-    ap.add_argument("--weight_quant", default=None, choices=["int8"],
-                    help="weight-only int8 applied after load (halves "
-                         "decode HBM traffic; ops/quant.py). With "
-                         "--kv_quant int8 the fully int8-resident fused "
-                         "decode kernel serves the slot batch "
-                         "(kernels/decode_step.py)")
+    ap.add_argument("--weight_quant", default=None,
+                    choices=["int8", "int4", "mixed"],
+                    help="weight-only quantization applied after load "
+                         "(ops/quant.py precision policies: int8 halves "
+                         "decode HBM traffic; int4 = group-wise int4 "
+                         "projections + int8 embedding, quarters it; "
+                         "mixed = int8 attention / int4 MLP / int8 "
+                         "embedding). All three stream through the fused "
+                         "decode kernels with dequant fused in the tile "
+                         "load (kernels/decode_step.py); compose with "
+                         "--kv_quant int8 for full low-bit residency")
+    ap.add_argument("--quant_group_size", type=int, default=None,
+                    help="int4 group size (rows per scale group) for "
+                         "--weight_quant int4/mixed; default 128")
     ap.add_argument("--quantize", default=None, choices=["int8"],
                     help="compatibility alias for --weight_quant")
     ap.add_argument("--kv_quant", default=None, choices=["int8"],
@@ -211,11 +219,19 @@ def main(argv=None) -> int:
             lm.cfg, kv_cache_quant=args.kv_quant).validate())
     tokenizer = build_tokenizer(args.tokenizer_type, args.tokenizer_model)
     params = load_params_for_inference(args.load, lm.cfg)
-    if args.weight_quant == "int8" or args.quantize == "int8":
-        from ..ops.quant import quantize_params
+    wq = args.weight_quant or args.quantize
+    if wq:
+        import dataclasses as _dc
 
-        params = quantize_params(params)
-        print("weights quantized to int8 (per-output-channel)")
+        from ..ops.quant import quantize_params, resolve_policy
+
+        pol = resolve_policy(wq)
+        if args.quant_group_size:
+            pol = _dc.replace(pol, group_size=args.quant_group_size)
+        params = quantize_params(params, pol)
+        print(f"weights quantized: policy={wq} (attn={pol.attn or 'fp'}, "
+              f"mlp={pol.mlp or 'fp'}, embedding={pol.embedding or 'fp'}, "
+              f"group_size={pol.group_size})")
 
     cluster = args.replicas > 1 or args.router
     mesh_ctx = None

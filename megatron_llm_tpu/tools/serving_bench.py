@@ -55,10 +55,10 @@ def run(model: str, size: str, tp: int, pp: int, batch: int,
     parallel = ParallelConfig(pipeline_parallel=pp, tensor_parallel=tp)
     params = model_lib.init_params(jax.random.key(0), cfg,
                                    tp=max(tp * pp, 1))
-    if quantize == "int8":
-        from ..ops.quant import quantize_params
+    if quantize:
+        from ..ops.quant import quantize_params, resolve_policy
 
-        params = quantize_params(params)
+        params = quantize_params(params, resolve_policy(quantize))
     params, mesh = shard_lib.shard_for_serving(params, cfg, parallel)
 
     rng = np.random.default_rng(0)
@@ -120,7 +120,9 @@ def main(argv=None) -> int:
     ap.add_argument("--gen", type=int, default=128)
     ap.add_argument("--params_dtype", default="bfloat16",
                     choices=["float32", "bfloat16", "float16"])
-    ap.add_argument("--quantize", default=None, choices=["int8"])
+    ap.add_argument("--quantize", default=None,
+                    choices=["int8", "int4", "mixed"],
+                    help="weight precision policy (ops/quant.py:POLICIES)")
     ap.add_argument("--kv_quant", default=None, choices=["int8"])
     ap.add_argument("--speculative", default=None, choices=["pld"],
                     help="prompt-lookup speculative decoding (greedy; "

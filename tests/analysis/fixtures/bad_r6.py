@@ -1,0 +1,28 @@
+"""Known-bad fixture for R6 (dequant-hot-path).
+
+Whole-tensor dequantization where the quantized bytes win is the
+point: a ``tpulint: hot-path`` function re-materializing the full fp
+tensor every decode step streams exactly the traffic int8/int4
+residency was bought to eliminate.  Cold paths (checkpoint export)
+may dequantize freely.
+"""
+from megatron_llm_tpu.ops.kv_quant import dequantize_cache
+from megatron_llm_tpu.ops.quant import dequantize_weight
+from megatron_llm_tpu.ops import quant
+
+
+# tpulint: hot-path
+def decode_step(params, cache):
+    w = dequantize_weight(params["wq"])  # BAD: dequant-hot-path
+    kv = dequantize_cache(cache)  # BAD: dequant-hot-path
+    return w, kv
+
+
+# tpulint: hot-path
+def verify_step(params):
+    return quant.dequantize_weight(params["w_up"])  # BAD: dequant-hot-path
+
+
+def export_checkpoint(params):
+    # cold path: materializing on purpose is fine here
+    return {k: dequantize_weight(v) for k, v in params.items()}

@@ -40,6 +40,7 @@ def actual_findings(path: Path, config=None):
         ("bad_r3.py", "donation"),
         ("bad_r4.py", "tracer-leak"),
         ("bad_r5.py", "lock-discipline"),
+        ("bad_r6.py", "dequant-hot-path"),
     ],
 )
 def test_fixture_findings_exact(name, rule):
@@ -81,6 +82,21 @@ def test_kernel_ref_params_are_traced():
     """
     findings = _analyze(src, path="megatron_llm_tpu/kernels/attn.py")
     assert [(f.line, f.rule) for f in findings] == [(3, "tracer-leak")]
+
+
+def test_dequant_flagged_anywhere_in_kernels():
+    # In kernels/ every function is on the bytes-bound path: whole-tensor
+    # dequant helpers are flagged without any hot-path comment, even in
+    # launch builders (only per-tile dequant inside the kernel body keeps
+    # the packed form as what streams from HBM).
+    src = """
+        from megatron_llm_tpu.ops.quant import dequantize_weight
+
+        def _launch(w):
+            return dequantize_weight(w)
+    """
+    findings = _analyze(src, path="megatron_llm_tpu/kernels/decode_step.py")
+    assert [(f.line, f.rule) for f in findings] == [(5, "dequant-hot-path")]
 
 
 def test_allow_comment_suppresses_finding():

@@ -669,3 +669,181 @@ def test_fused_paged_matches_dense_fused_int8():
     np.testing.assert_array_equal(np.asarray(got_h), np.asarray(want_h))
     jax.tree.map(lambda g, w: np.testing.assert_array_equal(
         np.asarray(g), np.asarray(w)), (k_rows, v_rows), (want_k, want_v))
+
+
+# ---------------------------------------------------------------------------
+# int4 group-wise + mixed-precision policies (round 9)
+# ---------------------------------------------------------------------------
+
+
+def _policy_setup(policy, group_size, b=2, max_len=256, fill=100, key=1,
+                  **cfg_kw):
+    """Params quantized under a named precision policy at ``group_size``
+    (int4 everywhere, or the mixed int8-attention × int4-MLP split),
+    cache prefilled through the composed path."""
+    from megatron_llm_tpu.ops import quant
+
+    cfg = _cfg(**cfg_kw)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    pol = dataclasses.replace(quant.POLICIES[policy], group_size=group_size)
+    params = quant.quantize_params(params, pol)
+    k_cache, v_cache, rope = _prefill_cache(
+        cfg, params, b, max_len, fill, jax.random.key(key))
+    return cfg, params, k_cache, v_cache, rope
+
+
+@pytest.mark.parametrize("policy,gsz", [
+    ("int4", 64), ("int4", 128), ("mixed", 64),
+])
+def test_fused_matches_composed_int4(policy, gsz):
+    """int4 group-wise weights (and the mixed split) through the fused
+    kernel vs the composed dequant path, per-slot fill vector.  Weights-
+    only quantization: both paths run the identical codes·scale algebra,
+    so the wq8-style tight tolerance applies."""
+    from megatron_llm_tpu.ops import quant
+
+    cfg, params, k_cache, v_cache, rope = _policy_setup(policy, gsz)
+    want_bits = (8, 4) if policy == "mixed" else (4, 4)
+    assert (quant.weight_bits(params["layers"]["attn"]["wq"]),
+            quant.weight_bits(params["layers"]["mlp"]["w_gate"])) \
+        == want_bits
+    b = 2
+    x = jax.random.normal(jax.random.key(2), (b, cfg.hidden_size),
+                          jnp.float32)
+    fills = jnp.asarray([100, 37], jnp.int32)
+
+    position_ids = fills[:, None] + jnp.arange(1, dtype=jnp.int32)[None, :]
+    side = AttnSideInputs(rope_cos=rope[0], rope_sin=rope[1],
+                          position_ids=position_ids, deterministic=True)
+    want_h, want_k, want_v = stack_forward_cached(
+        cfg, params["layers"], x[:, None, :], side, k_cache, v_cache,
+        fills)
+    got_h, k_rows, v_rows = fused_decode_step(
+        cfg, params["layers"], x, k_cache, v_cache, fills, rope,
+        interpret=True)
+
+    np.testing.assert_allclose(np.asarray(got_h), np.asarray(want_h[:, 0]),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(cache_update(k_cache, k_rows, fills)),
+        np.asarray(want_k), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(cache_update(v_cache, v_rows, fills)),
+        np.asarray(want_v), rtol=2e-4, atol=2e-4)
+
+
+def test_fused_paged_matches_dense_fused_int4():
+    """Paged vs dense fused under int4 weights, BITWISE at the shared
+    block partition: the packed-nibble tile loads must be insensitive to
+    the pool's physical block shuffle."""
+    cfg, params, k_cache, v_cache, rope = _policy_setup(
+        "int4", 64, b=3, fill=128, num_attention_heads=4, num_kv_heads=2)
+    bk, max_len = 128, 256
+    fills = jnp.asarray([37, 128, 1], jnp.int32)
+    x = jax.random.normal(jax.random.key(2), (3, cfg.hidden_size),
+                          jnp.float32)
+
+    want_h, want_k, want_v = fused_decode_step(
+        cfg, params["layers"], x, k_cache, v_cache, fills, rope,
+        block_k=bk, interpret=True)
+
+    rng = np.random.default_rng(11)
+    tables = _shuffled_tables(3, max_len // bk, rng)
+    k_pool = _pool_from_cache(k_cache, bk, tables)
+    v_pool = _pool_from_cache(v_cache, bk, tables)
+    got_h, k_rows, v_rows = fused_decode_step_paged(
+        cfg, params["layers"], x, k_pool, v_pool, jnp.asarray(tables),
+        fills, rope, interpret=True)
+
+    np.testing.assert_array_equal(np.asarray(got_h), np.asarray(want_h))
+    jax.tree.map(lambda g, w: np.testing.assert_array_equal(
+        np.asarray(g), np.asarray(w)), (k_rows, v_rows), (want_k, want_v))
+
+
+@pytest.mark.parametrize(
+    "policy,gsz",
+    [("int4", 128), pytest.param("mixed", 64, marks=pytest.mark.slow)],
+    ids=["int4-g128", "mixed-g64"],
+)
+def test_fused_verify_matches_sequential_steps_int4(policy, gsz):
+    """The fused verify kernel under int4/mixed weights vs W sequential
+    paged single-token steps, bitwise — the speculative accept/rollback
+    reproducibility bar extended to the new precision policies."""
+    (fused_verify, _, _, _, _) = _verify_helpers()
+    bk, W, b = 128, 2, 3
+    cfg, params, _, _, rope = _policy_setup(
+        policy, gsz, b=b, fill=128, key=1,
+        num_attention_heads=4, num_kv_heads=2)
+    k_cache, v_cache, _ = _prefill_cache(
+        cfg, params, b, 256, 128, jax.random.key(1))
+    rng = np.random.default_rng(7)
+    tables = _shuffled_tables(b, 256 // bk, rng)
+    k_pool = _pool_from_cache(k_cache, bk, tables)
+    v_pool = _pool_from_cache(v_cache, bk, tables)
+    fills = np.asarray([37, 128, 1], np.int32)
+    x = jax.random.normal(jax.random.key(5), (b, W, cfg.hidden_size),
+                          jnp.float32)
+    jt = jnp.asarray(tables)
+
+    ks, vs = k_pool, v_pool
+    want_h = []
+    for j in range(W):
+        fj = jnp.asarray(fills + j, jnp.int32)
+        h, kr, vr = fused_decode_step_paged(
+            cfg, params["layers"], x[:, j], ks, vs, jt, fj, rope,
+            interpret=True)
+        bids = jnp.asarray(tables[np.arange(b), (fills + j) // bk],
+                           jnp.int32)
+        offs = jnp.asarray((fills + j) % bk, jnp.int32)
+        ks = cache_append_rows(ks, kr, bids, offs)
+        vs = cache_append_rows(vs, vr, bids, offs)
+        want_h.append(h)
+
+    got_h, _, _ = fused_verify(
+        cfg, params["layers"], x, k_pool, v_pool, jt,
+        jnp.asarray(fills), rope, interpret=True)
+    for j in range(W):
+        np.testing.assert_array_equal(np.asarray(got_h[:, j]),
+                                      np.asarray(want_h[j]))
+
+
+def test_eligibility_matrix_int4():
+    """The mixed-precision eligibility matrix: int4 and mixed policy
+    trees fuse; a plain×quantized class split and non-uniform int4 group
+    sizes keep the composed path (no silent in-kernel dequant, no
+    cross-chunk scale state)."""
+    from megatron_llm_tpu.ops import quant
+
+    cfg = _cfg()
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    kc, _ = model_lib.init_kv_cache(cfg, 2, 256)
+    ok = lambda p: fused_decode_eligible(cfg, p, kc, 1, "tpu")
+
+    p4 = quant.quantize_params(
+        params, dataclasses.replace(quant.POLICIES["int4"], group_size=64))
+    pm = quant.quantize_params(params, quant.POLICIES["mixed"])
+    assert ok(p4)
+    assert ok(pm)
+    # ... but not on CPU or for multi-token dense steps
+    assert not fused_decode_eligible(cfg, p4, kc, 1, "cpu")
+    assert not fused_decode_eligible(cfg, p4, kc, 2, "tpu")
+
+    # int4 MLP × PLAIN attention: plain×quantized split declines
+    half = {**pm, "layers": {**pm["layers"],
+                             "attn": params["layers"]["attn"]}}
+    assert not ok(half)
+
+    # non-uniform int4 group sizes across classes decline
+    p4b = quant.quantize_params(
+        params,
+        dataclasses.replace(quant.POLICIES["int4"], group_size=128))
+    nonuniform = {**p4, "layers": {**p4["layers"],
+                                   "mlp": p4b["layers"]["mlp"]}}
+    assert not ok(nonuniform)
+
+    # one projection inside a class at a different width declines too
+    ragged = {**p4, "layers": {**p4["layers"], "attn": {
+        **p4["layers"]["attn"],
+        "wq": pm["layers"]["attn"]["wq"],   # int8 among int4 siblings
+    }}}
+    assert not ok(ragged)

@@ -3,9 +3,12 @@ the reference's optional TE-FP8 path (megatron/model/transformer.py:932-951).
 Logit-tolerance tests mirror how the reference gates low-precision — by
 output error, not weight error."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from megatron_llm_tpu.config import ParallelConfig, tiny_config
 from megatron_llm_tpu.models import model as model_lib
@@ -151,6 +154,214 @@ def test_int8_t5_forward_runs():
         encdec.t5_forward(cfg, quant.quantize_params(params), enc, dec),
         np.float32)
     assert float(np.abs(got - base).mean()) < 0.1
+
+
+# ---------------------------------------------------------------------------
+# int4 group-wise quantization + per-tensor precision policy (round 9:
+# closing the decode bytes gap, docs/inference.md)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("group_size", [32, 64, 128])
+def test_int4_roundtrip_error_bounded(group_size):
+    g = np.random.default_rng(7)
+    w = jnp.asarray(g.normal(0, 0.02, (256, 48)), jnp.float32)
+    qw = quant.quantize_weight_int4(w, group_size)
+    assert qw["q"].shape == (128, 48) and qw["q"].dtype == jnp.int8
+    assert qw["scale"].shape == (256 // group_size, 48)
+    assert quant.weight_bits(qw) == 4
+    assert quant.int4_group_size(qw) == group_size
+    back = quant.dequantize_weight(qw)
+    # symmetric [-7, 7]: error ≤ group scale / 2 per element
+    bound = np.repeat(np.asarray(qw["scale"]), group_size, axis=0) / 2
+    assert (np.abs(np.asarray(back - w)) <= bound + 1e-8).all()
+
+
+def test_int4_pack_unpack_roundtrip_exact():
+    g = np.random.default_rng(8)
+    q = jnp.asarray(g.integers(-7, 8, (3, 64, 16)), jnp.int8)
+    got = quant.unpack_int4(quant.pack_int4(q))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(q))
+
+
+def test_int4_mm_matches_dequantized_matmul():
+    g = np.random.default_rng(9)
+    x = jnp.asarray(g.normal(0, 1, (4, 128)), jnp.float32)
+    w = jnp.asarray(g.normal(0, 0.02, (128, 48)), jnp.float32)
+    qw = quant.quantize_weight_int4(w, 32)
+    np.testing.assert_allclose(
+        np.asarray(quant.mm(x, qw)),
+        np.asarray(x @ quant.dequantize_weight(qw)),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_policy_roundtrip_quantizes_exactly_the_policy_classes():
+    """int4 policy: projections int4, word table int8-per-row; norms,
+    biases, lm_head, and every scale tensor stay at the model dtype."""
+    pol = dataclasses.replace(quant.POLICIES["int4"], group_size=32)
+    cfg = _tiny()
+    params = model_lib.init_params(jax.random.key(2), cfg)
+    qp = quant.quantize_params(params, pol)
+    for name in ("wq", "wk", "wv", "wo"):
+        assert quant.weight_bits(qp["layers"]["attn"][name]) == 4
+        assert quant.int4_group_size(qp["layers"]["attn"][name]) == 32
+        assert qp["layers"]["attn"][name]["scale"].dtype == jnp.float32
+    for name in ("w_gate", "w_up", "w_down"):
+        assert quant.weight_bits(qp["layers"]["mlp"][name]) == 4
+    word = qp["embedding"]["word"]
+    assert quant.weight_bits(word) == 8  # per-row gather scheme
+    assert word["scale"].dtype == jnp.float32
+    # norms and lm_head untouched, bit for bit
+    np.testing.assert_array_equal(
+        np.asarray(qp["final_norm"]["scale"]),
+        np.asarray(params["final_norm"]["scale"]))
+    np.testing.assert_array_equal(
+        np.asarray(qp["layers"]["input_norm"]["scale"]),
+        np.asarray(params["layers"]["input_norm"]["scale"]))
+    np.testing.assert_array_equal(np.asarray(qp["lm_head"]),
+                                  np.asarray(params["lm_head"]))
+    assert qp["lm_head"].dtype == params["lm_head"].dtype
+
+
+def test_mixed_policy_splits_classes():
+    pol = dataclasses.replace(quant.POLICIES["mixed"], group_size=32)
+    cfg = _tiny()
+    params = model_lib.init_params(jax.random.key(3), cfg)
+    qp = quant.quantize_params(params, pol)
+    assert quant.weight_bits(qp["layers"]["attn"]["wq"]) == 8
+    assert quant.weight_bits(qp["layers"]["mlp"]["w_up"]) == 4
+    assert quant.weight_bits(qp["embedding"]["word"]) == 8
+
+
+def test_int4_indivisible_group_falls_back_to_int8():
+    """h=64 with group_size=128: the leaf falls back to int8 (visible via
+    weight_bits, never silent corruption)."""
+    cfg = _tiny()
+    params = model_lib.init_params(jax.random.key(4), cfg)
+    qp = quant.quantize_params(params, quant.POLICIES["int4"])  # g=128
+    assert quant.weight_bits(qp["layers"]["attn"]["wq"]) == 8
+    # ffn=128 rows: w_down still gets the int4 form
+    assert quant.weight_bits(qp["layers"]["mlp"]["w_down"]) == 4
+
+
+def test_precision_route_labels():
+    cfg = _tiny()
+    params = model_lib.init_params(jax.random.key(5), cfg)
+    pol4 = dataclasses.replace(quant.POLICIES["int4"], group_size=32)
+    polm = dataclasses.replace(quant.POLICIES["mixed"], group_size=32)
+    assert quant.precision_route(params) == "fp32"
+    assert quant.precision_route(quant.quantize_params(params)) == "int8"
+    assert quant.precision_route(
+        quant.quantize_params(params, pol4)) == "int4"
+    assert quant.precision_route(
+        quant.quantize_params(params, polm)) == "mixed"
+
+
+def test_int4_forward_logit_tolerance():
+    """End-to-end parity vs fp32 under the full int4 policy — same gate
+    as the int8 test (reference fp16 tolerance, getting_started:154)."""
+    cfg = _tiny()
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(6).integers(0, cfg.vocab_size, (2, 32)),
+        jnp.int32)
+    base = np.asarray(model_lib.forward(cfg, params, tokens), np.float32)
+    pol = dataclasses.replace(quant.POLICIES["int4"], group_size=32)
+    got = np.asarray(model_lib.forward(
+        cfg, quant.quantize_params(params, pol), tokens), np.float32)
+    avg_abs = float(np.abs(got - base).mean())
+    assert avg_abs < 0.1, avg_abs
+    agree = (got.argmax(-1) == base.argmax(-1)).mean()
+    assert agree > 0.9, agree
+
+
+def test_int4_specs_co_shard_with_q():
+    """quantize_specs: int4 scales take the weight's output-axis
+    sharding, replicate the group axis; the embedding's per-row scale
+    takes the vocab split; MQA-replicated K/V stay replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from megatron_llm_tpu.models import sharding as shard_lib
+
+    tp = 2
+    cfg = _tiny(num_layers=2, hidden_size=64, num_attention_heads=8,
+                num_kv_heads=8, ffn_hidden_size=128, vocab_size=256,
+                make_vocab_size_divisible_by=16)
+    params = model_lib.init_params(jax.random.key(6), cfg, tp=tp)
+    pol = dataclasses.replace(quant.POLICIES["int4"], group_size=32)
+    qp = quant.quantize_params(params, pol)
+    specs = shard_lib.serving_param_specs(
+        cfg, ParallelConfig(tensor_parallel=tp))
+    qspecs = quant.quantize_specs(specs, qp)
+    assert qspecs["layers"]["attn"]["wq"]["q"] == P(None, None, "tp")
+    assert qspecs["layers"]["attn"]["wq"]["scale"] == P(None, None, "tp")
+    # row-parallel w_down: packed rows shard, group axis replicates
+    assert qspecs["layers"]["mlp"]["w_down"]["q"] == P(None, "tp", None)
+    assert qspecs["layers"]["mlp"]["w_down"]["scale"] == P(None, None,
+                                                           None)
+    assert qspecs["embedding"]["word"]["q"] == P("tp", None)
+    assert qspecs["embedding"]["word"]["scale"] == P("tp")
+
+
+def test_int4_specs_mqa_kv_stay_replicated():
+    from jax.sharding import PartitionSpec as P
+
+    from megatron_llm_tpu.models import sharding as shard_lib
+
+    cfg = _tiny(num_layers=2, hidden_size=64, num_attention_heads=8,
+                num_kv_heads=1, ffn_hidden_size=128, vocab_size=256,
+                make_vocab_size_divisible_by=16)
+    params = model_lib.init_params(jax.random.key(7), cfg, tp=2)
+    pol = dataclasses.replace(quant.POLICIES["int4"], group_size=32)
+    qp = quant.quantize_params(params, pol)
+    specs = shard_lib.serving_param_specs(
+        cfg, ParallelConfig(tensor_parallel=2))
+    qspecs = quant.quantize_specs(specs, qp)
+    # kv_heads=1 can't split over tp=2: wk/wv and their scales replicate
+    assert qspecs["layers"]["attn"]["wk"]["q"] == P(None, None, None)
+    assert qspecs["layers"]["attn"]["wk"]["scale"] == P(None, None, None)
+    # q projection still splits, scale co-sharded
+    assert qspecs["layers"]["attn"]["wq"]["scale"] == P(None, None, "tp")
+
+
+def test_int4_generate_and_sharded_serving():
+    """int4/mixed greedy decode under the tp serving layout stays
+    token-identical to the unsharded quantized run (the tp=2 bytes win
+    with no token drift)."""
+    from megatron_llm_tpu.generation.generation import generate_tokens
+    from megatron_llm_tpu.models import sharding as shard_lib
+    from megatron_llm_tpu.parallel import mesh as mesh_lib
+
+    tp = 2
+    cfg = _tiny(num_layers=2, hidden_size=64, num_attention_heads=8,
+                num_kv_heads=8, ffn_hidden_size=128, vocab_size=256,
+                make_vocab_size_divisible_by=16, seq_length=48,
+                max_position_embeddings=48)
+    params = model_lib.init_params(jax.random.key(8), cfg, tp=tp)
+    pol = dataclasses.replace(quant.POLICIES["int4"], group_size=32)
+    qparams = quant.quantize_params(params, pol)
+
+    g = np.random.default_rng(9)
+    b, prompt_len, max_seq = 2, 16, 48
+    tokens = np.zeros((b, max_seq), np.int32)
+    tokens[:, :prompt_len] = g.integers(3, cfg.vocab_size, (b, prompt_len))
+    tokens = jnp.asarray(tokens)
+    lengths = jnp.full((b,), prompt_len, jnp.int32)
+
+    want = generate_tokens(cfg, qparams, tokens, lengths,
+                           use_eos_stop=False)
+    qsharded, mesh = shard_lib.shard_for_serving(
+        qparams, cfg, ParallelConfig(tensor_parallel=tp))
+    with mesh_lib.use_mesh(mesh):
+        got = generate_tokens(cfg, qsharded, tokens, lengths,
+                              use_eos_stop=False)
+    np.testing.assert_array_equal(np.asarray(got.tokens),
+                                  np.asarray(want.tokens))
+    # per-device resident bytes ≈ half of the full quantized tree
+    full = sum(np.asarray(l).nbytes for l in jax.tree.leaves(qparams))
+    per_dev = sum(l.addressable_shards[0].data.nbytes
+                  for l in jax.tree.leaves(qsharded))
+    assert per_dev / full < 0.56, per_dev / full
 
 
 # ---------------------------------------------------------------------------

@@ -608,3 +608,46 @@ class TestSpeculative:
         assert total_committed <= \
             snap["spec_accepted"] + hist["count"] + 1e-6
         assert hist["mean"] >= 1.0
+
+
+class TestPrecisionPolicies:
+    """int4 / mixed weight policies through the engine (round 9): every
+    committed token must equal the one-shot ``generate_tokens``
+    trajectory on the SAME quantized tree — bitwise reproducibility
+    across engine modes — and the decode-step metrics must attribute
+    iterations to the right precision route."""
+
+    @pytest.mark.parametrize("policy", ["int4", "mixed"])
+    def test_policy_paged_matches_one_shot(self, tiny, policy):
+        import dataclasses
+
+        from megatron_llm_tpu.ops import quant
+
+        cfg, params = tiny
+        pol = dataclasses.replace(quant.POLICIES[policy], group_size=32)
+        qparams = quant.quantize_params(params, pol)
+        rng = np.random.default_rng(37)
+        prompts = [rng.integers(1, cfg.vocab_size, n).tolist()
+                   for n in (3, 17, 9)]
+        engine = ServingEngine(cfg, qparams, EngineConfig(
+            max_batch_size=4, max_seq_len=64, max_queue_size=16,
+            kv_block_size=8)).start()
+        try:
+            handles = [engine.submit(p, max_new_tokens=10,
+                                     use_eos_stop=False) for p in prompts]
+            results = [h.result(timeout=600) for h in handles]
+        finally:
+            engine.shutdown()
+        for p, r in zip(prompts, results):
+            assert r.finish_reason == "length"
+            assert r.tokens == _reference(cfg, qparams, p, 10)
+
+        # decode iterations attributed to the policy's precision route
+        # (on CPU every step takes the composed path, so the fallback
+        # breakdown is where the label must land)
+        snap = engine.metrics.snapshot()
+        routes = {**snap["fused_steps_by_precision"],
+                  **snap["fallback_steps_by_precision"]}
+        assert set(routes) == {policy}
+        assert sum(snap["fallback_steps_by_precision"].values()) + \
+            sum(snap["fused_steps_by_precision"].values()) > 0
