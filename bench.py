@@ -671,6 +671,39 @@ def _serving_cluster_point():
         max_prompt_len=max_prompt_len, replicas=2, tp=2)
 
 
+def _serving_disagg_point(platform: str):
+    """Disaggregated prefill/decode point (serving/cluster/,
+    docs/serving.md "Disaggregated prefill/decode"): long-prompt traffic
+    through ``build_disagg_cluster`` (1 prefill + 1 decode replica) vs
+    ``build_cluster`` (2 colocated replicas) at EQUAL device count, plus
+    a prefill-chunk MFU sweep on a single engine.  Headlines
+    ``serving_disagg_ttft_p99_ratio`` (colocated TTFT p99 / disagg TTFT
+    p99 — above 1 means shipping KV blocks out of a dedicated prefill
+    engine beats interleaving admissions with decode),
+    ``serving_disagg_qps_ratio``, and ``serving_disagg_prefill_mfu``
+    (acceptance bar > 0.174 — above the training headline — on real
+    hardware) gate in --compare.  As with serving_cluster, the CPU
+    device-count simulation shares the host cores across "devices", so
+    simulated ratios and MFU only track plumbing cost, not the claims."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.serving.bench import run_disagg_serving_bench
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"serving_disagg_skipped":
+                f"needs >= 2 devices, have {n_dev}"}
+    prompt_len, gen_len = 512, 32
+    cfg = _bench_model(prompt_len + gen_len, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return run_disagg_serving_bench(
+        cfg, params, num_requests=16, gen_len=gen_len, slots=4,
+        prompt_len=prompt_len, prefill_chunk=64,
+        chunk_sweep=(64, 128, 256, 512),
+        peak_flops=chip_peak_flops(platform))
+
+
 def _transient_error_types():
     """The error classes worth retrying: the axon-tunneled compile service
     occasionally throws a transient remote-compile XlaRuntimeError.
@@ -727,7 +760,14 @@ _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
                      # same ≈ tp gate over the mixed-precision tree
                      # (quantized subtrees + int8 embedding must shard)
                      "serving_cluster."
-                     "serving_cluster_tp_quant_model_size_ratio")
+                     "serving_cluster_tp_quant_model_size_ratio",
+                     # disaggregated prefill/decode vs colocated at equal
+                     # device count: TTFT tail + QPS must not regress,
+                     # and the prefill-chunk sweep's best MFU (> 0.174
+                     # bar on real hardware) is the prefill-engine claim
+                     "serving_disagg.serving_disagg_ttft_p99_ratio",
+                     "serving_disagg.serving_disagg_qps_ratio",
+                     "serving_disagg.serving_disagg_prefill_mfu")
 _REGRESSION_TOLERANCE = 0.10
 # Tracing must stay effectively free on the serving hot path: the mixed
 # point's ITL p50 with the span recorder on may exceed the untraced rerun
@@ -740,7 +780,9 @@ _TRACE_OVERHEAD_TOLERANCE = 0.10
 # v4: + serving_cluster point (replica QPS scaling + tp model-size ratio)
 # v5: + decode int4/mixed points, per-tensor-class step-bytes breakdown,
 #     decode specs carry a precision-policy string in "quantize"
-_BENCH_SCHEMA_VERSION = 5
+# v6: + serving_disagg point (disaggregated prefill/decode TTFT/QPS vs
+#     colocated at equal devices + prefill-chunk MFU sweep)
+_BENCH_SCHEMA_VERSION = 6
 
 
 def _run_metadata(platform: str, device_count: int) -> dict:
@@ -931,6 +973,8 @@ def _child_main(spec_json: str) -> None:
         out = _retry(_serving_spec_point)
     elif kind == "serving_cluster":
         out = _retry(_serving_cluster_point)
+    elif kind == "serving_disagg":
+        out = _retry(_serving_disagg_point, platform)
     else:  # pragma: no cover - parent and child ship together
         raise ValueError(f"unknown point kind {kind!r}")
     print(_CHILD_MARK + json.dumps(out), flush=True)
@@ -1139,6 +1183,10 @@ def main() -> None:
                              {"kind": "serving_cluster",
                               "platform": platform},
                              timeout_s=1800, env=cluster_env)
+    serving_disagg = _point("serving/disagg",
+                            {"kind": "serving_disagg",
+                             "platform": platform},
+                            timeout_s=1800, env=cluster_env)
 
     baseline_mfu = 0.12  # reference 890 tok/s/GPU on A100 ⇒ ~0.12 MFU
     record = {
@@ -1204,6 +1252,8 @@ def main() -> None:
         record["serving_spec"] = serving_spec
     if serving_cluster is not None:
         record["serving_cluster"] = serving_cluster
+    if serving_disagg is not None:
+        record["serving_disagg"] = serving_disagg
     if headline is not None:
         record.update({
             "value": round(mfu, 4),

@@ -287,7 +287,9 @@ class LedgerSanitizer:
 
     For every block id the expected ref count is: one ref per occupied
     slot table entry pointing at it, plus one if the prefix-cache trie
-    holds it.  The pool's actual ``_ref`` must match exactly; the free
+    holds it, plus one per in-flight shipment carrying it (disaggregated
+    prefill/decode handoff or live migration — ``BlockPool.shipments``).
+    The pool's actual ``_ref`` must match exactly; the free
     list must be duplicate-free, ref-zero, and together with the
     allocated set partition the pool; the pool's outstanding
     reservation must equal the per-slot reservation ledger.  Runs on
@@ -330,6 +332,15 @@ class LedgerSanitizer:
                 if node.bid != trash:
                     owners.setdefault(node.bid, []).append("prefix-cache")
                 stack.extend(node.children.values())
+        # in-flight shipments hold one ref per block on behalf of the
+        # (extracted, not-yet-installed-elsewhere) request: blocks owned
+        # by neither replica's slot tables are attributed here until
+        # ``end_ship`` reconciles the ledger
+        for ship in getattr(slots.pool, "shipments", {}).values():
+            label = f"shipment:{ship['request_id']}"
+            for bid in ship["bids"]:
+                if bid != trash:
+                    owners.setdefault(int(bid), []).append(label)
         return owners
 
     # -- the per-iteration check ---------------------------------------
@@ -380,6 +391,11 @@ class LedgerSanitizer:
         if int(pool._reserved) != reserved:
             fail(f"pool reservation {int(pool._reserved)} != "
                  f"{reserved} summed over slots")
+        shipments = getattr(pool, "shipments", {})
+        if len(shipments) > slots.num_slots:
+            fail(f"{len(shipments)} shipments in flight exceeds "
+                 f"{slots.num_slots} slots — shipments are not being "
+                 "reconciled (end_ship missing)")
         self.owners = owners
         self.checks += 1
 

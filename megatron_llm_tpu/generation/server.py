@@ -77,7 +77,9 @@ class GenerationService:
                  pipeline_parallel: int = 1,
                  replicas: int = 1,
                  router: bool = False,
-                 router_config=None):
+                 router_config=None,
+                 disagg: str | None = None,
+                 role: str = "mixed"):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -133,12 +135,34 @@ class GenerationService:
         self.replicas = replicas
         self.router = router
         self.router_config = router_config
+        # disaggregated prefill/decode (docs/serving.md): disagg="N:M"
+        # builds N prefill-specialized + M decode replicas behind the
+        # phase-routing router (supersedes `replicas`); `role` tags a
+        # single-engine server's role in an externally assembled cluster
+        self.disagg = self._parse_disagg(disagg)
+        self.role = role
         # the lock now guards only the legacy one-shot paths (beam search,
         # scoring, PLD); standard generation goes through the engine
         self.lock = make_lock("server.generate")
         self._engine = engine
         self._engine_init_lock = make_lock("server.engine_init")
         self._draining = False
+
+    @staticmethod
+    def _parse_disagg(disagg: str | None) -> tuple[int, int] | None:
+        if disagg is None:
+            return None
+        try:
+            n, m = (int(x) for x in str(disagg).split(":"))
+        except ValueError:
+            raise ValueError(
+                f"--disagg expects N:M (prefill:decode replicas), "
+                f"got {disagg!r}") from None
+        if n < 1 or m < 1:
+            raise ValueError(
+                f"--disagg needs at least one replica per role, "
+                f"got {disagg!r}")
+        return n, m
 
     @property
     def engine(self):
@@ -167,9 +191,22 @@ class GenerationService:
                     spec_draft_len=self.spec_draft_len,
                     spec_ngram=self.spec_ngram,
                     trace=self.trace_enabled,
+                    role=self.role,
                     **extra)
                 shards = self.tensor_parallel * self.pipeline_parallel
-                if self.router or self.replicas > 1 or shards > 1:
+                if self.disagg is not None:
+                    from ..config import ParallelConfig
+                    from ..serving import build_disagg_cluster
+
+                    n, m = self.disagg
+                    self._engine = build_disagg_cluster(
+                        self.cfg, self.params, engine_config,
+                        prefill_replicas=n, decode_replicas=m,
+                        parallel=ParallelConfig(
+                            pipeline_parallel=self.pipeline_parallel,
+                            tensor_parallel=self.tensor_parallel),
+                        router_config=self.router_config)
+                elif self.router or self.replicas > 1 or shards > 1:
                     from ..config import ParallelConfig
                     from ..serving import build_cluster
 
@@ -244,6 +281,7 @@ class GenerationService:
             return engine.snapshot()
         return {"router": None, "replicas": [{
             "id": "engine-0",
+            "role": engine.config.role,
             "alive": engine._scheduler_error is None,
             "queue_depth": len(engine.queue),
             "slots_active": (engine.slots.active_slots

@@ -450,6 +450,39 @@ def _pad_to(x, length: int, axis: int):
     return jnp.pad(x, pads)
 
 
+def prefill_block_sizes(cfg, vmem_budget_bytes: int = 8 * 1024 * 1024):
+    """Prefill-tuned ``(block_q, block_k)`` for ``flash_attention``.
+
+    Chunked-prefill serving is the compute-bound corner of attention:
+    long q AND long kv, every row live.  The default 1024/1024 grid is
+    tuned for generality; a prefill-specialized engine
+    (serving/cluster/sharded.py:build_disagg_cluster) wants the widest q
+    tile the fp32 working set allows, because each q block re-streams
+    the whole K/V once — q-tile width divides the K/V re-read traffic,
+    which is what pins long-prefill MFU below the matmul roofline.
+
+    Per (batch, head) grid step the VMEM-resident fp32 working set is
+    roughly ``block_q*d`` (q) + ``2*block_k*d`` (k, v) + ``block_q*
+    block_k`` (scores) + ``block_q*d`` (o) + O(block_q) carries.  With
+    ``block_k`` fixed at the lane-friendly 512 (256 for wide heads) we
+    solve that for ``block_q`` under ``vmem_budget_bytes`` (default 8 MB
+    — half a TPU core's ~16 MB VMEM, leaving headroom for double
+    buffering), round down to the (8, 128)-tile sublane granularity, and
+    clamp to [256, 4096].  ``flash_attention`` still clamps both to the
+    actual padded sequence, so short prompts are unaffected.  The grid
+    changes the compute schedule only — the math, and therefore the
+    tokens, are identical at any block size.
+    """
+    d = getattr(cfg, "kv_channels", 0) or (
+        cfg.hidden_size // cfg.num_attention_heads)
+    block_k = 512 if d <= 128 else 256
+    per_q_row = 4 * (2 * d + block_k)       # q + o rows, one scores row
+    fixed = 4 * (2 * block_k * d)           # k + v tiles
+    block_q = (vmem_budget_bytes - fixed) // per_q_row
+    block_q = max(256, min(4096, (block_q // 128) * 128))
+    return int(block_q), int(block_k)
+
+
 def flash_attention(
     q: jax.Array,  # [b, sq, n_heads, d]
     k: jax.Array,  # [b, sk, kv_heads, d]

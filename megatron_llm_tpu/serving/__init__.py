@@ -13,15 +13,19 @@
   latency), consumed by the repo-level ``bench.py``.
 - ``cluster/`` — multi-chip serving: engines sharded over tp submeshes
   (``cluster/sharded.py``) behind a replicated health-aware router with
-  drain-based failover (``cluster/router.py``); see docs/serving.md,
-  'Multi-chip serving'.
+  drain-based failover (``cluster/router.py``), plus disaggregated
+  prefill/decode — prefill-specialized replicas shipping paged KV
+  blocks to decode replicas, with live decode migration
+  (``build_disagg_cluster``); see docs/serving.md, 'Multi-chip serving'
+  and 'Disaggregated prefill/decode'.
 """
 
 from .cluster import Router, RouterConfig, RouterHandle, build_cluster, \
-    build_sharded_engine
+    build_disagg_cluster, build_sharded_engine
 from .engine import (
     EngineConfig,
     FinishedRequest,
+    KVShipment,
     RequestHandle,
     ServingEngine,
 )
@@ -36,8 +40,10 @@ __all__ = [
     "RouterConfig",
     "RouterHandle",
     "build_cluster",
+    "build_disagg_cluster",
     "build_sharded_engine",
     "FinishedRequest",
+    "KVShipment",
     "LatencyHistogram",
     "PrefixCache",
     "PrefixLease",

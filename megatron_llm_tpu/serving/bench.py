@@ -661,6 +661,188 @@ def run_cluster_serving_bench(cfg, params, *, num_requests: int = 16,
     }
 
 
+def _fwd_flops_per_token(cfg, seq_len: int) -> float:
+    """Forward-pass FLOPs/token (the repo ``bench.py`` training count
+    without the 3x fwd+bwd factor) for prefill MFU normalization."""
+    h = cfg.hidden_size
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    nkv = cfg.kv_heads
+    ffn = cfg.ffn_size
+    n_mlp = 3 if cfg.is_glu else 2
+    per_layer = (
+        2 * h * (nq * d) + 2 * 2 * h * (nkv * d) + 2 * (nq * d) * h
+        + n_mlp * 2 * h * ffn
+        + 2 * 2 * nq * d * seq_len  # scores + context, causal-halved ×2
+    )
+    return cfg.num_layers * per_layer + 2 * h * cfg.padded_vocab_size()
+
+
+def run_disagg_serving_bench(cfg, params, *, num_requests: int = 16,
+                             gen_len: int = 32, slots: int = 4,
+                             prompt_len: int = 256,
+                             prefill_chunk: int = 64,
+                             chunk_sweep: tuple = (64, 128, 256, 512),
+                             seed: int = 0,
+                             peak_flops: float = 197e12) -> dict:
+    """Disaggregated prefill/decode point (serving/cluster/,
+    docs/serving.md "Disaggregated prefill/decode"): the two claims the
+    disaggregation subsystem makes, at EQUAL device count.
+
+    - **TTFT under prefill-heavy traffic** — the same long-prompt wave
+      through ``build_disagg_cluster`` (1 prefill + 1 decode replica)
+      vs ``build_cluster`` (2 colocated mixed replicas) on the same
+      device split.  Colocated engines interleave admission prefills
+      with active decode iterations, so long admissions stretch the
+      decode tail AND queue behind it; the disaggregated prefill engine
+      runs admissions back-to-back and ships finished KV blocks out.
+      Headlines: ``serving_disagg_ttft_p99_ratio`` (colocated p99 /
+      disagg p99 — above 1 means disaggregation wins the tail) and
+      ``serving_disagg_qps_ratio`` (disagg / colocated).  NOTE: under
+      the CPU device-count simulation every "device" shares the host's
+      physical cores, so both ratios only track plumbing cost there —
+      the scaling claims are only meaningful on hardware where the two
+      replicas own disjoint compute.
+    - **prefill MFU vs chunk size** — one engine driven with
+      max_new_tokens=1 requests across a ``prefill_chunk`` sweep (the
+      chunk is the tokens-per-device-step prefill batch, the knob a
+      prefill-specialized engine turns up).  ``prefill_mfu_vs_batch``
+      carries the curve; the scalar ``serving_disagg_prefill_mfu`` (its
+      max) gates in --compare (acceptance bar > 0.174 — above the
+      repo's training MFU headline — on real hardware).
+
+    TTFT is host-observed per request (submit -> first streamed token)
+    so the shipping hop is inside the measured window.  Tokens are
+    bitwise invariant to the disagg toggle (tests/serving/
+    test_disagg.py), so both cluster runs do identical per-request
+    work.
+    """
+    import numpy as np
+
+    from ..config import ParallelConfig
+    from .cluster import build_cluster, build_disagg_cluster
+    from .engine import EngineConfig, ServingEngine
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(num_requests)]
+    chunk = min(prefill_chunk, prompt_len)
+    ec = EngineConfig(
+        max_batch_size=slots,
+        max_seq_len=min(prompt_len + gen_len, cfg.max_position_embeddings),
+        max_queue_size=max(num_requests, slots),
+        prefill_bucket=chunk,
+        prefill_chunk=chunk,
+        pipeline_decode=True,
+    )
+
+    def one_run(build) -> dict:
+        router = build().start()
+        ttfts: list = []
+        lock = make_lock("bench.disagg.ttft")
+
+        def make_stream(t_submit):
+            seen = [False]
+
+            def on_token(_tok):
+                if not seen[0]:
+                    seen[0] = True
+                    with lock:
+                        ttfts.append(time.perf_counter() - t_submit)
+            return on_token
+
+        try:
+            # warmup: two requests compile every executable on both
+            # replicas outside the window.  Colocated: least-loaded
+            # dispatch spreads the idle-cluster pair one per replica.
+            # Disagg: phase routing sends both through the prefill
+            # replica, which ships to the decode replica — one pass
+            # compiles prefill + export on one side, import + decode on
+            # the other.
+            warm = router.submit_many([
+                dict(prompt=prompts[0], max_new_tokens=2,
+                     use_eos_stop=False, seed=0) for _ in range(2)])
+            for h in warm:
+                h.result(timeout=600)
+
+            t0 = time.perf_counter()
+            handles = [router.submit(
+                p, max_new_tokens=gen_len, use_eos_stop=False, seed=i,
+                on_token=make_stream(time.perf_counter()))
+                for i, p in enumerate(prompts)]
+            results = [h.result(timeout=600) for h in handles]
+            dt = time.perf_counter() - t0
+            snap = router.snapshot()
+        finally:
+            router.shutdown()
+        n_tokens = sum(len(r.tokens) - r.prompt_len for r in results)
+        return {
+            "qps": round(num_requests / dt, 3),
+            "tokens_per_sec": round(n_tokens / dt, 1),
+            "ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1e3, 2),
+            "snap": snap,
+        }
+
+    disagg = one_run(lambda: build_disagg_cluster(
+        cfg, params, ec, prefill_replicas=1, decode_replicas=1,
+        parallel=ParallelConfig()))
+    coloc = one_run(lambda: build_cluster(
+        cfg, params, ec, replicas=2, parallel=ParallelConfig()))
+
+    def prefill_point(c: int) -> dict:
+        engine = ServingEngine(cfg, params, EngineConfig(
+            max_batch_size=slots,
+            max_seq_len=min(prompt_len + 8, cfg.max_position_embeddings),
+            max_queue_size=max(slots, 2),
+            prefill_bucket=c,
+            prefill_chunk=c,
+        )).start()
+        try:
+            engine.submit(prompts[0], max_new_tokens=1,
+                          use_eos_stop=False).result(timeout=600)
+            t0 = time.perf_counter()
+            hs = [engine.submit(p, max_new_tokens=1, use_eos_stop=False)
+                  for p in prompts[:slots]]
+            for h in hs:
+                h.result(timeout=600)
+            dt = time.perf_counter() - t0
+        finally:
+            engine.shutdown()
+        tps = slots * prompt_len / dt
+        mfu = tps * _fwd_flops_per_token(cfg, prompt_len) / peak_flops
+        return {"prefill_chunk": c,
+                "prefill_tokens_per_sec": round(tps, 1),
+                "prefill_mfu": round(mfu, 4)}
+
+    sweep = [prefill_point(c)
+             for c in sorted({min(int(c), prompt_len)
+                              for c in chunk_sweep})]
+    r = disagg["snap"]["router"]
+    return {
+        "serving_disagg_qps": disagg["qps"],
+        "serving_disagg_coloc_qps": coloc["qps"],
+        "serving_disagg_qps_ratio": round(
+            disagg["qps"] / max(1e-9, coloc["qps"]), 3),
+        "serving_disagg_ttft_ms_p99": disagg["ttft_p99_ms"],
+        "serving_disagg_coloc_ttft_ms_p99": coloc["ttft_p99_ms"],
+        "serving_disagg_ttft_p99_ratio": round(
+            coloc["ttft_p99_ms"] / max(1e-9, disagg["ttft_p99_ms"]), 3),
+        "serving_disagg_tokens_per_sec": disagg["tokens_per_sec"],
+        "serving_disagg_coloc_tokens_per_sec": coloc["tokens_per_sec"],
+        "serving_disagg_ships": r["ships_total"],
+        "serving_disagg_ship_bytes": r["ship_bytes_total"],
+        "serving_disagg_shipments_in_flight":
+            len(disagg["snap"]["shipments_in_flight"]),
+        "serving_disagg_prefill_mfu": max(s["prefill_mfu"] for s in sweep),
+        "prefill_mfu_vs_batch": sweep,
+        "serving_disagg_num_requests": num_requests,
+        "serving_disagg_slots": slots,
+        "serving_disagg_prompt_len": prompt_len,
+        "serving_disagg_gen_len": gen_len,
+        "serving_disagg_prefill_chunk": chunk,
+    }
+
+
 def main() -> None:
     """Smoke run on the tiny test config (CPU-safe)."""
     import json
@@ -693,6 +875,11 @@ def main() -> None:
                                              gen_len=8, slots=2,
                                              max_prompt_len=32,
                                              replicas=2, tp=2))
+        out.update(run_disagg_serving_bench(cfg, params, num_requests=6,
+                                            gen_len=8, slots=2,
+                                            prompt_len=64,
+                                            prefill_chunk=16,
+                                            chunk_sweep=(16, 32, 64)))
     print(json.dumps(out))
 
 

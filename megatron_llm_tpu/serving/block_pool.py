@@ -5,8 +5,13 @@ block_size, head_dim]`` — the ``init_kv_cache`` layout family with the
 batch axis reinterpreted as a block axis, so the int8 ``{"q", "scale"}``
 quantized-cache form works verbatim.  All allocation state (free list,
 ref counts, reservations) lives on the host as plain numpy; the device
-arrays never change shape, so every consumer compiles exactly once and
-only the integer block tables vary between steps.
+arrays never change *shape*, so every consumer compiles exactly once and
+only the integer block tables vary between steps.  Block *contents* can
+leave the pool: ``export_blocks`` / ``import_blocks`` move a block-table-
+ordered slice between pools (possibly on different submeshes) for
+disaggregated prefill/decode and live migration (docs/serving.md,
+"Disaggregated prefill/decode") — the fixed arity keeps both sides on
+one compiled executable each.
 
 Conventions:
 
@@ -33,7 +38,7 @@ Conventions:
 from __future__ import annotations
 
 import functools
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +63,26 @@ def _copy_block_plain(pool, src, dst):
         return jax.lax.dynamic_update_slice_in_dim(a, blk, dst, axis=1)
 
     return jax.tree.map(cp, pool)
+
+
+@jax.jit
+def _export_gather(k_pool, v_pool, table):
+    # table [1, T]: a one-row block table — the dense leaves come back in
+    # *table order* ([L, 1, kv, T*bk(, d)]), pad entries reading trash.
+    return (model_lib.cache_gather_blocks(k_pool, table),
+            model_lib.cache_gather_blocks(v_pool, table))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _import_scatter_donated(k_pool, v_pool, k_dense, v_dense, scatter):
+    return (model_lib.cache_scatter_blocks(k_pool, k_dense, scatter),
+            model_lib.cache_scatter_blocks(v_pool, v_dense, scatter))
+
+
+@jax.jit
+def _import_scatter_plain(k_pool, v_pool, k_dense, v_dense, scatter):
+    return (model_lib.cache_scatter_blocks(k_pool, k_dense, scatter),
+            model_lib.cache_scatter_blocks(v_pool, v_dense, scatter))
 
 
 class BlockPool:
@@ -89,7 +114,15 @@ class BlockPool:
         self._copy = (_copy_block_plain
                       if jax.default_backend() == "cpu"
                       else _copy_block_donated)
+        self._import = (_import_scatter_plain
+                        if jax.default_backend() == "cpu"
+                        else _import_scatter_donated)
         self.cow_copies = 0
+        # in-flight shipments: ship_id -> {"request_id", "bids", "nbytes"}.
+        # Each recorded block holds one ref on behalf of the shipment so
+        # the blocks cannot be recycled (and the LedgerSanitizer can
+        # attribute them) while the transfer is in flight.
+        self.shipments: dict = {}
 
     def place(self, mesh) -> None:
         """Re-place the pool arrays onto a serving submesh, kv heads
@@ -194,6 +227,71 @@ class BlockPool:
         return new
 
     # ------------------------------------------------------------------
+    # cross-pool shipping (disaggregated prefill/decode, live migration)
+    # ------------------------------------------------------------------
+    def export_blocks(self, bids: Sequence[int], arity: int):
+        """Gather ``bids`` into dense table-ordered leaves for shipping.
+
+        ``arity`` is the fixed table width (the engine's
+        ``slots.table_blocks``) so every export compiles exactly once per
+        pool shape; positions beyond ``len(bids)`` read the trash block.
+        Leaves come back verbatim in the pool's own dtypes — int8
+        ``{"q", "scale"}`` ships quantized, never dequantized.  Returns
+        ``(k_dense, v_dense)`` with leaves ``[L, 1, kv, arity*bk(, d)]``.
+        """
+        assert len(bids) <= arity
+        table = np.full((1, arity), self.TRASH, dtype=np.int32)
+        table[0, :len(bids)] = np.asarray(bids, dtype=np.int32)
+        return _export_gather(self.k_pool, self.v_pool, table)
+
+    def import_blocks(self, k_dense, v_dense, scatter) -> None:
+        """Scatter shipped dense leaves into this pool's blocks.
+
+        ``scatter`` is a full-arity int32 vector mapping each dense
+        column group to a destination block id (trash for pad columns —
+        those columns carry the source pool's trash garbage and land
+        harmlessly in this pool's trash block).  The dense leaves may
+        live on a *different* submesh: each leaf is first re-placed onto
+        the matching pool leaf's sharding via ``jax.device_put`` (a
+        resharding copy), then written by the same fixed-arity scatter
+        admission uses.  Block contents transfer bitwise — no dequantize
+        round trip for int8 ``{"q", "scale"}`` leaves.
+        """
+        k_dense = jax.tree.map(
+            lambda d, p: jax.device_put(d, p.sharding), k_dense, self.k_pool)
+        v_dense = jax.tree.map(
+            lambda d, p: jax.device_put(d, p.sharding), v_dense, self.v_pool)
+        self.k_pool, self.v_pool = self._import(
+            self.k_pool, self.v_pool, k_dense, v_dense,
+            np.ascontiguousarray(np.asarray(scatter, dtype=np.int32)))
+
+    def begin_ship(self, ship_id: str, request_id: str,
+                   bids: Sequence[int], nbytes: int) -> None:
+        """Open a shipment: take one ref per block on the shipment's
+        behalf and record it in the in-flight ledger.
+
+        Called *before* the source slot releases its table refs, so the
+        blocks' counts never touch zero mid-transfer — the handoff is
+        atomic from the ledger's point of view and the LedgerSanitizer
+        attributes the refs to ``shipment:<request_id>`` until
+        ``end_ship`` reconciles them."""
+        assert ship_id not in self.shipments
+        for bid in bids:
+            self.incref(int(bid))
+        self.shipments[ship_id] = {
+            "request_id": request_id,
+            "bids": [int(b) for b in bids],
+            "nbytes": int(nbytes),
+        }
+
+    def end_ship(self, ship_id: str) -> None:
+        """Close a shipment: drop the shipment's refs (freeing blocks no
+        table still points at) and reconcile the in-flight ledger."""
+        ship = self.shipments.pop(ship_id)
+        for bid in ship["bids"]:
+            self.decref(bid)
+
+    # ------------------------------------------------------------------
     # introspection
     # ------------------------------------------------------------------
     def stats(self) -> dict:
@@ -207,6 +305,7 @@ class BlockPool:
             "blocks_reserved": self._reserved,
             "kv_cache_util": (used / usable) if usable else 0.0,
             "cow_copies": self.cow_copies,
+            "shipments_in_flight": len(self.shipments),
         }
 
     def ref_counts(self) -> dict:

@@ -14,15 +14,22 @@ Two independent layers (the sharded-worker / replicated-frontend split):
 
 ``sharded.build_cluster`` composes the two: N replicas on disjoint
 device slices (parallel/mesh.py:replica_submeshes) behind one Router.
+``sharded.build_disagg_cluster`` specializes the replicas by phase —
+prefill-role engines ship each request's KV blocks to decode-role
+engines after the prefill (disaggregated prefill/decode) — and the
+Router routes by phase, tracks in-flight shipments, and live-migrates
+decodes with the same block-shipping primitive.
 """
 
 from .router import Router, RouterConfig, RouterHandle
-from .sharded import build_cluster, build_sharded_engine
+from .sharded import (build_cluster, build_disagg_cluster,
+                      build_sharded_engine)
 
 __all__ = [
     "Router",
     "RouterConfig",
     "RouterHandle",
     "build_cluster",
+    "build_disagg_cluster",
     "build_sharded_engine",
 ]

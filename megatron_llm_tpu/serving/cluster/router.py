@@ -32,12 +32,28 @@ Failover reuses the engine's own machinery:
   client already received are suppressed by count, making the client-
   visible stream bitwise-equal to an uninterrupted run.
 
+The router is also the control plane for **disaggregated
+prefill/decode** (docs/serving.md): replicas carry a *role* from their
+``EngineConfig`` — prefill-specialized engines get a ship handler
+installed here, so after each prefill (+ first token) they hand the
+request's KV blocks to ``_dispatch_shipment``, which installs them on
+the least-loaded decode-capable replica and repoints the routed record;
+``_pick`` routes *new* requests away from decode-specialized replicas
+(phase routing).  The same block-shipping primitive powers
+``migrate_request``: live rebalancing of an in-flight decode.  Unlike
+drain/replay failover, a ship moves the live request object — token
+lists, RNG seed + fold counter, stream callback — so nothing is
+regenerated and the client stream is bitwise-continuous by
+construction, with in-flight shipments tracked in ``_shipments`` (and
+attributed by the LedgerSanitizer via the pool's shipment ledger).
+
 Every router lock comes from ``analysis.sanitizers.make_lock`` so the
 lock-order cycle detector covers the router ↔ engine interleavings, and
 every hop is correlated by the engine-assigned ``request_id`` in both
 EVENT_LOG lines (``routed`` / ``replica_draining`` /
-``replica_drained`` / ``replica_dead`` / ``resubmitted``) and router
-trace spans (``route`` / ``failover`` / ``drain``).
+``replica_drained`` / ``replica_dead`` / ``resubmitted`` / ``shipped``
+/ ``migrated``) and router trace spans (``route`` / ``failover`` /
+``drain`` / ``ship`` / ``migrate``).
 """
 
 from __future__ import annotations
@@ -53,7 +69,8 @@ from ...obs import REGISTRY
 from ...obs.logging import EVENT_LOG
 from ...obs.registry import MetricFamily
 from ...obs.trace import TraceRecorder
-from ..engine import FinishedRequest, RequestHandle, ServingEngine
+from ..engine import (FinishedRequest, KVShipment, RequestHandle,
+                      ServingEngine)
 from ..queue import QueueFull
 
 
@@ -79,6 +96,12 @@ class Replica:
         self.dispatched = 0
         self.completed = 0
 
+    @property
+    def role(self) -> str:
+        """Engine role in a disaggregated cluster: "prefill" | "decode"
+        | "mixed" (EngineConfig.role)."""
+        return self.engine.config.role
+
     def alive(self) -> bool:
         e = self.engine
         if self.dead or e._scheduler_error is not None:
@@ -103,6 +126,7 @@ class Replica:
              else {"blocks_free": None, "blocks_used": None})
         return {
             "id": self.id,
+            "role": self.role,
             "alive": self.alive(),
             "healthy": self.healthy(max_burn),
             "draining": self.draining,
@@ -209,6 +233,16 @@ class Router:
         self.resubmitted_total = 0
         self.failovers_total = 0
         self.completed_total = 0
+        self.ships_total = 0          # prefill → decode KV handoffs
+        self.migrations_total = 0     # live decode rebalances
+        self.ship_bytes_total = 0     # dense KV payload moved (both kinds)
+        self._shipments: dict[str, dict] = {}  # ship_id -> in-flight entry
+        # disaggregation: prefill-role engines hand each finished prefill's
+        # KV blocks to the router for placement on a decode replica
+        for r in self.replicas:
+            if r.role == "prefill":
+                r.engine.set_ship_handler(
+                    lambda ship, _src=r: self._dispatch_shipment(ship, _src))
         self.metrics = _RouterMetrics(self)
         REGISTRY.register_collector("cluster", self.metrics.collect)
 
@@ -301,9 +335,16 @@ class Router:
         return RouterHandle(self, rr)
 
     def _pick(self, sticky_key: Optional[str]) -> Optional[Replica]:
-        """Least-loaded usable replica (router lock held)."""
+        """Least-loaded usable replica (router lock held).
+
+        Phase routing: a new (or resubmitted) request starts with its
+        prefill, so decode-specialized replicas are a last resort — they
+        only take fresh work when no prefill-capable replica is usable."""
         usable = [r for r in self.replicas
                   if not r.draining and r.alive()]
+        front = [r for r in usable if r.role != "decode"]
+        if front:
+            usable = front
         if not usable:
             return None
         if sticky_key is not None and self.config.sticky:
@@ -311,6 +352,20 @@ class Router:
             for r in usable:
                 if r.id == rid:
                     return r
+        burn = self.config.slo_max_burn
+        return min(usable,
+                   key=lambda r: (not r.healthy(burn),) + r.load())
+
+    def _pick_decode(self,
+                     exclude: Optional[Replica] = None) -> Optional[Replica]:
+        """Least-loaded usable decode-capable replica for a KV shipment
+        (router lock held); prefill-specialized replicas never receive
+        shipments."""
+        usable = [r for r in self.replicas
+                  if not r.draining and r.alive() and r is not exclude
+                  and r.role != "prefill"]
+        if not usable:
+            return None
         burn = self.config.slo_max_burn
         return min(usable,
                    key=lambda r: (not r.healthy(burn),) + r.load())
@@ -454,6 +509,145 @@ class Router:
                 return r
         raise KeyError(f"unknown replica {replica_id!r}")
 
+    # -- KV-block shipping: prefill handoff + live migration ---------------
+
+    def _dispatch_shipment(self, ship: KVShipment, src: Replica) -> None:
+        """Ship handler for prefill-role replicas.  Runs ON the source
+        engine's scheduler thread right after a prefill committed its
+        first token: picks a decode-capable replica, installs the
+        shipment there (``call_in_scheduler`` — the destination's
+        scheduler adopts the blocks between its own iterations),
+        reconciles the source ledger via ``end_ship``, and repoints the
+        routed record so the client's stream keeps flowing.  Any failure
+        falls back to reinstalling on the source, which cannot fail: the
+        slot and block capacity were just freed there and the shipment's
+        refs still pin the original blocks."""
+        t0 = time.perf_counter()
+        req = ship.meta["req"]
+        with self._lock:
+            target = self._pick_decode(exclude=src)
+            if target is not None:
+                self._shipments[ship.ship_id] = {
+                    "ship_id": ship.ship_id, "kind": "prefill_handoff",
+                    "request_id": ship.request_id, "from": src.id,
+                    "to": target.id, "blocks": ship.n_live,
+                    "bytes": ship.nbytes}
+        if target is None:  # no decode replica usable: decode locally
+            src.engine.install_shipment(ship)
+            src.engine.slots.pool.end_ship(ship.ship_id)
+            return
+        try:
+            target.engine.call_in_scheduler(
+                lambda: target.engine.install_shipment(ship))
+        except Exception as e:  # noqa: BLE001 — dest full/dead: keep local
+            with self._lock:
+                self._shipments.pop(ship.ship_id, None)
+            src.engine.install_shipment(ship)
+            src.engine.slots.pool.end_ship(ship.ship_id)
+            EVENT_LOG.emit("router", "ship_failed",
+                           request_id=ship.request_id, from_replica=src.id,
+                           to_replica=target.id, error=repr(e))
+            return
+        src.engine.slots.pool.end_ship(ship.ship_id)
+        with self._lock:
+            self._shipments.pop(ship.ship_id, None)
+            self.ships_total += 1
+            self.ship_bytes_total += ship.nbytes
+            for rr in self._pending.values():
+                if rr.handle._req is req:
+                    rr.replica = target
+                    target.dispatched += 1
+                    break
+        self.trace.add("ship", t0, time.perf_counter(),
+                       request_id=ship.request_id, tid=req.id,
+                       args={"from": src.id, "to": target.id,
+                             "blocks": ship.n_live, "bytes": ship.nbytes})
+        EVENT_LOG.emit("router", "shipped", request_id=ship.request_id,
+                       from_replica=src.id, to_replica=target.id,
+                       blocks=ship.n_live, bytes=ship.nbytes)
+
+    def migrate_request(self, request,
+                        to_replica_id: Optional[str] = None,
+                        timeout: float = 30.0) -> bool:
+        """Live-migrate an actively decoding request to another replica.
+
+        ``request`` is a :class:`RouterHandle` or an engine ``rid``
+        string; ``to_replica_id`` picks the destination explicitly
+        (rebalancing policies / tests), else the least-loaded
+        decode-capable replica.  The request's KV blocks move verbatim
+        and the live request object — generated tokens, RNG fold
+        counter, stream callback — moves with them, so the continued
+        decode is bitwise the trajectory the source would have produced
+        and the client stream never replays or drops a token (no
+        delivered-count suppression needed, unlike failover).  Returns
+        False when the request is not in a migratable state (queued,
+        mid-prefill, finished, or finishing during the extract) or no
+        destination is usable; the request keeps decoding at home in
+        every False case."""
+        self.start()
+        rr = self._resolve(request)
+        if rr is None or rr.done_event.is_set():
+            return False
+        src = rr.replica
+        with self._lock:
+            dst = (self._replica(to_replica_id)
+                   if to_replica_id is not None
+                   else self._pick_decode(exclude=src))
+        if dst is None or dst is src or dst.draining or not dst.alive():
+            return False
+        req = rr.handle._req
+        t0 = time.perf_counter()
+        ship = src.engine.call_in_scheduler(
+            lambda: src.engine.extract_request(req), timeout)
+        if ship is None:
+            return False
+        with self._lock:
+            self._shipments[ship.ship_id] = {
+                "ship_id": ship.ship_id, "kind": "migration",
+                "request_id": ship.request_id, "from": src.id,
+                "to": dst.id, "blocks": ship.n_live, "bytes": ship.nbytes}
+        try:
+            dst.engine.call_in_scheduler(
+                lambda: dst.engine.install_shipment(ship), timeout)
+        except Exception as e:  # noqa: BLE001 — reinstall at home (the
+            # capacity was just freed there, so this cannot fail)
+            src.engine.call_in_scheduler(
+                lambda: src.engine.install_shipment(ship), timeout)
+            src.engine.call_in_scheduler(
+                lambda: src.engine.slots.pool.end_ship(ship.ship_id),
+                timeout)
+            with self._lock:
+                self._shipments.pop(ship.ship_id, None)
+            EVENT_LOG.emit("router", "migrate_failed",
+                           request_id=ship.request_id, from_replica=src.id,
+                           to_replica=dst.id, error=repr(e))
+            return False
+        src.engine.call_in_scheduler(
+            lambda: src.engine.slots.pool.end_ship(ship.ship_id), timeout)
+        with self._lock:
+            self._shipments.pop(ship.ship_id, None)
+            self.migrations_total += 1
+            self.ship_bytes_total += ship.nbytes
+            rr.replica = dst
+            dst.dispatched += 1
+        self.trace.add("migrate", t0, time.perf_counter(),
+                       request_id=ship.request_id, tid=req.id,
+                       args={"from": src.id, "to": dst.id,
+                             "blocks": ship.n_live, "bytes": ship.nbytes})
+        EVENT_LOG.emit("router", "migrated", request_id=ship.request_id,
+                       from_replica=src.id, to_replica=dst.id,
+                       blocks=ship.n_live, bytes=ship.nbytes)
+        return True
+
+    def _resolve(self, request) -> Optional[_Routed]:
+        if isinstance(request, RouterHandle):
+            return request._rr
+        with self._lock:
+            for rr in self._pending.values():
+                if rr.handle.rid == request:
+                    return rr
+        return None
+
     # -- health probe thread ----------------------------------------------
 
     def _probe_loop(self) -> None:
@@ -489,19 +683,27 @@ class Router:
 
     def snapshot(self) -> dict:
         burn = self.config.slo_max_burn
+        roles: dict[str, int] = {}
+        for r in self.replicas:
+            roles[r.role] = roles.get(r.role, 0) + 1
         return {
             "router": {
                 "replicas": len(self.replicas),
                 "usable": sum(1 for r in self.replicas
                               if not r.draining and r.alive()),
+                "roles": roles,
                 "draining": self._draining,
                 "routed_total": self.routed_total,
                 "resubmitted_total": self.resubmitted_total,
                 "failovers_total": self.failovers_total,
                 "completed_total": self.completed_total,
+                "ships_total": self.ships_total,
+                "migrations_total": self.migrations_total,
+                "ship_bytes_total": self.ship_bytes_total,
                 "pending": len(self._pending),
                 "sticky_keys": len(self._sticky),
             },
+            "shipments_in_flight": list(self._shipments.values()),
             "replicas": [r.probe(burn) for r in self.replicas],
         }
 
@@ -564,10 +766,29 @@ class _RouterMetrics:
                          "failover decisions").add(r.failovers_total),
             MetricFamily("cluster_completed_total", "counter",
                          "requests completed").add(r.completed_total),
+            MetricFamily("cluster_ships_total", "counter",
+                         "prefill->decode KV-block shipments"
+                         ).add(r.ships_total),
+            MetricFamily("cluster_migrations_total", "counter",
+                         "live decode migrations").add(r.migrations_total),
+            MetricFamily("cluster_ship_bytes_total", "counter",
+                         "dense KV bytes shipped between replicas"
+                         ).add(r.ship_bytes_total),
+            MetricFamily("cluster_shipments_in_flight", "gauge",
+                         "KV shipments currently owned by neither replica"
+                         ).add(len(r._shipments)),
         ]
         qd = MetricFamily("cluster_replica_queue_depth", "gauge",
                           "per-replica queue depth")
         for rep in r.replicas:
             qd.add(len(rep.engine.queue), labels={"replica": rep.id})
         fams.append(qd)
+        roles: dict[str, int] = {}
+        for rep in r.replicas:
+            roles[rep.role] = roles.get(rep.role, 0) + 1
+        by_role = MetricFamily("cluster_replicas_by_role", "gauge",
+                               "replicas per engine role")
+        for role, n in sorted(roles.items()):
+            by_role.add(n, labels={"role": role})
+        fams.append(by_role)
         return fams

@@ -97,3 +97,58 @@ def build_cluster(cfg: ModelConfig, params,
                 metrics=ServingMetrics(engine_config.max_batch_size,
                                        register=False)))
     return Router(engines, router_config or RouterConfig())
+
+
+def build_disagg_cluster(cfg: ModelConfig, params,
+                         engine_config: Optional[EngineConfig] = None,
+                         *, prefill_replicas: int = 1,
+                         decode_replicas: int = 1,
+                         parallel: Optional[ParallelConfig] = None,
+                         router_config=None,
+                         devices: Optional[Sequence[jax.Device]] = None):
+    """Disaggregated prefill/decode cluster: ``prefill_replicas``
+    prefill-specialized engines + ``decode_replicas`` decode engines on
+    disjoint device slices behind one phase-routing Router
+    (docs/serving.md, "Disaggregated prefill/decode").
+
+    The prefill replicas run with ``role="prefill"`` — the router routes
+    every new request to them, and after the prefill (+ first token)
+    they ship the request's KV blocks to a decode replica via
+    ``BlockPool.export_blocks`` / ``import_blocks``.  When the model
+    runs the flash-attention path, prefill replicas additionally get a
+    prefill-tuned grid (``kernels.flash_attention.prefill_block_sizes``)
+    — wider q tiles for the compute-bound long-sequence regime.  The
+    grid only shapes the attention *schedule*, never its math, but it is
+    applied strictly per-role so the dot-product fallback configs stay
+    byte-identical across roles.
+    """
+    import dataclasses as _dc
+
+    from ...parallel import mesh as mesh_lib
+    from .router import Router, RouterConfig
+
+    assert prefill_replicas >= 1 and decode_replicas >= 1, (
+        "a disaggregated cluster needs at least one prefill and one "
+        "decode replica (use build_cluster for colocated serving)")
+    parallel = parallel or ParallelConfig()
+    engine_config = engine_config or EngineConfig()
+    if devices is None:
+        devices = jax.devices()
+    total = prefill_replicas + decode_replicas
+    meshes = mesh_lib.replica_submeshes(parallel, total, devices=devices)
+    prefill_cfg = cfg
+    if cfg.attention_impl == "flash":
+        from ...kernels.flash_attention import prefill_block_sizes
+
+        bq, bk = prefill_block_sizes(cfg)
+        prefill_cfg = _dc.replace(cfg, flash_block_q=bq, flash_block_k=bk)
+    engines = []
+    for i, mesh in enumerate(meshes):
+        is_prefill = i < prefill_replicas
+        ec = _dc.replace(engine_config,
+                         role="prefill" if is_prefill else "decode")
+        engines.append(build_sharded_engine(
+            prefill_cfg if is_prefill else cfg, params, ec, parallel,
+            devices=mesh.devices.flatten().tolist(),
+            metrics=ServingMetrics(ec.max_batch_size, register=False)))
+    return Router(engines, router_config or RouterConfig())

@@ -215,6 +215,16 @@ class EngineConfig:
     #                               Costs one host pass over the slot
     #                               tables per iteration — tests/debug
     #                               only, default off.
+    role: str = "mixed"           # disaggregated prefill/decode
+    #                               (docs/serving.md): "prefill" runs a
+    #                               request's prefill + first token, then
+    #                               ships its KV blocks to a decode-role
+    #                               replica via the router's ship handler
+    #                               (falling back to decoding locally when
+    #                               no handler / no destination);
+    #                               "decode" engines receive shipments and
+    #                               run decode; "mixed" (default) does
+    #                               both and never initiates a ship.
 
 
 @dataclasses.dataclass
@@ -224,6 +234,34 @@ class FinishedRequest:
     finish_reason: str            # "eos" | "length" | "cancelled" |
     #                               "timeout" | "error"
     logprobs: Optional[List[float]] = None  # [len-1] incl. prompt positions
+
+
+@dataclasses.dataclass
+class KVShipment:
+    """A request's KV blocks + scheduling state in flight between engines.
+
+    Produced by ``ServingEngine.extract_request`` on the source scheduler
+    thread, consumed by ``install_shipment`` on the destination's.  The
+    dense leaves are table-ordered (``BlockPool.export_blocks``) and stay
+    in the pool's own dtypes — int8 ``{"q", "scale"}`` ships quantized.
+    ``meta["req"]`` is the live ``_Request`` itself (token lists, RNG
+    seed + fold counter, stream callback, done event), so the client's
+    stream continues bitwise across the move: the per-request RNG folds
+    on the request's own counter, never on slot or batch identity.
+    The source pool's ``shipments`` ledger holds one ref per block until
+    the owner of the shipment calls ``end_ship`` (router.py)."""
+    ship_id: str
+    request_id: str
+    k_dense: object
+    v_dense: object
+    bids: List[int]               # source block ids, table order
+    n_live: int                   # = len(bids)
+    nbytes: int                   # dense payload size (ship_bytes metric)
+    meta: dict                    # fill/count/pending/spec state + req
+
+
+# process-global so ship ids stay unique across every engine in a cluster
+_SHIP_IDS = iter(range(1, 1 << 62))
 
 
 class _Request:
@@ -674,6 +712,18 @@ class ServingEngine:
         #                              paused-loop wakeups
         self._drain_cond = sanitizers.make_condition("engine.drain")
         #                              drain() wakeups
+        assert self.config.role in ("mixed", "prefill", "decode"), \
+            f"unknown engine role {self.config.role!r}"
+        # control ops: closures other threads (the router) need the
+        # scheduler thread to run between iterations — shipment installs,
+        # extractions for migration.  Drained at the top of every loop
+        # iteration, including while paused/draining.
+        self._control: List = []
+        self._control_lock = sanitizers.make_lock("engine.control")
+        # router-installed callback a prefill-role engine hands finished
+        # prefills to: handler(KVShipment) ships the blocks to a decode
+        # replica (serving/cluster/router.py:_dispatch_shipment)
+        self._ship_handler: Optional[Callable] = None
         # device/host overlap accounting (metrics.observe_step_breakdown)
         self._last_dispatch_t: Optional[float] = None
         self._last_ready_t: Optional[float] = None
@@ -881,6 +931,53 @@ class ServingEngine:
             self._finish(req, "cancelled")
             self.metrics.set_gauges(queue_depth=len(self.queue))
 
+    # -- control ops (cross-thread -> scheduler thread) --------------------
+
+    def set_ship_handler(self, handler: Optional[Callable]) -> None:
+        """Install the router's shipment dispatcher.  A prefill-role
+        engine calls it (on the scheduler thread) with each finished
+        prefill's :class:`KVShipment`; the handler owns the shipment's
+        lifecycle — install on a decode replica, or reinstall here on
+        failure — and must call ``pool.end_ship`` when done."""
+        self._ship_handler = handler
+
+    def call_in_scheduler(self, fn: Callable, timeout: float = 30.0):
+        """Run ``fn()`` on the scheduler thread and return its result.
+
+        All slot/pool/table state is owned by the scheduler thread; the
+        router uses this to install shipments and extract requests
+        without adding locks to the hot path.  Called *from* the
+        scheduler thread it runs inline (so a prefill engine's ship
+        handler can reinstall locally on failure without deadlocking).
+        Exceptions propagate to the caller — they never touch the
+        scheduler's own crash handler."""
+        if threading.current_thread() is self._thread:
+            return fn()
+        if self._thread is None or not self._thread.is_alive():
+            raise RuntimeError("engine scheduler is not running")
+        box = {"done": threading.Event(), "result": None, "error": None}
+        with self._control_lock:
+            self._control.append((fn, box))
+        self.queue.notify()          # wake the idle wait
+        with self._wake:             # wake the paused wait
+            self._wake.notify_all()
+        if not box["done"].wait(timeout):
+            raise TimeoutError(f"scheduler control op not run in {timeout}s")
+        if box["error"] is not None:
+            raise box["error"]
+        return box["result"]
+
+    def _run_control_ops(self) -> None:
+        with self._control_lock:
+            ops, self._control = self._control, []
+        for fn, box in ops:
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — belongs to caller
+                box["error"] = e
+            finally:
+                box["done"].set()
+
     # -- scheduler loop (engine thread only) -------------------------------
 
     def _loop(self) -> None:
@@ -897,8 +994,11 @@ class ServingEngine:
     def _loop_body(self) -> None:
         try:
             while not self._stop.is_set():
-                # Cancellations and deadline expiry run even while paused:
-                # a paused engine must not hold expired requests hostage.
+                # Control ops (shipment installs / migration extractions)
+                # and cancellations/deadline expiry run even while paused:
+                # a paused engine must not hold expired requests — or the
+                # router's in-flight shipments — hostage.
+                self._run_control_ops()
                 self._drain_cancellations()
                 self._expire_deadlines()
                 if self._paused.is_set():
@@ -952,6 +1052,12 @@ class ServingEngine:
                 if req is None:
                     break
                 self._finish(req, "error")
+            with self._control_lock:  # pending control ops: fail callers
+                ops, self._control = self._control, []
+            for _, box in ops:
+                box["error"] = RuntimeError(
+                    f"serving engine scheduler died: {e!r}")
+                box["done"].set()
             self._stop.set()
             self._notify_drain()
 
@@ -1172,6 +1278,7 @@ class ServingEngine:
         st.lease = ps.lease
         self._active[ps.slot] = st
         self._commit_token(ps.slot, first_tok, float(np.asarray(tok_lp)[0]))
+        self._maybe_handoff(ps.slot)
 
     def _gather_lease(self, lease):
         """One fixed-arity gather of a lease's shared blocks into a fresh
@@ -1278,6 +1385,7 @@ class ServingEngine:
         st.lease = lease
         self._active[slot] = st
         self._commit_token(slot, first, float(np.asarray(tok_lp)[0]))
+        self._maybe_handoff(slot)
         return True
 
     # tpulint: hot-path
@@ -1726,3 +1834,130 @@ class ServingEngine:
                        e2e_s=round(time.perf_counter() - req.submit_time, 6))
         req.done_event.set()
         self._notify_drain()
+
+    # -- KV-block shipping (disaggregated prefill/decode, migration) -------
+
+    def _maybe_handoff(self, slot: int) -> None:
+        """Prefill-role post-admission hook: hand the freshly prefilled
+        request to the router's ship handler.  Runs on the scheduler
+        thread right after the first token committed (so TTFT is paid on
+        the compute-tuned prefill engine).  No handler, a one-token
+        request that already retired, or a handler failure all leave the
+        request decoding locally — shipping is an optimization, never a
+        correctness dependency."""
+        if self.config.role != "prefill" or self._ship_handler is None:
+            return
+        if self._active.get(slot) is None:  # retired on its first token
+            return
+        ship = self._extract_slot(slot)
+        try:
+            self._ship_handler(ship)
+        except Exception:  # noqa: BLE001 — last resort: decode locally
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "ship handler failed; decoding %s locally", ship.request_id)
+            self.install_shipment(ship)
+            self.slots.pool.end_ship(ship.ship_id)
+
+    def extract_request(self, req: _Request) -> Optional[KVShipment]:
+        """Pull an actively decoding request out of this engine (live
+        migration).  Scheduler thread only — route through
+        ``call_in_scheduler`` from anywhere else.  Returns None when the
+        request is not in an extractable state (queued, mid-prefill,
+        parked, or already finished)."""
+        self._flush_inflight()  # may retire the slot (EOS/budget/cancel)
+        for slot, st in self._active.items():
+            if st.req is req:
+                return self._extract_slot(slot)
+        return None
+
+    def _extract_slot(self, slot: int) -> KVShipment:
+        """Export a slot's KV blocks + scheduling state into a shipment.
+
+        The handoff is ledger-atomic: ``begin_ship`` increfs every block
+        *before* the slot's table refs drop, so counts never touch zero
+        mid-transfer and the LedgerSanitizer sees the shipment as the
+        owner until ``end_ship``.  The admission lease is released
+        without a prefix-cache ``offer`` — the request is moving, not
+        retiring — so shared prefix blocks stay pinned only by the cache
+        itself (the shipment carries a verbatim copy of their rows)."""
+        self._flush_inflight()
+        st = self._active.pop(slot)
+        req = st.req
+        pool = self.slots.pool
+        row = self.slots.tables[slot]
+        bids: List[int] = []
+        for b in row:  # non-TRASH entries form a prefix of the row
+            if int(b) == BlockPool.TRASH:
+                break
+            bids.append(int(b))
+        k_dense, v_dense = pool.export_blocks(bids, self.slots.table_blocks)
+        nbytes = sum(int(x.nbytes)
+                     for x in jax.tree.leaves((k_dense, v_dense)))
+        ship_id = f"ship-{next(_SHIP_IDS)}"
+        pool.begin_ship(ship_id, req.rid, bids, nbytes)
+        if self.prefix_cache is not None:
+            self.prefix_cache.release(st.lease)
+        self.slots.release(slot)
+        self._update_pool_gauges()
+        self.metrics.set_gauges(slots_active=self.slots.active_slots)
+        self.metrics.inc("ships_out_total")
+        return KVShipment(
+            ship_id=ship_id, request_id=req.rid,
+            k_dense=k_dense, v_dense=v_dense,
+            bids=bids, n_live=len(bids), nbytes=nbytes,
+            meta={"req": req, "fill": st.fill, "count": st.count,
+                  "pending": st.pending, "spec_ewma": st.spec_ewma,
+                  "spec_stall": st.spec_stall})
+
+    def install_shipment(self, ship: KVShipment) -> int:
+        """Adopt a shipment into a free slot of this engine.  Scheduler
+        thread only (``call_in_scheduler``).  Raises when no slot or no
+        block reservation is available — the caller (router) reinstalls
+        on the source, which cannot fail: the source just freed the
+        capacity and the shipment's refs still pin the original blocks.
+
+        The decode trajectory continues bitwise: block contents moved
+        verbatim, and the sampling RNG folds on the request's own
+        (seed, counter) — both in ``ship.meta`` — never on slot index,
+        batch composition, or which engine runs the step."""
+        req: _Request = ship.meta["req"]
+        pool = self.slots.pool
+        slot = self.slots.alloc()
+        if slot is None:
+            raise RuntimeError("no free slot for shipment install")
+        bk = pool.block_size
+        total = -(-(len(req.prompt) + req.max_new_tokens) // bk)
+        need = ship.n_live + max(0, total - ship.n_live)
+        if not self._try_reserve(need):
+            self.slots.release(slot)
+            raise RuntimeError(
+                f"pool cannot reserve {need} blocks for shipment install")
+        self.slots.set_reservation(slot, need)
+        table = np.full(self.slots.table_blocks, BlockPool.TRASH, np.int32)
+        for i in range(ship.n_live):
+            table[i] = pool.alloc_reserved()
+            # tpulint: allow[lock-discipline] scheduler thread only (via
+            # call_in_scheduler) — same single-writer discipline as every
+            # other slot-table mutation; _lock only guards start/shutdown
+            self.slots.reserved[slot] -= 1
+        # tpulint: allow[lock-discipline] scheduler thread only, as above
+        self.slots.tables[slot] = table
+        # pad columns of the dense payload carry the source's trash
+        # garbage; scattering them into our trash block is a no-op
+        pool.import_blocks(ship.k_dense, ship.v_dense, table)
+        st = _SlotState(req, fill=ship.meta["fill"],
+                        pending=ship.meta["pending"])
+        st.count = ship.meta["count"]
+        st.spec_ewma = ship.meta["spec_ewma"]
+        st.spec_stall = ship.meta["spec_stall"]
+        st.fresh = True  # next dispatch feeds the host-known pending token
+        self._active[slot] = st
+        self._update_pool_gauges()
+        self.metrics.set_gauges(slots_active=self.slots.active_slots)
+        self.metrics.inc("ships_in_total")
+        with self._wake:  # a paused/idle loop should start decoding it
+            self._wake.notify_all()
+        self.queue.notify()
+        return slot

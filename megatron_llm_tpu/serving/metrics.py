@@ -136,6 +136,13 @@ _COUNTERS = (
     # so spec_steps flat-lining while decode_iterations climbs is the
     # policy working, not a bug.
     "spec_proposed", "spec_accepted", "spec_steps",
+    # disaggregated prefill/decode (serving/cluster/): KV-block shipments
+    # this engine exported (prefill handoffs + migrations out) and
+    # adopted (installs in).  On a prefill-role replica ships_out
+    # tracking prefills is the disaggregation working; a persistent gap
+    # between a cluster's summed ships_out and ships_in means shipments
+    # are falling back to local decode (check router ship_failed events).
+    "ships_out_total", "ships_in_total",
 )
 
 # (attribute, prometheus family name, help) for the latency reservoirs

@@ -198,6 +198,23 @@ def main(argv=None) -> int:
                          "single replica (uniform ops surface: GET "
                          "/cluster, per-replica drain); implied by "
                          "--replicas > 1")
+    ap.add_argument("--disagg", default=None, metavar="N:M",
+                    help="disaggregated prefill/decode: N prefill-"
+                         "specialized + M decode replicas on disjoint "
+                         "pp·tp device slices (docs/serving.md "
+                         "'Disaggregated prefill/decode').  Prefill "
+                         "replicas run each request's prefill with a "
+                         "prefill-tuned attention grid and ship its KV "
+                         "blocks to a decode replica; the router routes "
+                         "by phase and live-migrates decodes.  "
+                         "Supersedes --replicas; needs (N+M) x tp x pp "
+                         "<= visible devices")
+    ap.add_argument("--role", default="mixed",
+                    choices=["prefill", "decode", "mixed"],
+                    help="engine role for a SINGLE-engine server joining "
+                         "an externally assembled disaggregated cluster "
+                         "(reported by GET /cluster); --disagg sets "
+                         "roles per replica itself")
     args = ap.parse_args(argv)
 
     from ..checkpointing import load_params_for_inference
@@ -233,9 +250,14 @@ def main(argv=None) -> int:
               f"mlp={pol.mlp or 'fp'}, embedding={pol.embedding or 'fp'}, "
               f"group_size={pol.group_size})")
 
-    cluster = args.replicas > 1 or args.router
+    cluster = args.replicas > 1 or args.router or args.disagg is not None
     mesh_ctx = None
-    if cluster:
+    if args.disagg is not None:
+        print(f"disaggregated cluster: {args.disagg} prefill:decode "
+              f"replicas x {args.tp * args.pp}-way tensor sharding "
+              "behind the phase-routing router (GET /cluster; "
+              "docs/serving.md 'Disaggregated prefill/decode')")
+    elif cluster:
         # cluster mode: each replica engine shards its own params onto
         # its submesh (serving/cluster/sharded.py) and runs under that
         # mesh on its scheduler thread — no ambient process-wide mesh
@@ -286,7 +308,9 @@ def main(argv=None) -> int:
         tensor_parallel=args.tp if cluster else 1,
         pipeline_parallel=args.pp if cluster else 1,
         replicas=args.replicas,
-        router=args.router)
+        router=args.router,
+        disagg=args.disagg,
+        role=args.role)
     if prefix_blocks:
         block_tokens = args.prefill_chunk or max(1, args.prefill_bucket)
         print(f"prefix cache: {prefix_blocks} blocks x {block_tokens} "

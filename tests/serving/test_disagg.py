@@ -1,0 +1,469 @@
+"""Disaggregated prefill/decode tests (CPU, 8 virtual devices, tiny model).
+
+Four contracts, each load-bearing for the KV-block shipping primitive
+(serving/block_pool.py) and the disaggregated cluster (serving/cluster/):
+
+- **export/import round trip** — a block-table-ordered slice of one pool
+  moves into another pool bitwise, fp32 and int8 ``{q, scale}`` leaves
+  verbatim (never dequantized), through shuffled non-contiguous tables,
+  with the shipment ref-count handoff keeping the ledger balanced.
+- **disagg parity** — a 1 prefill + 1 decode cluster must produce
+  bitwise-identical tokens to the single mixed engine across fp32/int8-kv
+  × pipelined/classic × speculation on/off (plus the int4 weight-policy
+  route), every request actually shipped, with zero post-warmup
+  recompiles on *both* engines.
+- **live migration** — moving an actively decoding request between
+  replicas mid-stream loses no accepted token: the client stream and the
+  final trajectory are bitwise-equal to an unmigrated run, and the
+  ledger sanitizer stays balanced on both replicas.
+- **sanitizer coverage** — a chaos-injected block leak during the
+  migration handoff is caught by the LedgerSanitizer and attributed to
+  the request that owned the block; unreconciled shipment ledgers
+  (missing ``end_ship``) trip the boundedness check.
+"""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.analysis.sanitizers import LedgerError, no_recompiles
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.obs.logging import EVENT_LOG
+from megatron_llm_tpu.resilience.chaos import chaos
+from megatron_llm_tpu.serving import (
+    EngineConfig,
+    ServingEngine,
+    build_cluster,
+    build_disagg_cluster,
+)
+from megatron_llm_tpu.serving.block_pool import BlockPool
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = tiny_config(num_layers=2, vocab_size=64,
+                      make_vocab_size_divisible_by=8)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _prompts(cfg, n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size,
+                         int(rng.integers(4, 12))).tolist()
+            for _ in range(n)]
+
+
+def _run(engine_or_router, specs, timeout=120):
+    handles = engine_or_router.submit_many(specs)
+    return [h.result(timeout) for h in handles]
+
+
+def _reference_tokens(cfg, params, specs, **cfg_overrides):
+    """Uninterrupted single mixed-role engine run — the parity baseline."""
+    kw = dict(max_batch_size=2, max_seq_len=64, max_queue_size=32)
+    kw.update(cfg_overrides)
+    engine = ServingEngine(cfg, params, EngineConfig(**kw)).start()
+    try:
+        return [list(r.tokens) for r in _run(engine, specs)]
+    finally:
+        engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pool primitive: export/import round trip, bitwise, ledger handoff
+# ---------------------------------------------------------------------------
+
+def _patterned(pool):
+    """Write a distinct deterministic pattern into every leaf element so a
+    block landing one row off — or through a dequantize round trip —
+    cannot compare equal."""
+    def pat(i, a):
+        vals = (jnp.arange(a.size) * 7 + i * 131) % 251
+        return vals.reshape(a.shape).astype(a.dtype)
+    pool.k_pool = jax.tree.map(
+        lambda a, _i=iter(range(100)): pat(next(_i), a), pool.k_pool)
+    pool.v_pool = jax.tree.map(
+        lambda a, _i=iter(range(100, 200)): pat(next(_i), a), pool.v_pool)
+
+
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+def test_export_import_roundtrip_bitwise(kv_quant):
+    """Shuffled, non-contiguous source blocks land at different (also
+    shuffled) destination blocks with every leaf element identical.  The
+    int8 pool's {q, scale} leaves must arrive in their original dtypes —
+    quantized KV ships quantized, never through a dequantize round trip.
+    (KV pools only come in fp32/int8 — int4 is a weight-only policy; its
+    disagg coverage is the cluster parity test below.)"""
+    cfg = tiny_config(num_layers=2, vocab_size=64,
+                      make_vocab_size_divisible_by=8)
+    if kv_quant != "none":
+        cfg = dataclasses.replace(cfg, kv_cache_quant=kv_quant).validate()
+    src = BlockPool(cfg, 12, 4)
+    dst = BlockPool(cfg, 12, 4)
+    _patterned(src)
+
+    src_bids = [7, 3, 9, 5]                  # shuffled, non-contiguous
+    dst_bids = [2, 10, 1, 6]
+    arity = 6                                # > len(bids): trash-padded
+    k_d, v_d = src.export_blocks(src_bids, arity)
+    # dense leaves keep the pool's own dtypes end to end
+    for d_leaf, p_leaf in zip(jax.tree.leaves(k_d),
+                              jax.tree.leaves(src.k_pool)):
+        assert d_leaf.dtype == p_leaf.dtype
+    if kv_quant == "int8":
+        assert any(leaf.dtype == jnp.int8 for leaf in jax.tree.leaves(k_d))
+
+    scatter = np.full(arity, BlockPool.TRASH, np.int32)
+    scatter[:len(dst_bids)] = dst_bids
+    dst.import_blocks(k_d, v_d, scatter)
+
+    for s_bid, d_bid in zip(src_bids, dst_bids):
+        for s_leaf, d_leaf in zip(jax.tree.leaves(src.k_pool),
+                                  jax.tree.leaves(dst.k_pool)):
+            np.testing.assert_array_equal(np.asarray(s_leaf[:, s_bid]),
+                                          np.asarray(d_leaf[:, d_bid]))
+        for s_leaf, d_leaf in zip(jax.tree.leaves(src.v_pool),
+                                  jax.tree.leaves(dst.v_pool)):
+            np.testing.assert_array_equal(np.asarray(s_leaf[:, s_bid]),
+                                          np.asarray(d_leaf[:, d_bid]))
+
+
+def test_ship_ledger_handoff_is_atomic():
+    """begin_ship takes the shipment's refs BEFORE the source slot drops
+    its own, so counts never touch zero mid-transfer; end_ship reconciles
+    and frees.  stats() surfaces the in-flight count throughout."""
+    cfg = tiny_config(num_layers=2, vocab_size=64,
+                      make_vocab_size_divisible_by=8)
+    pool = BlockPool(cfg, 8, 4)
+    assert pool.reserve(3)
+    bids = [pool.alloc_reserved() for _ in range(3)]
+
+    pool.begin_ship("ship-t", "req-t", bids, nbytes=123)
+    assert pool.stats()["shipments_in_flight"] == 1
+    assert all(pool.ref(b) == 2 for b in bids)
+    for b in bids:                       # the "slot release" half
+        pool.decref(b)
+    # mid-transfer: blocks alive, owned solely by the shipment
+    assert all(pool.ref(b) == 1 for b in bids)
+    assert pool.used_blocks == 3
+    pool.end_ship("ship-t")
+    assert pool.stats()["shipments_in_flight"] == 0
+    assert pool.used_blocks == 0
+    assert pool.free_blocks == pool.usable_blocks
+    with pytest.raises(KeyError):        # double end_ship is a bug
+        pool.end_ship("ship-t")
+
+
+# ---------------------------------------------------------------------------
+# disaggregated cluster: prefill ships to decode, bitwise, zero recompiles
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec", [0, 4], ids=["spec_off", "spec_on"])
+@pytest.mark.parametrize("pipeline", [True, False],
+                         ids=["pipelined", "classic"])
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+def test_disagg_bitwise_matches_colocated(tiny, devices, kv_quant,
+                                          pipeline, spec):
+    cfg, params = tiny
+    if kv_quant != "none":
+        cfg = dataclasses.replace(cfg, kv_cache_quant=kv_quant).validate()
+    # repetitive tails give the n-gram drafter something to accept when
+    # speculation is on; bitwise parity must hold either way
+    base = _prompts(cfg, 2, seed=13)
+    specs = [dict(prompt=(p + p)[:10], max_new_tokens=10, seed=i,
+                  use_eos_stop=False) for i, p in enumerate(base)]
+    kw = dict(prefill_bucket=16, pipeline_decode=pipeline,
+              spec_draft_len=spec, sanitize=True)
+    ref = _reference_tokens(cfg, params, specs, **kw)
+
+    router = build_disagg_cluster(
+        cfg, params,
+        EngineConfig(max_batch_size=2, max_seq_len=64, max_queue_size=32,
+                     **kw),
+        prefill_replicas=1, decode_replicas=1).start()
+    try:
+        assert [r.role for r in router.replicas] == ["prefill", "decode"]
+        # warmup compiles every workload shape on BOTH engines: the
+        # prefill bucket + export gather on the prefill replica, the
+        # import scatter + decode (and verify) steps on the decode one
+        _run(router, specs)
+        with no_recompiles():
+            got = [list(r.tokens) for r in _run(router, specs)]
+        snap = router.snapshot()
+        assert got == ref
+        # every request genuinely shipped — nothing decoded on the
+        # prefill replica via the local fallback
+        assert snap["router"]["ships_total"] == 2 * len(specs)
+        assert snap["shipments_in_flight"] == []
+        pre, dec = router.replicas
+        assert pre.engine.metrics.counters["ships_out_total"] == \
+            2 * len(specs)
+        assert dec.engine.metrics.counters["ships_in_total"] == \
+            2 * len(specs)
+        # phase routing sent every submission to the prefill replica
+        assert pre.dispatched >= 2 * len(specs)
+    finally:
+        router.shutdown()
+    # shutdown ran each sanitizer's leak report: the shipment handoffs
+    # left both replicas' ledgers balanced
+    for rep in router.replicas:
+        assert rep.engine.sanitizer_report == []
+
+
+def test_disagg_int4_weight_policy_bitwise(tiny, devices):
+    """Shipping composes with the serving weight-precision policy: an
+    int4-policy cluster (int8 KV pool) matches its own single-engine
+    baseline bitwise."""
+    from megatron_llm_tpu.ops.quant import quantize_params
+
+    cfg, params = tiny
+    qcfg = dataclasses.replace(cfg, kv_cache_quant="int8").validate()
+    qparams = quantize_params(params, "int4")
+    specs = [dict(prompt=p, max_new_tokens=8, seed=i, use_eos_stop=False)
+             for i, p in enumerate(_prompts(qcfg, 2, seed=17))]
+    ref = _reference_tokens(qcfg, qparams, specs, prefill_bucket=16)
+    router = build_disagg_cluster(
+        qcfg, qparams,
+        EngineConfig(max_batch_size=2, max_seq_len=64, max_queue_size=32,
+                     prefill_bucket=16, sanitize=True),
+        prefill_replicas=1, decode_replicas=1).start()
+    try:
+        got = [list(r.tokens) for r in _run(router, specs)]
+        assert got == ref
+        assert router.snapshot()["router"]["ships_total"] == len(specs)
+    finally:
+        router.shutdown()
+    for rep in router.replicas:
+        assert rep.engine.sanitizer_report == []
+
+
+def test_disagg_observability_surface(tiny, devices):
+    """EVENT_LOG ``shipped`` lines carry request id + both replica ids,
+    ship spans land on the request's tid track, Prometheus exposition
+    carries the cluster ship counters and per-role replica gauges."""
+    cfg, params = tiny
+    EVENT_LOG.clear()
+    specs = [dict(prompt=p, max_new_tokens=6, seed=i, use_eos_stop=False)
+             for i, p in enumerate(_prompts(cfg, 2, seed=19))]
+    router = build_disagg_cluster(
+        cfg, params,
+        EngineConfig(max_batch_size=2, max_seq_len=64, max_queue_size=32),
+        prefill_replicas=1, decode_replicas=1).start()
+    try:
+        handles = router.submit_many(specs)
+        results = [h.result(120) for h in handles]
+        assert all(r.finish_reason == "length" for r in results)
+        shipped = EVENT_LOG.recent(event="shipped")
+        assert len(shipped) == len(specs)
+        for e in shipped:
+            assert e["request_id"]
+            assert e["from_replica"] == "replica-0"
+            assert e["to_replica"] == "replica-1"
+            assert e["bytes"] > 0 and e["blocks"] >= 1
+        events = router.trace.chrome_trace()["traceEvents"]
+        ship_spans = [e for e in events if e["name"] == "ship"]
+        assert len(ship_spans) == len(specs)
+        # ship spans ride the request's tid track, so a per-request
+        # timeline shows the handoff inline with its other spans
+        assert {e["tid"] for e in ship_spans} == \
+            {h.request_id for h in handles}
+
+        fams = {f.name: f for f in router.metrics.collect()}
+        assert fams["cluster_ships_total"].samples[0].value == len(specs)
+        assert fams["cluster_migrations_total"].samples[0].value == 0
+        assert fams["cluster_ship_bytes_total"].samples[0].value > 0
+        assert fams["cluster_shipments_in_flight"].samples[0].value == 0
+        roles = {s.labels["role"]: s.value
+                 for s in fams["cluster_replicas_by_role"].samples}
+        assert roles == {"prefill": 1, "decode": 1}
+        snap = router.snapshot()
+        assert snap["router"]["roles"] == {"prefill": 1, "decode": 1}
+        assert snap["router"]["ship_bytes_total"] > 0
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# live migration: bitwise mid-stream handoff, chaos leak, boundedness
+# ---------------------------------------------------------------------------
+
+def _wait_tokens(stream, n, timeout=60):
+    deadline = time.perf_counter() + timeout
+    while len(stream) < n:
+        assert time.perf_counter() < deadline, \
+            f"stream produced {len(stream)} tokens, wanted {n}"
+        time.sleep(0.01)
+
+
+def test_migrate_mid_stream_bitwise_zero_loss(tiny):
+    cfg, params = tiny
+    n = 2
+    specs = [dict(prompt=p, max_new_tokens=24, seed=i, use_eos_stop=False)
+             for i, p in enumerate(_prompts(cfg, n, seed=23))]
+    ref = _reference_tokens(cfg, params, specs)
+
+    EVENT_LOG.clear()
+    streams = {i: [] for i in range(n)}
+    router = build_cluster(
+        cfg, params,
+        EngineConfig(max_batch_size=2, max_seq_len=64, max_queue_size=32,
+                     sanitize=True),
+        replicas=2).start()
+    try:
+        handles = router.submit_many([
+            dict(s, on_token=(lambda i: (lambda t:
+                 streams[i].append(int(t))))(i))
+            for i, s in enumerate(specs)])
+        _wait_tokens(streams[0], 3)
+        src = handles[0]._rr.replica
+        dst_id = next(r.id for r in router.replicas if r is not src)
+        # pause the source so the request cannot finish while we migrate
+        # (control ops still run on a paused scheduler by design)
+        src.engine.pause()
+        try:
+            assert router.migrate_request(handles[0], to_replica_id=dst_id)
+        finally:
+            src.engine.resume()
+        assert handles[0]._rr.replica.id == dst_id
+        results = [h.result(120) for h in handles]
+    finally:
+        router.shutdown()
+    for rep in router.replicas:
+        assert rep.engine.sanitizer_report == []
+
+    got = [list(r.tokens) for r in results]
+    assert got == ref
+    # zero lost, zero replayed: the stream saw exactly the generated
+    # suffix once — migration moves the live request, nothing re-runs
+    for i, r in enumerate(results):
+        assert streams[i] == list(map(int, r.tokens[r.prompt_len:]))
+    migrated = EVENT_LOG.recent(event="migrated")
+    assert len(migrated) == 1
+    assert migrated[0]["request_id"] == handles[0].rid
+    assert migrated[0]["from_replica"] == src.id
+    assert migrated[0]["to_replica"] == dst_id
+    snap = router.snapshot()
+    assert snap["router"]["migrations_total"] == 1
+    assert snap["shipments_in_flight"] == []
+    spans = [e for e in router.trace.chrome_trace()["traceEvents"]
+             if e["name"] == "migrate"]
+    assert len(spans) == 1 and spans[0]["args"]["to"] == dst_id
+
+
+def test_migration_chaos_leak_caught_and_attributed(tiny):
+    """A chaos-injected block leak at the extract's slot release is the
+    exact hazard the shipment ledger exists for: the source sanitizer
+    must fail loudly and name the leaked block's last owner."""
+    cfg, params = tiny
+    spec = dict(prompt=_prompts(cfg, 1, seed=29)[0], max_new_tokens=32,
+                seed=0, use_eos_stop=False)
+    stream = []
+    router = build_cluster(
+        cfg, params,
+        EngineConfig(max_batch_size=1, max_seq_len=64, max_queue_size=8,
+                     sanitize=True),
+        replicas=2).start()
+    try:
+        [h] = router.submit_many([dict(spec, on_token=lambda t:
+                                       stream.append(int(t)))])
+        _wait_tokens(stream, 2)
+        rid = h.rid
+        src = h._rr.replica
+        dst_id = next(r.id for r in router.replicas if r is not src)
+        src.engine.pause()
+        try:
+            chaos().leak_kv_blocks("slots-release", times=1)
+            assert router.migrate_request(h, to_replica_id=dst_id)
+        finally:
+            src.engine.resume()
+        # the request itself survives on the destination, token-complete
+        res = h.result(120)
+        assert len(res.tokens) == res.prompt_len + 32
+        # the source scheduler's next ledger audit catches the leak
+        deadline = time.perf_counter() + 30
+        while src.engine._scheduler_error is None:
+            assert time.perf_counter() < deadline, \
+                "sanitizer did not catch the leaked block"
+            time.sleep(0.01)
+        err = src.engine._scheduler_error
+        assert isinstance(err, LedgerError)
+        assert "leaked reference" in str(err)
+        assert rid in str(err), \
+            f"leak not attributed to its last owner: {err}"
+    finally:
+        chaos().reset()
+        router.shutdown()
+    # the shutdown leak report names the same block with its last owners
+    report = src.engine.sanitizer_report
+    assert report and any(rid in owner
+                          for leak in report
+                          for owner in leak["last_owners"])
+
+
+def test_sanitizer_bounds_unreconciled_shipments(tiny):
+    """A shipment ledger that only ever grows (end_ship missing) is a
+    silent leak factory; the per-iteration audit fails once in-flight
+    shipments exceed the slot count."""
+    cfg, params = tiny
+    engine = ServingEngine(
+        cfg, params,
+        EngineConfig(max_batch_size=2, max_seq_len=64,
+                     sanitize=True)).start()
+    try:
+        _run(engine, [dict(prompt=[1, 2, 3], max_new_tokens=2,
+                           use_eos_stop=False)])
+        pool = engine.slots.pool
+        engine.call_in_scheduler(lambda: [
+            pool.begin_ship(f"ship-zombie-{i}", f"req-{i}", [], 0)
+            for i in range(engine.slots.num_slots + 1)])
+        deadline = time.perf_counter() + 30
+        while engine._scheduler_error is None:
+            assert time.perf_counter() < deadline
+            time.sleep(0.01)
+        assert "end_ship missing" in str(engine._scheduler_error)
+    finally:
+        engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# server surface: --disagg wiring, GET /cluster roles + in-flight shipments
+# ---------------------------------------------------------------------------
+
+def test_generation_service_disagg_surface(tiny):
+    from megatron_llm_tpu.generation.server import GenerationService
+    from megatron_llm_tpu.tokenizer.tokenizer import NullTokenizer
+
+    cfg, params = tiny
+    svc = GenerationService(cfg, params,
+                            NullTokenizer(vocab_size=cfg.vocab_size),
+                            max_batch_size=2, engine_max_seq_len=64,
+                            disagg="1:1")
+    try:
+        status, resp = svc.handle({"prompts": ["3 4 5", "6 7 8"],
+                                   "tokens_to_generate": 4,
+                                   "random_seed": 7})
+        assert status == 200
+        assert len(resp["text"]) == 2
+        snap = svc.cluster_snapshot()
+        assert snap["router"]["roles"] == {"prefill": 1, "decode": 1}
+        assert snap["router"]["ships_total"] == 2
+        assert snap["shipments_in_flight"] == []
+        assert {r["role"] for r in snap["replicas"]} == \
+            {"prefill", "decode"}
+    finally:
+        svc.close()
+
+
+def test_parse_disagg_validation():
+    from megatron_llm_tpu.generation.server import GenerationService
+
+    assert GenerationService._parse_disagg("2:1") == (2, 1)
+    for bad in ("2", "a:b", "0:1", "1:0", ":", "1:2:3"):
+        with pytest.raises(ValueError):
+            GenerationService._parse_disagg(bad)
