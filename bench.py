@@ -643,6 +643,34 @@ def _serving_spec_point():
         gen_len=gen_len, slots=8, draft_len=4, ngram=3)
 
 
+def _serving_spec_tree_point(wide_layers: int = 0):
+    """Resident-draft tree-speculation serving point (serving/engine.py
+    draft path, docs/serving.md "Tree speculation & resident drafts"):
+    draft on vs off at identical engine geometry on random AND
+    repetitive traffic.  Random traffic is the headline — it is exactly
+    where the n-gram drafter's acceptance is ~0 (the PLD ceiling), so
+    ``serving_spec_tree_itl_speedup`` (draft-off ITL p50 / draft-on, on
+    random prompts) gating in --compare is the beat-the-ceiling claim
+    (acceptance bar > 1.0).  Runs at 7B width (hidden 4096, L8 depth,
+    the decode_7b geometry) when ``wide_layers`` is set so the headline
+    is quoted at deployment-relevant matmul shapes; the bench draft is
+    the perfect-oracle self-draft (a random-init target has no
+    distilled partner — see serving/bench.py)."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.serving.bench import run_spec_tree_serving_bench
+
+    prompt_len, gen_len = 256, 128
+    cfg = (_bench_model_7b_width(prompt_len + gen_len, wide_layers)
+           if wide_layers else _bench_model(prompt_len + gen_len,
+                                            "selective"))
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return run_spec_tree_serving_bench(
+        cfg, params, num_requests=16, prompt_len=prompt_len,
+        gen_len=gen_len, slots=8, draft_len=4)
+
+
 def _serving_cluster_point():
     """Multi-chip serving point (serving/cluster/, docs/serving.md
     "Multi-chip serving"): mixed traffic through ``build_cluster`` at 1
@@ -752,6 +780,11 @@ _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
                      "serving_paged.serving_paged_max_concurrency",
                      "serving_spec.serving_spec_itl_speedup",
                      "serving_spec.serving_spec_acceptance_rate",
+                     # resident-draft tree speculation: the random-
+                     # traffic ITL speedup (> 1.0 = beating the n-gram
+                     # drafter's ceiling) with acceptance riding along
+                     "serving_spec_tree.serving_spec_tree_itl_speedup",
+                     "serving_spec_tree.serving_spec_tree_acceptance_rate",
                      # multi-chip serving: replica QPS scaling (≥ 1.8x at
                      # 2 replicas on real hardware) and the tp=2 per-chip
                      # model-size win (≈ 2.0)
@@ -782,7 +815,10 @@ _TRACE_OVERHEAD_TOLERANCE = 0.10
 #     decode specs carry a precision-policy string in "quantize"
 # v6: + serving_disagg point (disaggregated prefill/decode TTFT/QPS vs
 #     colocated at equal devices + prefill-chunk MFU sweep)
-_BENCH_SCHEMA_VERSION = 6
+# v7: + serving_spec_tree point (resident-draft tree speculation: random-
+#     traffic ITL speedup vs draft-off + acceptance; the n-gram
+#     serving_spec point rides unchanged for the PLD baseline)
+_BENCH_SCHEMA_VERSION = 7
 
 
 def _run_metadata(platform: str, device_count: int) -> dict:
@@ -971,6 +1007,8 @@ def _child_main(spec_json: str) -> None:
         out = _retry(_serving_paged_point)
     elif kind == "serving_spec":
         out = _retry(_serving_spec_point)
+    elif kind == "serving_spec_tree":
+        out = _retry(_serving_spec_tree_point, spec.get("wide_layers", 0))
     elif kind == "serving_cluster":
         out = _retry(_serving_cluster_point)
     elif kind == "serving_disagg":
@@ -1169,6 +1207,15 @@ def main() -> None:
                           {"kind": "serving_spec",
                            "platform": platform},
                           timeout_s=1800)
+    # headline quoted at 7B width (decode_7b geometry) so the
+    # beat-the-PLD-ceiling claim holds at deployment matmul shapes; on
+    # CPU the wide model would blow the point timeout, so the simulated
+    # record carries the standard bench-model geometry instead
+    serving_spec_tree = _point(
+        "serving/spec-tree",
+        {"kind": "serving_spec_tree", "platform": platform,
+         "wide_layers": 0 if platform == "cpu" else 8},
+        timeout_s=1800)
     # CPU runs simulate 8 devices so the replica/tp topology exercises
     # end to end; on real hardware the flag is inert (jax ignores the
     # host-platform count when an accelerator is present)
@@ -1250,6 +1297,8 @@ def main() -> None:
         record["serving_paged"] = serving_paged
     if serving_spec is not None:
         record["serving_spec"] = serving_spec
+    if serving_spec_tree is not None:
+        record["serving_spec_tree"] = serving_spec_tree
     if serving_cluster is not None:
         record["serving_cluster"] = serving_cluster
     if serving_disagg is not None:

@@ -54,6 +54,12 @@ RULES: Dict[str, str] = {
         "a # tpulint: hot-path function — materializes the full fp "
         "tensor, erasing the quantized-residency bytes win; dequantize "
         "per tile inside the kernel instead"),
+    "dyn-shape": (
+        "operand of a jitted call constructed with a data-dependent "
+        "shape (len()/per-request state in the shape tuple) — every "
+        "distinct shape compiles a new executable; pack per-iteration "
+        "operands (e.g. candidate-tree topology tensors) at fixed "
+        "arity and mask in-kernel"),
     "suppression": (
         "malformed tpulint suppression (unknown rule id or missing "
         "reason) — suppressions must document why"),

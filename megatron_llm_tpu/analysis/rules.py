@@ -715,9 +715,113 @@ def rule_dequant_hot_path(ctx: ModuleContext) -> List[Finding]:
     return findings
 
 
+# -- R7: data-dependent operand shapes into jitted calls --------------------
+
+_SHAPE_CTORS = {"zeros", "ones", "empty", "full"}
+
+
+def _shape_expr_dynamic(expr: ast.expr, config: AnalysisConfig) -> bool:
+    """True when a shape expression varies per iteration: it calls
+    ``len()`` or reads request/slot state.  Config/module constants
+    (``S``, ``self.config.max_batch_size``) are bounded and fine."""
+    for node in ast.walk(expr):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "len"):
+            return True
+        if (isinstance(node, ast.Attribute)
+                and node.attr in config.request_state_attrs):
+            return True
+    return False
+
+
+def _dyn_shape_ctor(node: ast.AST, config: AnalysisConfig,
+                    ) -> Optional[ast.expr]:
+    """The offending shape expression, if ``node`` constructs an array
+    whose SHAPE is data-dependent: ``np.zeros((len(plans), W))`` etc."""
+    if not isinstance(node, ast.Call):
+        return None
+    p = dotted_path(node.func)
+    if (p is None or p[-1] not in _SHAPE_CTORS
+            or p[0] not in config.numpy_names + ("jnp", "jax")):
+        return None
+    shape: Optional[ast.expr] = node.args[0] if node.args else None
+    for kw in node.keywords:
+        if kw.arg == "shape":
+            shape = kw.value
+    if shape is not None and _shape_expr_dynamic(shape, config):
+        return shape
+    return None
+
+
+def rule_dynamic_operand_shape(ctx: ModuleContext) -> List[Finding]:
+    """Per-iteration operands handed to a jitted callable must have
+    FIXED shapes — the candidate-tree topology operands (depths,
+    ancestor tables, windows) are the canonical case: pack them at
+    fixed arity (pad to the node budget, mask in-kernel,
+    serving/engine.py:_spec_step_tree) rather than sizing them by
+    ``len(chains)`` or per-request node counts, because every distinct
+    operand shape compiles a fresh executable and the compile storm
+    lands mid-decode."""
+    findings: List[Finding] = []
+    for fn in _functions(ctx.tree):
+        dyn: Dict[str, ast.Call] = {}
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            if _dyn_shape_ctor(node.value, ctx.config) is None:
+                continue
+            for t in node.targets:
+                p = dotted_path(t)
+                if p is not None and len(p) == 1:
+                    dyn[p[0]] = node.value
+        seen: Set[Tuple[int, int]] = set()
+
+        def flag(ctor: ast.Call, jf_name: str, at: ast.AST) -> None:
+            key = (ctor.lineno, ctor.col_offset)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(
+                ctx.path, ctor.lineno, ctor.col_offset, "dyn-shape",
+                f"operand of jit'd '{jf_name}' is built with a data-"
+                "dependent shape (len()/per-request state in the shape "
+                "tuple): every distinct shape compiles a new executable "
+                "— pack it at fixed arity (pad to the budget, mask "
+                "in-kernel)", ctx.qualname_of(at)))
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            jf = ctx.resolve_jit(node.func)
+            if jf is None:
+                continue
+            operands: List[Tuple[str, ast.expr]] = []
+            for i, arg in enumerate(node.args):
+                pname = jf.params[i] if i < len(jf.params) else ""
+                operands.append((pname, arg))
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    operands.append((kw.arg, kw.value))
+            for pname, arg in operands:
+                if pname in jf.static:
+                    continue
+                for sub in ast.walk(arg):
+                    if (isinstance(sub, ast.Name)
+                            and isinstance(getattr(sub, "ctx", None),
+                                           ast.Load)
+                            and sub.id in dyn):
+                        flag(dyn[sub.id], jf.name, node)
+                    else:
+                        ctor_shape = _dyn_shape_ctor(sub, ctx.config)
+                        if ctor_shape is not None:
+                            flag(sub, jf.name, node)
+    return findings
+
+
 ALL_RULES = (rule_recompile, rule_host_sync, rule_donation,
              rule_tracer_leak, rule_lock_discipline,
-             rule_dequant_hot_path)
+             rule_dequant_hot_path, rule_dynamic_operand_shape)
 
 
 def run_all(ctx: ModuleContext) -> List[Finding]:

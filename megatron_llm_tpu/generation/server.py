@@ -72,6 +72,9 @@ class GenerationService:
                  kv_pool_blocks: int | None = None,
                  spec_draft_len: int = 0,
                  spec_ngram: int = 3,
+                 spec_reprobe_interval: int | None = None,
+                 draft_cfg: ModelConfig | None = None,
+                 draft_params=None,
                  trace: bool = True,
                  tensor_parallel: int = 1,
                  pipeline_parallel: int = 1,
@@ -120,6 +123,13 @@ class GenerationService:
         # Distinct from the one-shot PLD path behind ``speculative="pld"``
         self.spec_draft_len = spec_draft_len
         self.spec_ngram = spec_ngram
+        # stalled-slot re-probe cadence; None keeps the engine default
+        self.spec_reprobe_interval = spec_reprobe_interval
+        # resident draft model (tree speculation, docs/serving.md): a
+        # small model drafting candidate trees on-device, replacing the
+        # host n-gram probe when present.  Shares the target vocabulary.
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
         # per-request span tracing (obs/trace.py, GET /trace); the CLI's
         # --no_trace escape hatch lands here
         self.trace_enabled = trace
@@ -179,6 +189,13 @@ class GenerationService:
                     extra["kv_block_size"] = self.kv_block_size
                 if self.kv_pool_blocks is not None:
                     extra["kv_pool_blocks"] = self.kv_pool_blocks
+                if self.spec_reprobe_interval is not None:
+                    extra["spec_reprobe_interval"] = \
+                        self.spec_reprobe_interval
+                draft_kw = {}
+                if self.draft_cfg is not None:
+                    draft_kw = {"draft_cfg": self.draft_cfg,
+                                "draft_params": self.draft_params}
                 engine_config = EngineConfig(
                     max_batch_size=self.max_batch_size,
                     max_seq_len=self.engine_max_seq_len,
@@ -205,7 +222,7 @@ class GenerationService:
                         parallel=ParallelConfig(
                             pipeline_parallel=self.pipeline_parallel,
                             tensor_parallel=self.tensor_parallel),
-                        router_config=self.router_config)
+                        router_config=self.router_config, **draft_kw)
                 elif self.router or self.replicas > 1 or shards > 1:
                     from ..config import ParallelConfig
                     from ..serving import build_cluster
@@ -216,10 +233,10 @@ class GenerationService:
                         parallel=ParallelConfig(
                             pipeline_parallel=self.pipeline_parallel,
                             tensor_parallel=self.tensor_parallel),
-                        router_config=self.router_config)
+                        router_config=self.router_config, **draft_kw)
                 else:
                     self._engine = ServingEngine(self.cfg, self.params,
-                                                 engine_config)
+                                                 engine_config, **draft_kw)
             return self._engine
 
     def metrics_snapshot(self) -> dict:

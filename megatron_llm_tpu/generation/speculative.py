@@ -21,10 +21,16 @@ negligible extra FLOPs — decode is latency-bound, which is the point).
 
 Extension beyond the reference (its generation loop is strictly one token
 per pipelined ForwardStep, megatron/text_generation/generation.py:89-285).
-This module is the ONE-SHOT path (fixed batch, dense cache, jitted loop);
-the continuous-batching serving engine carries its own speculative path
-over paged blocks with a per-slot acceptance policy —
-serving/engine.py and docs/serving.md ("Speculative decoding").
+This module is the ONE-SHOT path (fixed batch, dense cache, jitted loop)
+and its drafter is strictly the linear prompt-lookup one.  The
+continuous-batching serving engine carries TWO speculative paths over
+paged blocks, both with per-slot acceptance policies: the same host
+n-gram drafter verifying a linear window (docs/serving.md, "Speculative
+decoding"), and a resident draft MODEL proposing candidate trees that
+the target verifies in one fused forward — the path that still
+speculates on traffic with nothing to look up (serving/engine.py
+``_spec_step_tree``; docs/serving.md, "Tree speculation & resident
+drafts").
 
 Batched behavior (round 5): fully per-sample.  The KV cache carries a
 [batch] vector of fill levels (ops/kv_quant.py:cache_update and the

@@ -372,12 +372,19 @@ def _decode_step_kernel(per_row: bool, aq: int, mq: int, gsz: int,
 
 
 def _decode_step_kernel_paged(aq: int, mq: int, gsz: int,
-                              cq8: bool, W: int,
+                              cq8: bool, W: int, tree: bool,
                               ntb: int, nm: int, block_k: int,
                               b: int, nq: int, nkv: int, g: int, d: int,
                               eps: float, scale: float, act,
-                              lens_ref, tbl_ref,
-                              x_ref, rot_ref, cos_ref, sin_ref, *refs):
+                              lens_ref, tbl_ref, *refs):
+    anc_ref = None
+    if tree:
+        # third prefetched scalar: flattened [S, W·W] ancestor topology —
+        # anc_ref[r, j·W + dd] is the node index of row j's ancestor at
+        # tree depth dd (arbitrary for dd >= depth(j): those columns are
+        # masked by the per-row lens limit and never score)
+        anc_ref, *refs = refs
+    (x_ref, rot_ref, cos_ref, sin_ref, *refs) = refs
     # Paged twin of _decode_step_kernel, always per-row (the serving
     # engine's slot batch).  ``lens_ref`` is [1 + b] (lens[0] = max fill,
     # layout parity with the dense kernel; lens[1 + i] = row i's limit —
@@ -525,24 +532,68 @@ def _decode_step_kernel_paged(aq: int, mq: int, gsz: int,
             c2 = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
             sel_rows = jax.lax.broadcasted_iota(jnp.int32, (b, 1, 1), 0)
-            for i in range(W - 1):
-                # one-hot gather of scratch row r·W + i (r is traced, so
-                # no dynamic scratch indexing)
-                sel = (sel_rows == r * W + i).astype(f32)
-                kvi = jnp.sum(kn_vis * sel, axis=0)      # (nkv, d)
-                vvi = jnp.sum(vn_vis * sel, axis=0)
-                hit = (c2 == fill_r + i)[..., None]      # (1, bk, 1)
-                k4 = jnp.where(hit, kvi[:, None, :], k4)
-                v4 = jnp.where(hit, vvi[:, None, :], v4)
+            if not tree:
+                for i in range(W - 1):
+                    # one-hot gather of scratch row r·W + i (r is traced,
+                    # so no dynamic scratch indexing)
+                    sel = (sel_rows == r * W + i).astype(f32)
+                    kvi = jnp.sum(kn_vis * sel, axis=0)  # (nkv, d)
+                    vvi = jnp.sum(vn_vis * sel, axis=0)
+                    hit = (c2 == fill_r + i)[..., None]  # (1, bk, 1)
+                    k4 = jnp.where(hit, kvi[:, None, :], k4)
+                    v4 = jnp.where(hit, vvi[:, None, :], v4)
+            else:
+                # tree splice: the window rows form a candidate TREE per
+                # slot (BFS node order: node 0 = root/pending, depth
+                # non-decreasing in node index), so different rows need
+                # DIFFERENT keys at the same column — row j's ancestor
+                # at depth dd must land at column fill_r + dd, exactly
+                # where sequentially decoding j's root path would have
+                # written it.  The splice therefore widens to per-row
+                # (b, nkv, bk, d) tiles; masked columns (dd >= depth(j))
+                # splice arbitrary values whose scores the per-row lens
+                # limit replaces with NEG_INF, so p is exactly 0.0 there
+                # and the online-softmax recurrence is untouched — the
+                # same annihilation argument as the linear splice.  A
+                # chain topology (anc[j, dd] = dd, depth(j) = j) makes
+                # every row's tile equal to the shared linear splice,
+                # which is what keeps chain-tree verify bitwise-equal to
+                # the W-window path.
+                k4 = jnp.broadcast_to(k4[None], (b,) + k4.shape)
+                v4 = jnp.broadcast_to(v4[None], (b,) + v4.shape)
+                for dd in range(W - 1):
+                    kdd = jnp.zeros((b, nkv, d), f32)
+                    vdd = jnp.zeros((b, nkv, d), f32)
+                    for jj in range(W):
+                        # SMEM scalar read with traced r, then a one-hot
+                        # gather of scratch row r·W + anc (no dynamic
+                        # scratch indexing)
+                        a = anc_ref[r, jj * W + dd]
+                        sel_a = (sel_rows == r * W + a).astype(f32)
+                        kv_a = jnp.sum(kn_vis * sel_a, axis=0)  # (nkv, d)
+                        vv_a = jnp.sum(vn_vis * sel_a, axis=0)
+                        row_hit = (sel_rows == r * W + jj).astype(f32)
+                        kdd = kdd + row_hit * kv_a[None]
+                        vdd = vdd + row_hit * vv_a[None]
+                    hit = (c2 == fill_r + dd)[:, None, :, None]
+                    k4 = jnp.where(hit, kdd[:, :, None, :], k4)
+                    v4 = jnp.where(hit, vdd[:, :, None, :], v4)
             # per-row limits: row (s, j) attends cache positions
-            # < fill_s + j (its own key folds in _finish_attn)
+            # < fill_s + depth_j (its own key folds in _finish_attn);
+            # linear windows have depth_j = j — either way the limit is
+            # lens[1 + row] = the row's own position
             in_range = jnp.logical_and(
                 rows // W == r,
                 jnp.concatenate([cols < lens_ref[1 + rr]
                                  for rr in range(b)], axis=0))
+        # rank-4 k4/v4 (tree) already carry the row axis; rank-3 tiles
+        # broadcast it — elementwise products and the d-axis reduction
+        # are identical either way, so the linear path is bit-unchanged
+        k4b = k4 if k4.ndim == 4 else k4[None]
+        v4b = v4 if v4.ndim == 4 else v4[None]
         for gg in range(g):
             qv = q_scr[gg]                               # (b, nkv, d) f32
-            s = jnp.sum(qv[:, :, None, :] * k4[None], axis=-1) * scale
+            s = jnp.sum(qv[:, :, None, :] * k4b, axis=-1) * scale
             s = jnp.where(in_range, s, NEG_INF)          # (b, nkv, bk)
             m_prev = m_scr[gg][:, :, :1]
             m_new = jnp.maximum(
@@ -553,7 +604,7 @@ def _decode_step_kernel_paged(aq: int, mq: int, gsz: int,
                 alpha * l_scr[gg][:, :, :1]
                 + jnp.sum(p, axis=-1, keepdims=True), l_scr[gg].shape)
             acc_scr[gg] = (acc_scr[gg] * alpha
-                           + jnp.sum(p[..., None] * v4[None], axis=2))
+                           + jnp.sum(p[..., None] * v4b, axis=2))
             m_scr[gg] = jnp.broadcast_to(m_new, m_scr[gg].shape)
 
     @pl.when(jnp.logical_and(ki == nk, "finish" in phases))
@@ -826,14 +877,17 @@ def fused_paged_decode_eligible(cfg, params, k_pool, n_slots: int,
 
 def fused_paged_verify_eligible(cfg, params, k_pool, n_slots: int,
                                 window: int, table_blocks: int,
-                                platform: str, mesh=None) -> bool:
+                                platform: str, mesh=None,
+                                tree: bool = False) -> bool:
     """Static predicate for the speculative verify kernel
     (fused_decode_verify_paged): the paged predicate with the row batch
     widened to ``n_slots * window`` — the flattened (slot, window-pos)
     rows all carry q/kn/vn scratch, so the VMEM estimate scales with the
     window even though cache traffic still streams one block per tick.
-    ``mesh`` makes the dispatch shard-aware exactly as in
-    ``fused_paged_decode_eligible``."""
+    ``tree`` charges the tree splice's per-row (b, nkv, block_k, d) key
+    and value tiles (the shared tiles widen to a row axis), which the
+    linear window never materializes.  ``mesh`` makes the dispatch
+    shard-aware exactly as in ``fused_paged_decode_eligible``."""
     from ..ops.kv_quant import is_quantized_cache
 
     if n_slots < 1 or window < 1 or table_blocks < 1:
@@ -851,7 +905,8 @@ def fused_paged_verify_eligible(cfg, params, k_pool, n_slots: int,
         return False
     attn_item, mlp_item = _class_itemsizes(params, aq, mq)
     return _vmem_fit(cfg, n_slots * window, block_k, attn_item, mlp_item,
-                     1 if cq8 else kc.dtype.itemsize, cache_rows=1)
+                     1 if cq8 else kc.dtype.itemsize, cache_rows=1,
+                     extra_bcast=2 if tree else 0)
 
 
 def _mlp_chunks(ffn: int, cap: int = 4) -> int:
@@ -891,7 +946,8 @@ def _pick_block_k(cfg, b: int, max_len: int, attn_itemsize: float,
 def _vmem_fit(cfg, b: int, block_k: int, attn_itemsize: float,
               mlp_itemsize: float, cache_itemsize: int,
               budget: int = 100 * 1024 * 1024,
-              cache_rows: int | None = None) -> bool:
+              cache_rows: int | None = None,
+              extra_bcast: int = 0) -> bool:
     """Whole-layer-resident VMEM estimate: the kernel holds one layer's
     weights + two KV blocks, double-buffered, plus fp32 scratch.  Layers
     wider than the budget (e.g. 7B-width: ~354 MB/layer bf16) must keep
@@ -916,8 +972,10 @@ def _vmem_fit(cfg, b: int, block_k: int, attn_itemsize: float,
               + cache_elts * cache_itemsize) * 2  # double-buffered
     b_pad = max(8, -(-b // 8) * 8)
     g = nq // nkv
-    # quantized caches materialize scaled fp32 copies of both tile loads
-    n_tmp = 5 if cache_itemsize == 1 else 3
+    # quantized caches materialize scaled fp32 copies of both tile loads;
+    # tree splice widens the shared K/V tiles to a per-row axis
+    # (extra_bcast more (b, nkv, block_k, d) fp32 temporaries)
+    n_tmp = (5 if cache_itemsize == 1 else 3) + extra_bcast
     int4_tmp = 0
     if attn_itemsize == 0.5:
         # _project materializes wq/wk/wv fp32 tiles at once (wo later,
@@ -1237,6 +1295,13 @@ def fused_decode_verify_paged(
     fills: jax.Array,    # [S] int32 per-slot committed fills
     rope: tuple,         # (cos, sin) tables from rope_tables(cfg)
     *,
+    depths: jax.Array | None = None,  # [S, W] int32 node depths (tree
+    #                      mode): row (s, j) sits at cache position
+    #                      fills[s] + depths[s, j].  None = linear window
+    #                      (depths[s, j] = j implicitly).
+    anc: jax.Array | None = None,     # [S, W, W] int32 parent-pointer
+    #                      closure: anc[s, j, dd] = node index of row j's
+    #                      ancestor at depth dd.  Required iff depths is.
     interpret: bool | None = None,
 ):
     """Batched variable-length speculative verify: the paged fused step
@@ -1253,26 +1318,42 @@ def fused_decode_verify_paged(
     per-row variable draft lengths are handled by the caller simply
     ignoring logits past a row's real drafts — the arity stays fixed and
     the executable is one.
+
+    With ``depths``/``anc`` the window is a candidate TREE per slot
+    (BFS node order, node 0 = root, depth non-decreasing in node index,
+    the last node deepest): each node attends only its committed history
+    plus its own root path, and each node's output is bitwise what
+    sequentially decoding that root path would produce.  K/V rows still
+    come back in node-index order — the caller compacts the accepted
+    path's rows to depth positions afterwards (cache_move_rows).
     """
     S, W, h = x.shape
     fills = jnp.asarray(fills, jnp.int32)
-    pos = (fills[:, None]
-           + jnp.arange(W, dtype=jnp.int32)[None, :]).reshape(-1)
+    if depths is None:
+        pos = (fills[:, None]
+               + jnp.arange(W, dtype=jnp.int32)[None, :]).reshape(-1)
+        anc_flat = None
+    else:
+        pos = (fills[:, None]
+               + jnp.asarray(depths, jnp.int32)).reshape(-1)
+        anc_flat = jnp.asarray(anc, jnp.int32).reshape(S, W * W)
     hidden, k_rows, v_rows = _fused_paged_call(
         cfg, stacked, x.reshape(S * W, h), k_pool, v_pool, tables, pos,
-        fills, rope, window=W, interpret=interpret)
+        fills, rope, window=W, tree_anc=anc_flat, interpret=interpret)
     return hidden.reshape(S, W, h), k_rows, v_rows
 
 
 def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
-                      fills, rope, *, window: int,
+                      fills, rope, *, window: int, tree_anc=None,
                       interpret: bool | None = None):
     """Shared launch builder for the paged decode/verify kernels.
 
     ``x`` is the flattened [b = S·window, h] row batch, ``pos`` the [b]
     per-row cache positions (== ``fills`` when window == 1) driving both
     the RoPE rows and the per-row attention limits; ``fills`` stays [S]
-    per-slot for the lens[0] clamp parity."""
+    per-slot for the lens[0] clamp parity.  ``tree_anc`` ([S, W·W] int32,
+    flattened ancestor topology) switches the kernel to tree mode and
+    rides as a third prefetched scalar."""
     from ..ops.kv_quant import is_quantized_cache
     from ..ops.quant import int4_group_size, weight_bits
 
@@ -1377,7 +1458,10 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
         # append blocks must stream so the kernel can splice the window
         # K/V over their columns; un-allocated append entries point at
         # the trash block, whose columns are all spliced or masked.
-        def idx(li, ki, lens, tbl):
+        # Tree mode keeps the same clamp: BFS node order puts the
+        # deepest node last, so lens[1 + r·W + W-1] still bounds every
+        # row of the slot.
+        def idx(li, ki, lens, tbl, *s):
             t = jnp.minimum(ki, nk - 1)
             r = t // ntb
             j = t - r * ntb
@@ -1456,12 +1540,15 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
 
     compiler_params_cls = getattr(pltpu, "CompilerParams", None) \
         or pltpu.TPUCompilerParams
+    tree = tree_anc is not None
+    prefetch = (lens, tables) if not tree \
+        else (lens, tables, jnp.asarray(tree_anc, jnp.int32))
     hidden, k_rows, v_rows = pl.pallas_call(
         functools.partial(_decode_step_kernel_paged, aq, mq, gsz, cq8, W,
-                          ntb, nm, block_k,
+                          tree, ntb, nm, block_k,
                           b, nq, nkv, g, d, eps, scale, act),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=len(prefetch),
             grid=(L, nk + nm),
             in_specs=in_specs,
             out_specs=out_specs,
@@ -1473,5 +1560,5 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
             vmem_limit_bytes=110 * 1024 * 1024,
         ),
         interpret=interpret,
-    )(lens, tables, *operands)
+    )(*prefetch, *operands)
     return hidden[:b], k_rows[:, :, :, None, :], v_rows[:, :, :, None, :]

@@ -78,3 +78,30 @@ def falcon(size: str = "7b", **overrides) -> CausalLM:
 
 def gpt(size: str = "345m", **overrides) -> CausalLM:
     return CausalLM(validate_gpt(gpt_config(size, **overrides)))
+
+
+def draft_model(name: str, target: ModelConfig, **overrides) -> CausalLM:
+    """Resolve a resident draft-model config from a preset name
+    (config.PRESETS, e.g. ``"tiny"``) for tree speculation against
+    ``target`` (serving/engine.py, server CLI ``--draft_model``).
+
+    The draft's vocabulary is forced to the target's — every drafted
+    token must be verifiable by the target's argmax — and its position
+    range is widened to the target's so draft positions cover any slot
+    the engine can decode.  Everything else (depth, width, heads) stays
+    the preset's: the whole point is a model small enough that a handful
+    of draft forwards cost less than the tokens they save."""
+    import dataclasses
+
+    from ..config import get_preset
+
+    cfg = get_preset(name)
+    cfg = dataclasses.replace(
+        cfg,
+        vocab_size=target.vocab_size,
+        make_vocab_size_divisible_by=target.make_vocab_size_divisible_by,
+        seq_length=max(cfg.seq_length, target.seq_length),
+        max_position_embeddings=max(cfg.max_position_embeddings,
+                                    target.max_position_embeddings),
+        **overrides)
+    return CausalLM(cfg.validate())

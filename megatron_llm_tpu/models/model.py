@@ -360,6 +360,7 @@ def forward_cached_paged_verify(
     *,
     rope: Optional[tuple] = None,
     use_fused: bool = False,
+    tree: Optional[tuple] = None,
 ):
     """Batched variable-length speculative *verify* over the paged pool.
 
@@ -369,6 +370,24 @@ def forward_cached_paged_verify(
     dispatch runs the whole stack at positions ``fills[s] .. fills[s]+W-1``
     per row with per-row causal masking, returns logits for every window
     position, and appends the window's K/V rows to the pool.
+
+    ``tree`` switches the window from a linear token run to a candidate
+    *tree*: ``tree = (depths [S, W] int32, anc [S, W, W] int32)`` where
+    window column ``j`` is a tree node at depth ``depths[s, j]`` whose
+    ancestor at depth ``dd < depths[s, j]`` is node ``anc[s, j, dd]``
+    (entries at or past a node's depth are ignored and may be
+    arbitrary).  Nodes must be in BFS order — node 0 is the root (the
+    pending token, depth 0), parents precede children, and depths are
+    non-decreasing — so the deepest node is last and the kernel's
+    longest-row bookkeeping carries over.  Each node runs at position
+    ``fills[s] + depths[s, j]`` attending only to the committed prefix
+    plus its own root path, which makes every root-to-leaf path
+    bitwise-equal to sequentially decoding that path; K/V rows land
+    *node-indexed* at the caller's ``(bids, offs)`` (the engine passes
+    ``offs = fill + node``), and the caller compacts the accepted path
+    to depth-indexed positions afterwards (``cache_move_rows``).
+    A chain tree (``depths[s, j] = j``, ``anc[s, j, dd] = dd``)
+    reproduces the linear window exactly.
 
     Rollback is the caller's concern and costs nothing here: rejected
     rows were written to ``(bids, offs)`` slots that the next step simply
@@ -405,14 +424,22 @@ def forward_cached_paged_verify(
     tables = jnp.asarray(tables, jnp.int32)
     bids = jnp.asarray(bids, jnp.int32).reshape(S * W)
     offs = jnp.asarray(offs, jnp.int32).reshape(S * W)
+    depths = anc = None
+    if tree is not None:
+        depths = jnp.asarray(tree[0], jnp.int32)
+        anc = jnp.asarray(tree[1], jnp.int32)
     if use_fused:
         from ..kernels.decode_step import fused_decode_verify_paged
         from ..ops.kv_quant import is_quantized_cache, quantize_rows
 
-        pos = fills[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        if tree is None:
+            pos = fills[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        else:
+            pos = fills[:, None] + depths
         x = embed(cfg, params, window, pos)
         hidden, k_rows, v_rows = fused_decode_verify_paged(
-            cfg, params["layers"], x, k_pool, v_pool, tables, fills, rope)
+            cfg, params["layers"], x, k_pool, v_pool, tables, fills, rope,
+            depths=depths, anc=anc)
         if is_quantized_cache(k_pool):
             k_rows = quantize_rows(k_rows)
             v_rows = quantize_rows(v_rows)
@@ -424,17 +451,77 @@ def forward_cached_paged_verify(
         return logits.astype(jnp.float32), k_pool, v_pool
     k_dense = cache_gather_blocks(k_pool, tables)
     v_dense = cache_gather_blocks(v_pool, tables)
+    if tree is None:
+        steps = []
+        for j in range(W):
+            lj, k_dense, v_dense = forward_cached(
+                cfg, params, window[:, j:j + 1], k_dense, v_dense, fills + j,
+                rope=rope)
+            steps.append(lj)
+        logits = jnp.concatenate(steps, axis=1)
+        k_pool = cache_append_rows(
+            k_pool, cache_rows_range(k_dense, fills, W), bids, offs)
+        v_pool = cache_append_rows(
+            v_pool, cache_rows_range(v_dense, fills, W), bids, offs)
+        return logits, k_pool, v_pool
+    # Tree walk over the same gathered dense view: before each node's
+    # single-token step, overlay its ancestors' stored rows at dense
+    # positions fills+0 .. fills+depth-1 (deeper spec columns are never
+    # attended — forward_cached masks columns >= cache_len — so stale
+    # rows from a sibling path are invisible).  The per-step shapes and
+    # op sequence match sequential decode of the node's root path
+    # exactly, which is the bitwise guarantee; the extract/overlay
+    # round trip is pure gather/scatter at the dense dtype.
+    node_shape = lambda a: a.shape[:3] + (W,) + a.shape[4:]
+    k_nodes = jax.tree.map(lambda a: jnp.zeros(node_shape(a), a.dtype),
+                           k_dense)
+    v_nodes = jax.tree.map(lambda a: jnp.zeros(node_shape(a), a.dtype),
+                           v_dense)
+
+    def overlay(dense, nodes, j):
+        dj = depths[:, j]
+        for dd in range(W - 1):
+            a_idx = anc[:, j, dd]
+
+            def one(nd, dn):
+                idx = a_idx.reshape((1, -1) + (1,) * (nd.ndim - 2))
+                row = jnp.take_along_axis(nd, idx, axis=3)
+                cols = jnp.arange(dn.shape[3], dtype=jnp.int32)
+                hit = (cols[None, :] == (fills + dd)[:, None]) \
+                    & (dd < dj)[:, None]
+                hit = hit.reshape((1, S, 1, dn.shape[3])
+                                  + (1,) * (dn.ndim - 4))
+                return jnp.where(hit, row, dn)
+
+            dense = jax.tree.map(one, nodes, dense)
+        return dense
+
     steps = []
     for j in range(W):
+        k_dense = overlay(k_dense, k_nodes, j)
+        v_dense = overlay(v_dense, v_nodes, j)
+        pj = fills + depths[:, j]
         lj, k_dense, v_dense = forward_cached(
-            cfg, params, window[:, j:j + 1], k_dense, v_dense, fills + j,
+            cfg, params, window[:, j:j + 1], k_dense, v_dense, pj,
             rope=rope)
         steps.append(lj)
+        kr = cache_rows_at(k_dense, pj)
+        vr = cache_rows_at(v_dense, pj)
+        k_nodes = jax.tree.map(
+            lambda n, r: n.at[:, :, :, j:j + 1].set(r), k_nodes, kr)
+        v_nodes = jax.tree.map(
+            lambda n, r: n.at[:, :, :, j:j + 1].set(r), v_nodes, vr)
     logits = jnp.concatenate(steps, axis=1)
-    k_pool = cache_append_rows(
-        k_pool, cache_rows_range(k_dense, fills, W), bids, offs)
-    v_pool = cache_append_rows(
-        v_pool, cache_rows_range(v_dense, fills, W), bids, offs)
+
+    def node_rows(nodes):
+        def f(a):
+            tail = tuple(a.shape[4:])
+            r = jnp.moveaxis(a, 3, 2)                # [L, S, W, kv(,d)]
+            return r.reshape((a.shape[0], S * W, a.shape[2], 1) + tail)
+        return jax.tree.map(f, nodes)
+
+    k_pool = cache_append_rows(k_pool, node_rows(k_nodes), bids, offs)
+    v_pool = cache_append_rows(v_pool, node_rows(v_nodes), bids, offs)
     return logits, k_pool, v_pool
 
 
@@ -543,6 +630,28 @@ def cache_append_rows(pool, rows, bids, offs):
         return p.at[:, bids, :, offs].set(upd.astype(p.dtype))
 
     return jax.tree.map(ap, pool, rows)
+
+
+def cache_move_rows(pool, src_bids, src_offs, dst_bids, dst_offs):
+    """Copy pool rows ``(src_bids[i], src_offs[i])`` to
+    ``(dst_bids[i], dst_offs[i])`` in one functional gather-then-scatter
+    (every source row is read before any destination row is written, so
+    overlapping src/dst — tree-verify compaction moving accepted node
+    rows down to their depth positions — behaves as a simultaneous
+    move).  No-op entries point both sides at the trash block; duplicate
+    trash destinations collapse to one harmless write.  The int8
+    {q, scale} pytree moves leaf-wise, so quantized rows relocate
+    verbatim without a requantize round trip."""
+    src_bids = jnp.asarray(src_bids, jnp.int32)
+    src_offs = jnp.asarray(src_offs, jnp.int32)
+    dst_bids = jnp.asarray(dst_bids, jnp.int32)
+    dst_offs = jnp.asarray(dst_offs, jnp.int32)
+
+    def mv(p):
+        rows = p[:, src_bids, :, src_offs]       # [M, L, kv(, d)]
+        return p.at[:, dst_bids, :, dst_offs].set(rows)
+
+    return jax.tree.map(mv, pool)
 
 
 def cache_rows_at(dense, fills):

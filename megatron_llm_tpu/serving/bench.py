@@ -47,6 +47,41 @@ def _itl_recorder():
     return itl, make_stream
 
 
+def _warmup_executables(target, warm_requests, *, ensure_spec=None):
+    """Compile every serving executable outside the measured window.
+
+    ``target`` is anything with ``submit(prompt, max_new_tokens=..)`` —
+    a :class:`~.engine.ServingEngine` or a cluster ``Router``.
+    ``warm_requests`` is a list of ``(prompt, max_new_tokens)`` pairs
+    submitted as ONE burst before any result is awaited: on a router,
+    least-loaded (or phase) dispatch then spreads the idle-cluster burst
+    across replicas so every replica compiles — and a disaggregated pair
+    exercises prefill + export on one side, import + decode on the
+    other.
+
+    ``ensure_spec = (engine, prompt, gen_len)``: after the burst, if the
+    engine has not executed a single speculative step, re-run ``prompt``
+    (bounded retries) until it has, so the verify executable — the
+    linear n-gram window or the candidate tree, plus the resident draft
+    model's prefill/absorb/expand executables when a draft is loaded —
+    is compiled before the clock starts.  The n-gram drafter only
+    engages once the model's own continuation establishes a repeating
+    cycle, a few tokens in; a resident draft engages on the first
+    decode step; the same re-probe covers both.
+    """
+    handles = [target.submit(p, max_new_tokens=n, use_eos_stop=False)
+               for p, n in warm_requests]
+    for h in handles:
+        h.result(timeout=600)
+    if ensure_spec is not None:
+        engine, prompt, gen_len = ensure_spec
+        for _ in range(3):
+            if engine.metrics.snapshot()["spec_steps"] > 0:
+                break
+            engine.submit(prompt, max_new_tokens=gen_len,
+                          use_eos_stop=False).result(timeout=600)
+
+
 def run_serving_bench(cfg, params, *, num_requests: int = 24,
                       prompt_len: int = 128, gen_len: int = 128,
                       slots: int = 8, stagger_s: float = 0.0,
@@ -460,20 +495,13 @@ def run_spec_serving_bench(cfg, params, *, num_requests: int = 12,
         )).start()
         itl, make_stream = _itl_recorder()
         try:
-            # warmup: compile every executable outside the window.  The
-            # repetitive request runs at full gen_len so the verify path
-            # actually engages (drafts only hit once the model's own
-            # continuation establishes a repeating cycle, a few tokens
-            # in); the random one covers the plain pipelined path
-            engine.submit(reps[0], max_new_tokens=gen_len,
-                          use_eos_stop=False).result(timeout=600)
-            engine.submit(rands[0], max_new_tokens=8,
-                          use_eos_stop=False).result(timeout=600)
-            if spec and engine.metrics.snapshot()["spec_steps"] == 0:
-                # never speculated -> verify executable not yet built;
-                # one more repetitive pass usually engages it
-                engine.submit(reps[0], max_new_tokens=gen_len,
-                              use_eos_stop=False).result(timeout=600)
+            # warmup: the repetitive request runs at full gen_len so the
+            # verify path actually engages; the random one covers the
+            # plain pipelined path (_warmup_executables re-probes until
+            # a spec step has run)
+            _warmup_executables(
+                engine, [(reps[0], gen_len), (rands[0], 8)],
+                ensure_spec=(engine, reps[0], gen_len) if spec else None)
             engine.metrics = ServingMetrics(slots)
 
             t0 = time.perf_counter()
@@ -529,6 +557,130 @@ def run_spec_serving_bench(cfg, params, *, num_requests: int = 12,
     }
 
 
+def run_spec_tree_serving_bench(cfg, params, *, num_requests: int = 12,
+                                prompt_len: int = 96, gen_len: int = 64,
+                                slots: int = 4, draft_len: int = 4,
+                                motif_len: int = 8,
+                                draft_cfg=None, draft_params=None,
+                                seed: int = 0) -> dict:
+    """Resident-draft tree-speculation point (docs/serving.md, "Tree
+    speculation & resident drafts"): draft on vs off at IDENTICAL engine
+    geometry, on the same two traffic shapes as the n-gram point.
+
+    The n-gram drafter's ceiling is the traffic itself: on
+    incompressible prompts its acceptance is ~0 and the policy's best
+    move is to stand down (``serving_spec_random_overhead`` ≈ 1.0 in the
+    PLD point).  A resident draft model has no such ceiling — it drafts
+    candidate TREES from actual model predictions every iteration, so
+    the **random wave** is the headline here:
+    ``serving_spec_tree_itl_speedup`` (draft-off p50 / draft-on p50 on
+    random traffic) is what the ``--compare`` gate watches, with the
+    repetitive wave alongside for parity with the PLD point.
+
+    ``draft_cfg``/``draft_params`` default to the TARGET itself — a
+    perfect-oracle self-draft.  That is the acceptance upper bound, not
+    a deployment configuration (a real deployment loads a distilled
+    small draft via ``--draft_model``): it measures the tree-speculation
+    MECHANICS — multi-token commits per engine iteration, tree verify,
+    accept/rollback — without needing a trained draft pair, which is the
+    right harness for a random-init bench model whose argmax no small
+    model could match.  Tokens are bitwise invariant to the toggle
+    (tests/serving/test_sanitize.py), so both runs do exactly the same
+    work per request.
+    """
+    import numpy as np
+
+    from .engine import EngineConfig, ServingEngine
+    from .metrics import ServingMetrics
+
+    if draft_cfg is None:
+        draft_cfg, draft_params = cfg, params
+    rng = np.random.default_rng(seed)
+    motifs = [rng.integers(1, cfg.vocab_size, motif_len).tolist()
+              for _ in range(num_requests)]
+    reps = [(m * (prompt_len // len(m) + 1))[:prompt_len] for m in motifs]
+    rands = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+             for _ in range(num_requests)]
+
+    def one_run(prompts, draft: bool) -> dict:
+        engine = ServingEngine(
+            cfg, params,
+            EngineConfig(
+                max_batch_size=slots,
+                max_seq_len=min(prompt_len + gen_len,
+                                cfg.max_position_embeddings),
+                max_queue_size=max(num_requests, slots),
+                prefill_bucket=prompt_len,
+                spec_draft_len=draft_len if draft else 0,
+            ),
+            draft_cfg=draft_cfg if draft else None,
+            draft_params=draft_params if draft else None).start()
+        itl, make_stream = _itl_recorder()
+        try:
+            # the resident draft engages on the first greedy decode
+            # step, but the shared re-probe also covers a cold EWMA
+            _warmup_executables(
+                engine, [(prompts[0], gen_len), (prompts[-1], 8)],
+                ensure_spec=(engine, prompts[0], gen_len) if draft
+                else None)
+            engine.metrics = ServingMetrics(slots)
+
+            t0 = time.perf_counter()
+            handles = [engine.submit(p, max_new_tokens=gen_len,
+                                     use_eos_stop=False,
+                                     on_token=make_stream())
+                       for p in prompts]
+            results = [h.result(timeout=600) for h in handles]
+            dt = time.perf_counter() - t0
+        finally:
+            engine.shutdown()
+        n_tokens = sum(len(r.tokens) - r.prompt_len for r in results)
+        snap = engine.metrics.snapshot()
+        return {
+            "tokens_per_sec": round(n_tokens / dt, 1),
+            "itl_ms_p50": round(itl.percentile(50) * 1e3, 3),
+            "itl_ms_p99": round(itl.percentile(99) * 1e3, 3),
+            "acceptance_rate": round(snap["spec_acceptance_rate"], 4),
+            "accepted_per_step_mean": round(
+                snap["accepted_tokens_per_step"]["mean"], 3),
+            "spec_steps": snap["spec_steps"],
+            "by_source": snap["spec_by_source"],
+        }
+
+    rnd_on = one_run(rands, True)
+    rnd_off = one_run(rands, False)
+    rep_on = one_run(reps, True)
+    rep_off = one_run(reps, False)
+    return {
+        # headline: random traffic, where the n-gram drafter cannot help
+        "serving_spec_tree_itl_ms_p50": rnd_on["itl_ms_p50"],
+        "serving_spec_tree_itl_ms_p99": rnd_on["itl_ms_p99"],
+        "serving_spec_tree_off_itl_ms_p50": rnd_off["itl_ms_p50"],
+        "serving_spec_tree_itl_speedup": round(
+            rnd_off["itl_ms_p50"] / max(1e-9, rnd_on["itl_ms_p50"]), 3),
+        "serving_spec_tree_tokens_per_sec": rnd_on["tokens_per_sec"],
+        "serving_spec_tree_off_tokens_per_sec": rnd_off["tokens_per_sec"],
+        "serving_spec_tree_acceptance_rate": rnd_on["acceptance_rate"],
+        "serving_spec_tree_accepted_per_step_mean":
+            rnd_on["accepted_per_step_mean"],
+        "serving_spec_tree_steps": rnd_on["spec_steps"],
+        "serving_spec_tree_model_steps":
+            rnd_on["by_source"].get("model", {}).get("steps", 0),
+        # repetitive wave, for parity with the n-gram PLD point
+        "serving_spec_tree_rep_itl_ms_p50": rep_on["itl_ms_p50"],
+        "serving_spec_tree_rep_off_itl_ms_p50": rep_off["itl_ms_p50"],
+        "serving_spec_tree_rep_itl_speedup": round(
+            rep_off["itl_ms_p50"] / max(1e-9, rep_on["itl_ms_p50"]), 3),
+        "serving_spec_tree_rep_acceptance_rate": rep_on["acceptance_rate"],
+        "serving_spec_tree_draft_len": draft_len,
+        "serving_spec_tree_self_draft": int(draft_cfg is cfg),
+        "serving_spec_tree_num_requests": num_requests,
+        "serving_spec_tree_slots": slots,
+        "serving_spec_tree_prompt_len": prompt_len,
+        "serving_spec_tree_gen_len": gen_len,
+    }
+
+
 def run_cluster_serving_bench(cfg, params, *, num_requests: int = 16,
                               gen_len: int = 32, slots: int = 4,
                               max_prompt_len: int = 64, replicas: int = 2,
@@ -580,14 +732,8 @@ def run_cluster_serving_bench(cfg, params, *, num_requests: int = 16,
         itl, make_stream = _itl_recorder()
         try:
             # warmup: one request per replica compiles every replica's
-            # executables outside the window (least-loaded dispatch
-            # spreads an idle-cluster burst one per replica)
-            warm = router.submit_many([
-                dict(prompt=prompts[0], max_new_tokens=2,
-                     use_eos_stop=False, seed=0)
-                for _ in range(n_replicas)])
-            for h in warm:
-                h.result(timeout=600)
+            # executables (least-loaded dispatch spreads the burst)
+            _warmup_executables(router, [(prompts[0], 2)] * n_replicas)
 
             t0 = time.perf_counter()
             handles = router.submit_many([
@@ -753,17 +899,10 @@ def run_disagg_serving_bench(cfg, params, *, num_requests: int = 16,
 
         try:
             # warmup: two requests compile every executable on both
-            # replicas outside the window.  Colocated: least-loaded
-            # dispatch spreads the idle-cluster pair one per replica.
-            # Disagg: phase routing sends both through the prefill
-            # replica, which ships to the decode replica — one pass
-            # compiles prefill + export on one side, import + decode on
-            # the other.
-            warm = router.submit_many([
-                dict(prompt=prompts[0], max_new_tokens=2,
-                     use_eos_stop=False, seed=0) for _ in range(2)])
-            for h in warm:
-                h.result(timeout=600)
+            # replicas (colocated: least-loaded dispatch spreads the
+            # idle-cluster pair; disagg: both route through the prefill
+            # replica and ship to the decode replica)
+            _warmup_executables(router, [(prompts[0], 2)] * 2)
 
             t0 = time.perf_counter()
             handles = [router.submit(
@@ -870,6 +1009,9 @@ def main() -> None:
     out.update(run_spec_serving_bench(cfg, params, num_requests=6,
                                       prompt_len=32, gen_len=16,
                                       slots=2, draft_len=3))
+    out.update(run_spec_tree_serving_bench(cfg, params, num_requests=6,
+                                           prompt_len=32, gen_len=16,
+                                           slots=2, draft_len=3))
     if len(jax.devices()) >= 2:
         out.update(run_cluster_serving_bench(cfg, params, num_requests=6,
                                              gen_len=8, slots=2,

@@ -8,6 +8,13 @@ tp, weights resident, int8 ``{"q", "scale"}`` subtrees via
 int32 — block ids are global on every shard, so the engine's entire
 ledger (free list, refs, reservations, prefix trie) is untouched.
 
+A resident draft model (tree speculation, docs/serving.md) rides the
+same machinery: its params re-shard with ``serving_param_specs`` of the
+*draft* config onto the same submesh, so tp-sharded and disaggregated
+decode replicas speculate exactly like the single-chip engine.  Draft
+KV never ships — each decode replica rebuilds it with one cheap dense
+prefill on install.
+
 At tp=1 this builds the plain single-chip engine — same executable,
 bitwise-identical tokens — so the cluster path has no single-chip tax.
 """
@@ -23,41 +30,62 @@ from ..engine import EngineConfig, ServingEngine
 from ..metrics import ServingMetrics
 
 
+def _shard_for_serving(cfg: ModelConfig, params, parallel, mesh):
+    """Re-lay a param tree (target or draft) onto a serving submesh,
+    routing any int8/int4 ``{"q", "scale"}`` subtrees through
+    ``quantize_specs`` so quantized residency survives the reshard."""
+    from ...models import sharding as shard_lib
+    from ...ops import quant
+
+    specs = shard_lib.serving_param_specs(cfg, parallel)
+    if any(quant.is_quantized(w)
+           for w in jax.tree.leaves(params, is_leaf=quant.is_quantized)
+           if isinstance(w, dict)):
+        specs = quant.quantize_specs(specs, params)
+    return shard_lib.shard_params(params, specs, mesh)
+
+
 def build_sharded_engine(cfg: ModelConfig, params,
                          engine_config: Optional[EngineConfig] = None,
                          parallel: Optional[ParallelConfig] = None,
                          devices: Optional[Sequence[jax.Device]] = None,
                          metrics: Optional[ServingMetrics] = None,
+                         draft_cfg: Optional[ModelConfig] = None,
+                         draft_params=None,
                          ) -> ServingEngine:
     """One engine over one submesh.
 
     ``devices`` is the submesh's device slice (defaults to the first
     pp·tp of ``jax.devices()``); ``params`` are re-laid-out onto it with
-    the serving re-layout.  With pp·tp == 1 and no explicit devices this
-    returns the ordinary single-chip engine (mesh=None) so the fused
-    single-device kernels stay eligible.
+    the serving re-layout, and ``draft_params`` (resident draft model,
+    if any) follow with their own config's specs.  With pp·tp == 1 and
+    no explicit devices this returns the ordinary single-chip engine
+    (mesh=None) so the fused single-device kernels stay eligible.
     """
-    from ...models import sharding as shard_lib
     from ...parallel import mesh as mesh_lib
 
     parallel = parallel or ParallelConfig()
     tp_eff = parallel.pipeline_parallel * parallel.tensor_parallel
     if tp_eff == 1 and devices is None:
-        return ServingEngine(cfg, params, engine_config, metrics=metrics)
+        return ServingEngine(cfg, params, engine_config, metrics=metrics,
+                             draft_cfg=draft_cfg,
+                             draft_params=draft_params)
     assert cfg.num_attention_heads % tp_eff == 0, (
         f"serving re-layout shards heads over pp·tp = {tp_eff}, which "
         f"must divide num_attention_heads = {cfg.num_attention_heads}")
+    if draft_cfg is not None:
+        assert draft_cfg.num_attention_heads % tp_eff == 0, (
+            f"draft model heads ({draft_cfg.num_attention_heads}) must "
+            f"divide pp·tp = {tp_eff} to reshard with the target; pick "
+            f"a wider draft or a narrower submesh")
     mesh = mesh_lib.build_mesh(parallel, devices=devices)
-    specs = shard_lib.serving_param_specs(cfg, parallel)
-    from ...ops import quant
-
-    if any(quant.is_quantized(w)
-           for w in jax.tree.leaves(params, is_leaf=quant.is_quantized)
-           if isinstance(w, dict)):
-        specs = quant.quantize_specs(specs, params)
-    sharded = shard_lib.shard_params(params, specs, mesh)
+    sharded = _shard_for_serving(cfg, params, parallel, mesh)
+    sharded_draft = (None if draft_params is None else
+                     _shard_for_serving(draft_cfg, draft_params, parallel,
+                                        mesh))
     return ServingEngine(cfg, sharded, engine_config, metrics=metrics,
-                         mesh=mesh)
+                         mesh=mesh, draft_cfg=draft_cfg,
+                         draft_params=sharded_draft)
 
 
 def build_cluster(cfg: ModelConfig, params,
@@ -65,7 +93,9 @@ def build_cluster(cfg: ModelConfig, params,
                   *, replicas: int = 1,
                   parallel: Optional[ParallelConfig] = None,
                   router_config=None,
-                  devices: Optional[Sequence[jax.Device]] = None):
+                  devices: Optional[Sequence[jax.Device]] = None,
+                  draft_cfg: Optional[ModelConfig] = None,
+                  draft_params=None):
     """N sharded engine replicas on disjoint device slices behind one
     :class:`~..cluster.router.Router`.
 
@@ -86,7 +116,8 @@ def build_cluster(cfg: ModelConfig, params,
         engines.append(ServingEngine(
             cfg, params, engine_config,
             metrics=ServingMetrics(engine_config.max_batch_size,
-                                   register=False)))
+                                   register=False),
+            draft_cfg=draft_cfg, draft_params=draft_params))
     else:
         meshes = mesh_lib.replica_submeshes(parallel, replicas,
                                             devices=devices)
@@ -95,7 +126,8 @@ def build_cluster(cfg: ModelConfig, params,
                 cfg, params, engine_config, parallel,
                 devices=mesh.devices.flatten().tolist(),
                 metrics=ServingMetrics(engine_config.max_batch_size,
-                                       register=False)))
+                                       register=False),
+                draft_cfg=draft_cfg, draft_params=draft_params))
     return Router(engines, router_config or RouterConfig())
 
 
@@ -105,7 +137,9 @@ def build_disagg_cluster(cfg: ModelConfig, params,
                          decode_replicas: int = 1,
                          parallel: Optional[ParallelConfig] = None,
                          router_config=None,
-                         devices: Optional[Sequence[jax.Device]] = None):
+                         devices: Optional[Sequence[jax.Device]] = None,
+                         draft_cfg: Optional[ModelConfig] = None,
+                         draft_params=None):
     """Disaggregated prefill/decode cluster: ``prefill_replicas``
     prefill-specialized engines + ``decode_replicas`` decode engines on
     disjoint device slices behind one phase-routing Router
@@ -121,6 +155,12 @@ def build_disagg_cluster(cfg: ModelConfig, params,
     grid only shapes the attention *schedule*, never its math, but it is
     applied strictly per-role so the dot-product fallback configs stay
     byte-identical across roles.
+
+    A resident draft model is handed to every replica, but only decode
+    (and mixed) roles ever run it: prefill-role engines skip the draft
+    prefill entirely and the adopting decode replica rebuilds the draft
+    KV from the shipped request's tokens — a shipment carries no draft
+    state.
     """
     import dataclasses as _dc
 
@@ -150,5 +190,6 @@ def build_disagg_cluster(cfg: ModelConfig, params,
         engines.append(build_sharded_engine(
             prefill_cfg if is_prefill else cfg, params, ec, parallel,
             devices=mesh.devices.flatten().tolist(),
-            metrics=ServingMetrics(ec.max_batch_size, register=False)))
+            metrics=ServingMetrics(ec.max_batch_size, register=False),
+            draft_cfg=draft_cfg, draft_params=draft_params))
     return Router(engines, router_config or RouterConfig())

@@ -206,6 +206,13 @@ class EngineConfig:
     spec_ngram: int = 3           # trailing n-gram length the drafter
     #                               matches on (longer = fewer, better
     #                               drafts)
+    spec_reprobe_interval: int = 16  # how many zero-draft iterations a
+    #                               slot whose acceptance EWMA collapsed
+    #                               the draft budget to zero waits before
+    #                               probing again with a single token —
+    #                               so a repetitive (or draftable)
+    #                               stretch later in the generation can
+    #                               re-engage speculation
     sanitize: bool = False        # runtime sanitizers (analysis/
     #                               sanitizers.py): per-iteration block-
     #                               pool ledger checks, a leak report at
@@ -492,13 +499,134 @@ _verify_plain = functools.partial(
     jax.jit, static_argnames=("cfg", "use_fused"))(_verify_impl)
 
 
+def _verify_tree_impl(cfg: ModelConfig, params, k_pool, v_pool, tables,
+                      window, depths, anc, fills, bids, offs, seeds,
+                      counters, greedy, temps, top_ks, top_ps, *,
+                      use_fused: bool):
+    """Tree-verify twin of ``_verify_impl``: the window columns are the
+    nodes of a per-slot candidate tree (``depths``/``anc``, see
+    forward_cached_paged_verify) instead of a linear run, so one forward
+    scores every root-to-leaf branch the resident draft model proposed.
+    Node 0 is the root (the pending token at the slot's fill position)
+    and samples exactly like a plain step — same ``_sample_slots``, same
+    RNG fold — so rider slots with a root-only tree take a
+    bitwise-unchanged step.  Deeper nodes only ever commit under greedy
+    acceptance along a root path, so pad-masked argmax is their whole
+    sampling story.  K/V rows land node-indexed at ``(bids, offs)``;
+    the host compacts the accepted path afterwards."""
+    rope = model_lib.rope_tables(cfg)
+    logits, k_pool, v_pool = model_lib.forward_cached_paged_verify(
+        cfg, params, window, k_pool, v_pool, tables, fills, bids, offs,
+        rope=rope, use_fused=use_fused, tree=(depths, anc))
+    tok0, tok0_lp = _sample_slots(logits[:, 0], seeds, counters, greedy,
+                                  temps, top_ks, top_ps, cfg.vocab_size)
+    V = logits.shape[-1]
+    pad = jnp.arange(V) >= cfg.vocab_size
+    masked = jnp.where(pad[None, None, :], NEG_INF, logits)
+    g_tok = jnp.argmax(masked, axis=-1).astype(jnp.int32)       # [S, W]
+    lp = jax.nn.log_softmax(masked, axis=-1)
+    g_lp = jnp.take_along_axis(lp, g_tok[..., None], axis=-1)[..., 0]
+    g_tok = g_tok.at[:, 0].set(tok0)
+    g_lp = g_lp.at[:, 0].set(tok0_lp)
+    return g_tok, g_lp, k_pool, v_pool
+
+
+_verify_tree_donated = functools.partial(
+    jax.jit, static_argnames=("cfg", "use_fused"),
+    donate_argnums=(2, 3))(_verify_tree_impl)
+_verify_tree_plain = functools.partial(
+    jax.jit, static_argnames=("cfg", "use_fused"))(_verify_tree_impl)
+
+
+# number of candidate branches the resident draft model surfaces per
+# window position: branch 0 extends the main chain, branch 1 is the
+# depth-1 hedge leaf (the tree planner never fans wider, so a static 2
+# keeps the draft-step executable's output shape fixed)
+_DRAFT_TOPK = 2
+
+
+def _draft_step_impl(cfg: ModelConfig, params, k_pool, v_pool, tables,
+                     window, fills, bids, offs, *, use_fused: bool):
+    """One resident-draft forward over the draft model's shadow pool:
+    a chain verify of up to W tokens per slot at the slot's own draft
+    positions, returning the top-``_DRAFT_TOPK`` candidate tokens per
+    position instead of full logits (the tree planner only needs the
+    ranked heads, and [S, W, 2] int32 keeps the host transfer tiny).
+    Serves both draft phases with ONE executable: the absorb pass
+    (committed tokens at real block destinations, advancing the draft
+    fill) and chain expansions (speculative tokens routed to the trash
+    block, draft fill untouched).  Draft numerics never touch committed
+    trajectories — candidates only steer which tokens the TARGET
+    verifies — so there is no bitwise bar here, just fixed shapes."""
+    rope = model_lib.rope_tables(cfg)
+    logits, k_pool, v_pool = model_lib.forward_cached_paged_verify(
+        cfg, params, window, k_pool, v_pool, tables, fills, bids, offs,
+        rope=rope, use_fused=use_fused)
+    V = logits.shape[-1]
+    pad = jnp.arange(V) >= cfg.vocab_size
+    masked = jnp.where(pad[None, None, :], NEG_INF, logits)
+    _, cand = jax.lax.top_k(masked, _DRAFT_TOPK)
+    return cand.astype(jnp.int32), k_pool, v_pool
+
+
+_draft_step_donated = functools.partial(
+    jax.jit, static_argnames=("cfg", "use_fused"),
+    donate_argnums=(2, 3))(_draft_step_impl)
+_draft_step_plain = functools.partial(
+    jax.jit, static_argnames=("cfg", "use_fused"))(_draft_step_impl)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_seq_len"))
+def _draft_prefill_impl(cfg: ModelConfig, params, tokens, *,
+                        max_seq_len: int):
+    """Dense draft-model prefill of one request's context (batch 1,
+    always padded to the full slot width so this stays ONE compiled
+    shape per engine).  Rows past the real context hold pad-token K/V
+    that the draft fill level masks and absorb steps overwrite in
+    order — the same ragged-prefill argument as the target's bucketed
+    prefill, minus the bucketing."""
+    rope = model_lib.rope_tables(cfg)
+    k, v = model_lib.init_kv_cache(cfg, 1, max_seq_len)
+    _, k, v = model_lib.forward_cached(
+        cfg, params, tokens, k, v, jnp.int32(0), rope=rope,
+        empty_cache=True, last_logit_only=True)
+    return k, v
+
+
+def _draft_install_impl(k_pool, v_pool, k_small, v_small, bids):
+    """Publish a dense draft prefill into the draft shadow pool at the
+    slot's (target-governed) block ids; trash entries skip."""
+    return (model_lib.cache_scatter_blocks(k_pool, k_small, bids),
+            model_lib.cache_scatter_blocks(v_pool, v_small, bids))
+
+
+_draft_install_donated = functools.partial(
+    jax.jit, donate_argnums=(0, 1))(_draft_install_impl)
+_draft_install_plain = jax.jit(_draft_install_impl)
+
+
+def _move_rows_impl(k_pool, v_pool, src_bids, src_offs, dst_bids,
+                    dst_offs):
+    """Compact a verify step's accepted tree paths: move the accepted
+    node-indexed K/V rows down to their depth positions in both pools
+    (models/model.py:cache_move_rows — functional gather-then-scatter,
+    so overlapping moves behave simultaneously).  Fixed [S·W] operand
+    arrays; no-op entries route trash -> trash."""
+    return (model_lib.cache_move_rows(k_pool, src_bids, src_offs,
+                                      dst_bids, dst_offs),
+            model_lib.cache_move_rows(v_pool, src_bids, src_offs,
+                                      dst_bids, dst_offs))
+
+
+_move_rows_donated = functools.partial(
+    jax.jit, donate_argnums=(0, 1))(_move_rows_impl)
+_move_rows_plain = jax.jit(_move_rows_impl)
+
+
 # speculative decoding policy: weight of the newest per-slot acceptance
-# observation in the EWMA that scales the draft budget, and how many
-# zero-draft iterations a collapsed slot waits before probing again with
-# a single draft token (so a repetitive stretch later in the generation
-# can re-engage speculation)
+# observation in the EWMA that scales the draft budget (the re-probe
+# interval for collapsed slots is EngineConfig.spec_reprobe_interval)
 _SPEC_EWMA_ALPHA = 0.3
-_SPEC_PROBE_INTERVAL = 16
 
 
 def _ngram_draft_host(ctx: Sequence[int], ngram: int,
@@ -607,6 +735,10 @@ class _SlotState:
         #                           carried no draft — drives the
         #                           periodic re-probe once the budget
         #                           collapses to zero
+        self.draft_fill = 0       # rows of this slot's context absorbed
+        #                           into the resident draft model's
+        #                           shadow KV pool (<= fill + 1; 0 when
+        #                           no draft model is resident)
 
 
 class _Inflight:
@@ -656,9 +788,25 @@ class ServingEngine:
     def __init__(self, cfg: ModelConfig, params,
                  engine_config: Optional[EngineConfig] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 mesh=None):
+                 mesh=None, draft_cfg: Optional[ModelConfig] = None,
+                 draft_params=None):
         self.cfg = cfg
         self.params = params
+        # Resident draft model (speculative decoding beyond prompt
+        # lookup): a small model sharing the target's vocabulary whose
+        # on-device forwards propose candidate TREES for the tree-verify
+        # kernel.  It keeps a shadow paged KV pool aligned to the
+        # target's block tables (same bids, its own head geometry) so
+        # drafting needs no second ledger.
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
+        if draft_cfg is not None:
+            assert draft_params is not None, \
+                "draft_cfg requires draft_params"
+            assert draft_cfg.vocab_size == cfg.vocab_size, (
+                f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                f"{cfg.vocab_size}: draft tokens must be verifiable")
+        self._draft_kv = None     # (k_pool, v_pool) shadow pool, start()
         # Serving submesh (serving/cluster/): params arrive pre-sharded
         # (models/sharding.py:shard_for_serving layout), the paged pool
         # is placed head-sharded at start(), and the scheduler thread
@@ -690,6 +838,18 @@ class ServingEngine:
                         else _decode_donated)
         self._verify = (_verify_plain if jax.default_backend() == "cpu"
                         else _verify_donated)
+        self._verify_tree = (
+            _verify_tree_plain if jax.default_backend() == "cpu"
+            else _verify_tree_donated)
+        self._draft_step = (
+            _draft_step_plain if jax.default_backend() == "cpu"
+            else _draft_step_donated)
+        self._draft_install = (
+            _draft_install_plain if jax.default_backend() == "cpu"
+            else _draft_install_donated)
+        self._move_rows = (
+            _move_rows_plain if jax.default_backend() == "cpu"
+            else _move_rows_donated)
         self._prefill_chunk_fn = (
             _prefill_chunk_plain if jax.default_backend() == "cpu"
             else _prefill_chunk_donated)
@@ -733,6 +893,10 @@ class ServingEngine:
         # attribute each decode iteration to fused_steps/fallback_steps
         self._fused_decode = False
         self._fused_verify = False  # same, for the multi-token verify step
+        self._fused_draft = False   # same, for the draft model's forwards
+        # draft model actually engaged: resident params AND speculation on
+        self._draft_enabled = (self.draft_cfg is not None
+                               and self.config.spec_draft_len > 0)
         # weight precision route (ops/quant.py:precision_route) labelling
         # the fused/fallback counters per precision — resolved at start()
         self._precision_route = "fp32"
@@ -778,8 +942,33 @@ class ServingEngine:
                 if cfg_e.spec_draft_len > 0:
                     from ..kernels.decode_step import (
                         fused_paged_verify_eligible)
+                    # tree mode widens two splice temps to full (b, nkv,
+                    # block_k, d) broadcasts, so eligibility is resolved
+                    # against the stricter VMEM budget when a draft model
+                    # will be proposing trees
                     self._fused_verify = fused_paged_verify_eligible(
                         self.cfg, self.params, pool.k_pool,
+                        cfg_e.max_batch_size, cfg_e.spec_draft_len + 1,
+                        self.slots.table_blocks, jax.default_backend(),
+                        mesh=self.mesh, tree=self._draft_enabled)
+                if self._draft_enabled:
+                    # shadow paged pool for the draft model: SAME block
+                    # count and block size as the target pool so the
+                    # target's block tables index both — no second
+                    # ledger, no separate alloc/free, and trash (block
+                    # 0) masks identically.  Only the head geometry
+                    # differs (draft_cfg's kv heads / head dim).
+                    dk, dv = model_lib.init_kv_pool(
+                        self.draft_cfg, n_blocks, bk)
+                    if self.mesh is not None:
+                        from ..models import sharding as shard_lib
+                        dk, dv = shard_lib.shard_kv_pool(
+                            dk, dv, self.draft_cfg, self.mesh)
+                    self._draft_kv = (dk, dv)
+                    from ..kernels.decode_step import (
+                        fused_paged_verify_eligible)
+                    self._fused_draft = fused_paged_verify_eligible(
+                        self.draft_cfg, self.draft_params, dk,
                         cfg_e.max_batch_size, cfg_e.spec_draft_len + 1,
                         self.slots.table_blocks, jax.default_backend(),
                         mesh=self.mesh)
@@ -1277,6 +1466,8 @@ class ServingEngine:
         st = _SlotState(req, fill=len(req.prompt), pending=first_tok)
         st.lease = ps.lease
         self._active[ps.slot] = st
+        if self._draft_enabled and self.config.role != "prefill":
+            self._draft_prefill(ps.slot, st)
         self._commit_token(ps.slot, first_tok, float(np.asarray(tok_lp)[0]))
         self._maybe_handoff(ps.slot)
 
@@ -1384,6 +1575,10 @@ class ServingEngine:
         st = _SlotState(req, fill=plen, pending=first)
         st.lease = lease
         self._active[slot] = st
+        if self._draft_enabled and self.config.role != "prefill":
+            # prefill-role engines hand the slot off immediately; the
+            # decode replica re-prefills the draft on install instead
+            self._draft_prefill(slot, st)
         self._commit_token(slot, first, float(np.asarray(tok_lp)[0]))
         self._maybe_handoff(slot)
         return True
@@ -1405,10 +1600,16 @@ class ServingEngine:
         forward runs, and up to draft_len+1 tokens commit per slot."""
         if self.config.spec_draft_len > 0 and self._plan_spec():
             self._flush_inflight()
-            drafts = self._build_drafts()
-            if drafts:
-                self._spec_step(drafts)
-                return
+            if self._draft_enabled:
+                plans = self._plan_tree_budgets()
+                if plans:
+                    self._spec_step_tree(plans)
+                    return
+            else:
+                drafts = self._build_drafts()
+                if drafts:
+                    self._spec_step(drafts)
+                    return
         it0 = time.perf_counter()
         t = self.metrics.timers("serving-decode", 2)
         t.start()
@@ -1435,11 +1636,13 @@ class ServingEngine:
     def _spec_budget(self, st: _SlotState) -> int:
         """Draft-token budget from the slot's acceptance EWMA; a slot
         the policy collapsed to zero re-probes with one token every
-        ``_SPEC_PROBE_INTERVAL`` iterations so a repetitive stretch
-        later in the generation can re-engage speculation."""
+        ``EngineConfig.spec_reprobe_interval`` iterations so a
+        repetitive stretch later in the generation can re-engage
+        speculation."""
         k = int(round(st.spec_ewma * self.config.spec_draft_len))
         if k < 1:
-            return 1 if st.spec_stall >= _SPEC_PROBE_INTERVAL else 0
+            return (1 if st.spec_stall >= self.config.spec_reprobe_interval
+                    else 0)
         return k
 
     def _plan_spec(self) -> bool:
@@ -1466,8 +1669,13 @@ class ServingEngine:
             if self._spec_budget(st) < 1:
                 st.spec_stall += 1
                 continue
-            if _ngram_draft_host(st.req.prompt + st.req.generated,
-                                 self.config.spec_ngram, 1):
+            if self._draft_enabled:
+                # a resident draft model always has something to propose
+                # (no n-gram match required), so a budgeted greedy slot
+                # is enough to pay for the flush
+                want = True
+            elif _ngram_draft_host(st.req.prompt + st.req.generated,
+                                   self.config.spec_ngram, 1):
                 want = True
             else:
                 st.spec_stall += 1
@@ -1492,6 +1700,159 @@ class ServingEngine:
                 drafts[slot] = d
                 st.spec_stall = 0
         return drafts
+
+    def _plan_tree_budgets(self) -> dict:
+        """slot -> draft-token budget for this tree-verify step
+        (resident-draft twin of ``_build_drafts``).  Authoritative: the
+        pipeline is flushed, so the remaining-token budgets are exact.
+        The budget counts DRAFT tokens (tree nodes minus the root); the
+        tree planner decides how to spend it between the main chain and
+        the depth-1 hedge."""
+        plans = {}
+        for slot, st in self._active.items():
+            if not st.req.greedy:
+                continue
+            rem = st.req.max_new_tokens - len(st.req.generated)
+            k_cap = min(self.config.spec_draft_len, self._spec_budget(st),
+                        rem - 1)
+            if k_cap < 1:
+                continue
+            plans[slot] = k_cap
+            st.spec_stall = 0
+        return plans
+
+    def _draft_prefill(self, slot: int, st: _SlotState) -> None:
+        """Absorb a slot's committed context into the resident draft
+        model's shadow pool in one dense prefill (padded to the slot
+        width: ONE compiled shape per engine), published at the slot's
+        target-governed block table.  Runs at admission and after a
+        migration install; the pending token and later commits are
+        absorbed incrementally by ``_spec_step_tree``.
+
+        Blocks shared through the prefix cache get their draft rows
+        rewritten with identical values (same tokens, same deterministic
+        draft forward), so concurrent leaseholders are unaffected.
+        After a target-side COW the new block's older draft rows are
+        stale pad-K/V — harmless: draft output only steers which tokens
+        the TARGET verifies, never what commits."""
+        ctx = list(st.req.prompt) + list(st.req.generated)
+        n = min(st.fill, len(ctx))
+        toks = np.zeros((1, self.slots.width), np.int32)
+        toks[0, :n] = ctx[:n]
+        with device_annotation("draft_prefill"):
+            k_small, v_small = _draft_prefill_impl(
+                self.draft_cfg, self.draft_params, jnp.asarray(toks),
+                max_seq_len=self.slots.width)
+            dk, dv = self._draft_kv
+            bids = jnp.asarray(self.slots.tables[slot])
+            # tpulint: allow[lock-discipline] scheduler-thread-owned;
+            # the start() write under the lock precedes thread launch
+            self._draft_kv = self._draft_install(dk, dv, k_small, v_small,
+                                                 bids)
+        st.draft_fill = n
+
+    def _draft_absorb(self, plans: dict, tables) -> dict:
+        """Catch each planned slot's draft cache up to ``fill + 1`` rows
+        (context plus the pending token) in W-token chunks, and return
+        slot -> [top1, top2] candidate continuations of the pending
+        token from the final chunk's last real position.
+
+        In speculative steady state every slot is exactly ``acc + 1 <=
+        W`` rows behind (the tokens the last verify committed), so this
+        is ONE draft forward; slots that took plain steps for a stretch
+        (budget collapse, spec tail gate) need more chunks, all through
+        the same executable.  Chunk rows land at their real positions in
+        the shadow pool — the target's block tables cover them, the
+        ledger never hears about it."""
+        S = self.config.max_batch_size
+        W = self.config.spec_draft_len + 1
+        bk = self.slots.pool.block_size
+        dk, dv = self._draft_kv
+        heads = {}
+        while True:
+            window = np.zeros((S, W), np.int32)
+            fills_d = np.zeros((S,), np.int32)
+            bids_d = np.zeros((S * W,), np.int32)  # default: trash
+            offs_d = np.zeros((S * W,), np.int32)
+            finishing = []
+            pending_work = False
+            for slot, st in self._active.items():
+                if slot not in plans:
+                    continue
+                seq = list(st.req.prompt) + list(st.req.generated)
+                lo = st.draft_fill
+                hi = min(st.fill + 1, lo + W)
+                fills_d[slot] = lo
+                if hi <= lo:
+                    continue
+                n = hi - lo
+                window[slot, :n] = seq[lo:hi]
+                for j in range(n):
+                    pos = lo + j
+                    bids_d[slot * W + j] = \
+                        self.slots.tables[slot][pos // bk]
+                    offs_d[slot * W + j] = pos % bk
+                st.draft_fill = hi
+                if hi == st.fill + 1:
+                    finishing.append((slot, n))
+                else:
+                    pending_work = True
+            if not finishing and not pending_work:
+                break
+            with device_annotation("draft_absorb"):
+                cand, dk, dv = self._draft_step(
+                    self.draft_cfg, self.draft_params, dk, dv, tables,
+                    jnp.asarray(window), jnp.asarray(fills_d),
+                    jnp.asarray(bids_d), jnp.asarray(offs_d),
+                    use_fused=self._fused_draft)
+            if finishing:
+                # tpulint: allow[host-sync] draft candidates feed the
+                # host-side tree packer; nothing to overlap
+                cand = np.asarray(cand)
+                for slot, n in finishing:
+                    heads[slot] = cand[slot, n - 1].tolist()
+        # tpulint: allow[lock-discipline] scheduler-thread-owned;
+        # the start() write under the lock precedes thread launch
+        self._draft_kv = (dk, dv)
+        return heads
+
+    def _draft_expand(self, chains: dict, tables) -> None:
+        """Grow each planned slot's main chain to its budgeted length by
+        repeated draft forwards over the chain-so-far at ``fill + 1``
+        with ALL-trash landing rows: the verify window's in-window
+        splice makes depth >= 2 attention exact without a single shadow-
+        pool write, so rejected chains leave nothing to roll back.
+        ``chains``: slot -> (token list, target length), mutated in
+        place."""
+        S = self.config.max_batch_size
+        W = self.config.spec_draft_len + 1
+        dk, dv = self._draft_kv
+        trash = jnp.zeros((S * W,), jnp.int32)
+        for depth in range(1, W - 1):
+            window = np.zeros((S, W), np.int32)
+            fills_d = np.zeros((S,), np.int32)
+            growing = []
+            for slot, (chain, want) in chains.items():
+                if len(chain) != depth or len(chain) >= want:
+                    continue
+                st = self._active[slot]
+                window[slot, :depth] = chain
+                fills_d[slot] = st.fill + 1
+                growing.append(slot)
+            if not growing:
+                break
+            with device_annotation("draft_expand"):
+                cand, dk, dv = self._draft_step(
+                    self.draft_cfg, self.draft_params, dk, dv, tables,
+                    jnp.asarray(window), jnp.asarray(fills_d), trash,
+                    trash, use_fused=self._fused_draft)
+            # tpulint: allow[host-sync] chain growth is host-driven
+            cand = np.asarray(cand)
+            for slot in growing:
+                chains[slot][0].append(int(cand[slot, depth - 1, 0]))
+        # tpulint: allow[lock-discipline] scheduler-thread-owned;
+        # the start() write under the lock precedes thread launch
+        self._draft_kv = (dk, dv)
 
     # tpulint: hot-path
     def _spec_step(self, drafts: dict) -> None:
@@ -1575,6 +1936,7 @@ class ServingEngine:
         proposed = 0
         accepted_total = 0
         per_slot_committed = []
+        slot_ewmas = {}
         for slot, st in list(self._active.items()):
             d = drafts.get(slot, ())
             k_i = len(d)
@@ -1587,6 +1949,7 @@ class ServingEngine:
             if k_i:
                 st.spec_ewma = ((1.0 - _SPEC_EWMA_ALPHA) * st.spec_ewma
                                 + _SPEC_EWMA_ALPHA * acc / k_i)
+                slot_ewmas[slot] = st.spec_ewma
             # dispatch-time semantics, span-sized: rows for the pending
             # token and the accepted drafts landed; the bonus token's
             # row is the NEXT step's write
@@ -1613,7 +1976,8 @@ class ServingEngine:
                                      "committed": committed_here})
         t.stop()
         self.metrics.observe_spec_step(proposed, accepted_total,
-                                       per_slot_committed)
+                                       per_slot_committed, source="ngram",
+                                       slot_ewmas=slot_ewmas)
         self.metrics.observe_decode_iteration(total_committed, device_s)
         self.metrics.observe_step_breakdown(device_s=device_s)
         host_s = max(0.0, (time.perf_counter() - it0) - (t_ready - t0))
@@ -1625,6 +1989,253 @@ class ServingEngine:
                   "route": ("spec_fused" if self._fused_verify
                             else "spec_fallback"),
                   "pipelined": False, "proposed": proposed,
+                  "accepted": accepted_total})
+
+    # tpulint: hot-path
+    def _spec_step_tree(self, plans: dict) -> None:
+        """One resident-draft tree-verify iteration (pipeline already
+        flushed).  Each planned slot spends its ``k_i``-token budget on
+        a candidate tree rooted at the pending token: a main chain from
+        the draft model's repeated top-1, plus — when the budget affords
+        it (``k_i >= 3``) — a depth-1 HEDGE leaf from the draft's
+        second choice, which rescues one token on exactly the steps
+        where chain speculation dies at the first position.  The target
+        scores every node in ONE tree-verify forward (each node attends
+        only its root path), and the commit is the longest root path
+        whose tokens match the target's argmax, plus the bonus token
+        from its deepest node — bitwise what plain decode would have
+        produced.
+
+        Rollback stays zero-churn: node K/V rows land NODE-indexed at
+        ``fill + node``, rejected rows sit beyond the advanced fill
+        (masked, overwritten in place later), and only a hedge
+        acceptance needs a row move to re-pack the surviving path
+        depth-contiguously — dispatched BEFORE commits so a retirement
+        can never free the blocks under a pending move.  Riders (non-
+        greedy slots, collapsed budgets) take the root-only path with
+        unchanged seed/counter streams, exactly like ``_spec_step``."""
+        assert self._inflight is None
+        it0 = time.perf_counter()
+        t = self.metrics.timers("serving-decode", 2)
+        t.start()
+        S = self.config.max_batch_size
+        W = self.config.spec_draft_len + 1
+        bk = self.slots.pool.block_size
+        # block targeting before anything touches the device: a slot's
+        # nodes land node-indexed at rows fill..fill+k_i, and the draft
+        # absorb writes the pending token's shadow row at fill, so every
+        # one of those blocks must exist (lazily allocated / COWed)
+        # before the single tables snapshot both models share
+        for slot, st in self._active.items():
+            for j in range(plans.get(slot, 0) + 1):
+                self.slots.append_block_id(slot, st.fill + j)
+        tables = jnp.asarray(self.slots.tables)
+
+        # draft phase: absorb committed tokens into the shadow pool,
+        # fork the tree heads, grow the main chains
+        heads = self._draft_absorb(plans, tables)
+        chains = {}
+        hedges = {}
+        for slot, k_i in plans.items():
+            top = heads[slot]    # host ints (tolist in _draft_absorb)
+            if k_i >= 3:
+                chains[slot] = ([top[0]], k_i - 1)
+                hedges[slot] = top[1]
+            else:
+                chains[slot] = ([top[0]], k_i)
+        self._draft_expand(chains, tables)
+
+        # pack the fixed-shape tree operands (host-side, numpy)
+        window = np.zeros((S, W), np.int32)
+        depths = np.zeros((S, W), np.int32)
+        anc = np.zeros((S, W, W), np.int32)
+        fills = np.zeros((S,), np.int32)
+        seeds = np.zeros((S,), np.uint32)
+        counters = np.zeros((S,), np.int32)
+        greedy = np.ones((S,), bool)
+        temps = np.ones((S,), np.float32)
+        top_ks = np.zeros((S,), np.int32)
+        top_ps = np.zeros((S,), np.float32)
+        bids = np.zeros((S * W,), np.int32)  # default: the trash block
+        offs = np.zeros((S * W,), np.int32)
+        n_real = {}
+        for slot, st in self._active.items():
+            window[slot, 0] = st.pending
+            fills[slot] = st.fill
+            seeds[slot] = st.req.seed
+            counters[slot] = st.count
+            greedy[slot] = st.req.greedy
+            temps[slot] = st.req.temperature
+            top_ks[slot] = st.req.top_k
+            top_ps[slot] = st.req.top_p
+            st.fresh = False
+            # node list in BFS order (depths non-decreasing, parents
+            # before children, deepest node last — the kernel's per-row
+            # iteration bound reads the LAST column's position)
+            node_dep = [0]
+            parent = [0]
+            chain_nodes = [0]     # chain node index at each depth
+            hedge = hedges.get(slot)
+            chain = chains[slot][0] if slot in chains else []
+            for t_, tok in enumerate(chain):
+                node_dep.append(t_ + 1)
+                parent.append(chain_nodes[t_])
+                chain_nodes.append(len(node_dep) - 1)
+                window[slot, len(node_dep) - 1] = tok
+                if t_ == 0 and hedge is not None:
+                    node_dep.append(1)
+                    parent.append(0)
+                    window[slot, len(node_dep) - 1] = hedge
+            n = len(node_dep)
+            n_real[slot] = n
+            for j in range(1, n):
+                p = parent[j]
+                for dd in range(node_dep[j] - 1, -1, -1):
+                    anc[slot, j, dd] = p
+                    p = parent[p]
+            depths[slot, :n] = node_dep
+            # trailing pad nodes: depth pinned to the slot's max real
+            # depth (keeps BFS order and the deepest-last clamp valid),
+            # ancestor row borrowed from the deepest real node so every
+            # gather index stays in range; outputs ignored, rows trashed
+            depths[slot, n:] = node_dep[-1]
+            anc[slot, n:, :] = anc[slot, n - 1, :]
+            for j in range(n):
+                pos = st.fill + j
+                bids[slot * W + j] = self.slots.tables[slot][pos // bk]
+                offs[slot * W + j] = pos % bk
+
+        t0 = time.perf_counter()
+        if self._last_dispatch_t is not None:
+            wall = t0 - self._last_dispatch_t
+            if wall > 0 and self._last_ready_t is not None:
+                gap = min(wall, t0 - self._last_ready_t)
+                self.metrics.observe_step_breakdown(gap_frac=gap / wall)
+        self._last_dispatch_t = t0
+        self.metrics.inc_step(self._fused_verify, self._precision_route)
+        with device_annotation("verify_tree"):
+            g_tok, g_lp, k_pool, v_pool = self._verify_tree(
+                self.cfg, self.params, self.slots.k_pool,
+                self.slots.v_pool, tables, jnp.asarray(window),
+                jnp.asarray(depths), jnp.asarray(anc),
+                jnp.asarray(fills), jnp.asarray(bids), jnp.asarray(offs),
+                jnp.asarray(seeds), jnp.asarray(counters),
+                jnp.asarray(greedy), jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                use_fused=self._fused_verify)
+        # tpulint: allow[host-sync] verify steps are synchronous by
+        # design: the accepted path decides the next fill vector AND
+        # whether rows must move, so there is nothing to overlap
+        g_tok = np.asarray(g_tok)
+        g_lp = np.asarray(g_lp)  # tpulint: allow[host-sync] same fetch
+        t_ready = time.perf_counter()
+        self._last_ready_t = t_ready
+        device_s = t_ready - t0
+
+        # accept walk (host): longest root path matching target argmax
+        paths = {}
+        src_b = np.zeros((S * W,), np.int32)   # default trash -> trash
+        src_o = np.zeros((S * W,), np.int32)
+        dst_b = np.zeros((S * W,), np.int32)
+        dst_o = np.zeros((S * W,), np.int32)
+        any_moves = False
+        for slot, st in self._active.items():
+            cur, acc, path = 0, 0, [0]
+            while True:
+                # tpulint: allow[host-sync] numpy row, fetched above
+                tgt = int(g_tok[slot, cur])
+                nxt = -1
+                for c in range(1, n_real.get(slot, 1)):
+                    if (depths[slot, c] == acc + 1
+                            and anc[slot, c, acc] == cur
+                            and window[slot, c] == tgt):
+                        nxt = c
+                        break
+                if nxt < 0:
+                    break
+                cur = nxt
+                path.append(nxt)
+                acc += 1
+            paths[slot] = path
+            # depth-contiguous re-pack of the accepted path: only a node
+            # whose index differs from its depth (the hedge leaf) moved
+            for t_ in range(1, acc + 1):
+                p_t = path[t_]
+                if p_t == t_:
+                    continue
+                any_moves = True
+                src = st.fill + p_t
+                dst = st.fill + t_
+                src_b[slot * W + t_] = self.slots.tables[slot][src // bk]
+                src_o[slot * W + t_] = src % bk
+                dst_b[slot * W + t_] = self.slots.tables[slot][dst // bk]
+                dst_o[slot * W + t_] = dst % bk
+        if any_moves:
+            with device_annotation("spec_compact"):
+                k_pool, v_pool = self._move_rows(
+                    k_pool, v_pool, jnp.asarray(src_b),
+                    jnp.asarray(src_o), jnp.asarray(dst_b),
+                    jnp.asarray(dst_o))
+        self.slots.set_pools(k_pool, v_pool)
+
+        total_committed = 0
+        proposed = 0
+        accepted_total = 0
+        per_slot_committed = []
+        slot_ewmas = {}
+        for slot, st in list(self._active.items()):
+            path = paths[slot]
+            acc = len(path) - 1
+            k_i = plans.get(slot, 0)
+            proposed += k_i
+            accepted_total += acc
+            if k_i:
+                chain_len = chains[slot][1]
+                st.spec_ewma = ((1.0 - _SPEC_EWMA_ALPHA) * st.spec_ewma
+                                + _SPEC_EWMA_ALPHA * acc / chain_len)
+                slot_ewmas[slot] = st.spec_ewma
+            # dispatch-time semantics, span-sized: rows for the pending
+            # token and the accepted path landed (and were re-packed);
+            # the bonus token's row is the NEXT step's write
+            st.fill += acc + 1
+            st.count += acc + 1
+            st.fresh = True
+            committed_here = 0
+            for t_ in range(acc + 1):
+                if self._active.get(slot) is not st:
+                    break  # EOS / budget retired the slot mid-path
+                # tpulint: allow[host-sync] numpy row, fetched above
+                st.pending = int(g_tok[slot, path[t_]])
+                committed_here += 1
+                # tpulint: allow[host-sync] numpy row, fetched above
+                lp = float(g_lp[slot, path[t_]])
+                self._commit_token(slot, st.pending, lp)
+            total_committed += committed_here
+            if k_i:
+                per_slot_committed.append(committed_here)
+            if self.trace.enabled:
+                self.trace.add("decode", t0, t_ready,
+                               request_id=st.req.rid, tid=st.req.id,
+                               args={"slot": slot, "spec": True,
+                                     "tree": True, "proposed": k_i,
+                                     "accepted": acc,
+                                     "committed": committed_here})
+        t.stop()
+        self.metrics.observe_spec_step(proposed, accepted_total,
+                                       per_slot_committed,
+                                       source="model",
+                                       slot_ewmas=slot_ewmas)
+        self.metrics.observe_decode_iteration(total_committed, device_s)
+        self.metrics.observe_step_breakdown(device_s=device_s)
+        host_s = max(0.0, (time.perf_counter() - it0) - (t_ready - t0))
+        self.metrics.observe_step_breakdown(host_s=host_s)
+        self.metrics.set_gauges(slots_active=self.slots.active_slots)
+        self.trace.add(
+            "engine_step", it0, time.perf_counter(), tid=0,
+            args={"batch": len(plans),
+                  "route": ("spec_fused" if self._fused_verify
+                            else "spec_fallback"),
+                  "pipelined": False, "tree": True, "proposed": proposed,
                   "accepted": accepted_total})
 
     # tpulint: hot-path
@@ -1909,7 +2520,8 @@ class ServingEngine:
             bids=bids, n_live=len(bids), nbytes=nbytes,
             meta={"req": req, "fill": st.fill, "count": st.count,
                   "pending": st.pending, "spec_ewma": st.spec_ewma,
-                  "spec_stall": st.spec_stall})
+                  "spec_stall": st.spec_stall,
+                  "draft_fill": st.draft_fill})
 
     def install_shipment(self, ship: KVShipment) -> int:
         """Adopt a shipment into a free slot of this engine.  Scheduler
@@ -1954,6 +2566,13 @@ class ServingEngine:
         st.spec_stall = ship.meta["spec_stall"]
         st.fresh = True  # next dispatch feeds the host-known pending token
         self._active[slot] = st
+        if self._draft_enabled and self.config.role != "prefill":
+            # the draft shadow pool does not travel with the shipment
+            # (draft rows are derived state, cheap to rebuild with a
+            # tiny model); re-prefill the context so this replica can
+            # keep speculating.  The source's draft_fill in ship.meta is
+            # informational — the dense prefill always rebuilds from 0.
+            self._draft_prefill(slot, st)
         self._update_pool_gauges()
         self.metrics.set_gauges(slots_active=self.slots.active_slots)
         self.metrics.inc("ships_in_total")

@@ -211,6 +211,14 @@ class ServingMetrics:
         # config losing kernel eligibility after a geometry change) is
         # invisible in the aggregate counters but obvious here
         self.step_routes: dict = {}
+        # speculative counters broken down by where the draft came from
+        # ("ngram" = host prompt-lookup, "model" = resident draft model
+        # proposing trees) — the source label is how a bench run shows
+        # the resident draft carrying random traffic that PLD cannot
+        self.spec_by_source: dict = {}
+        # per-slot acceptance EWMA gauges (the value the engine's budget
+        # controller actually steers on), refreshed every verify step
+        self.slot_spec_ewma: dict = {}
         self.timers = Timers(log_level=2)
         self.slo = SLOTracker(slo or SLOConfig())
         if register:
@@ -284,15 +292,27 @@ class ServingMetrics:
             self.prefix_hit_tokens.observe(float(tokens))
 
     def observe_spec_step(self, proposed: int, accepted: int,
-                          committed: Sequence[int]) -> None:
+                          committed: Sequence[int],
+                          source: str = "ngram",
+                          slot_ewmas: Optional[dict] = None) -> None:
         """One speculative verify step: ``proposed`` draft tokens across
         the batch, ``accepted`` of them confirmed against greedy decode,
         ``committed`` tokens landed per participating slot (the accepted
-        prefix plus the bonus token, truncated by EOS/budget)."""
+        prefix plus the bonus token, truncated by EOS/budget).
+        ``source`` labels who drafted ("ngram" host prompt-lookup,
+        "model" resident draft model); ``slot_ewmas`` refreshes the
+        per-slot acceptance-EWMA gauges (slot -> ewma)."""
         with self._lock:
             self.counters["spec_steps"] += 1
             self.counters["spec_proposed"] += proposed
             self.counters["spec_accepted"] += accepted
+            src = self.spec_by_source.setdefault(
+                source, {"steps": 0, "proposed": 0, "accepted": 0})
+            src["steps"] += 1
+            src["proposed"] += proposed
+            src["accepted"] += accepted
+            if slot_ewmas:
+                self.slot_spec_ewma.update(slot_ewmas)
             for n in committed:
                 self.accepted_per_step.observe(float(n))
 
@@ -346,6 +366,15 @@ class ServingMetrics:
                 "spec_acceptance_rate": (
                     self.counters["spec_accepted"]
                     / max(1, self.counters["spec_proposed"])),
+                # per-source breakdown (spec_draft_source label):
+                # "ngram" prompt-lookup vs "model" resident draft
+                "spec_by_source": {
+                    source: dict(src)
+                    for source, src in sorted(self.spec_by_source.items())},
+                # per-slot acceptance EWMA (the budget controller input)
+                "slot_spec_ewma": {
+                    str(slot): ewma
+                    for slot, ewma in sorted(self.slot_spec_ewma.items())},
                 "accepted_tokens_per_step":
                     self.accepted_per_step.snapshot(suffix=""),
                 # decode-step routing by weight precision (inc_step)
@@ -385,6 +414,31 @@ class ServingMetrics:
                     fused_fam.add(r["fused"], labels={"precision": route})
                     fb_fam.add(r["fallback"], labels={"precision": route})
                 fams.extend([fused_fam, fb_fam])
+            if self.spec_by_source:
+                by_src = {
+                    "steps": MetricFamily(
+                        "serving_spec_steps_by_source_total", "counter",
+                        "speculative verify steps by draft source"),
+                    "proposed": MetricFamily(
+                        "serving_spec_proposed_by_source_total", "counter",
+                        "speculative draft tokens proposed by draft source"),
+                    "accepted": MetricFamily(
+                        "serving_spec_accepted_by_source_total", "counter",
+                        "speculative draft tokens accepted by draft source"),
+                }
+                for source, src in sorted(self.spec_by_source.items()):
+                    for key, fam in by_src.items():
+                        fam.add(src[key],
+                                labels={"spec_draft_source": source})
+                fams.extend(by_src.values())
+            if self.slot_spec_ewma:
+                ewma_fam = MetricFamily(
+                    "serving_spec_slot_ewma", "gauge",
+                    "per-slot speculative acceptance EWMA (budget "
+                    "controller input)")
+                for slot, ewma in sorted(self.slot_spec_ewma.items()):
+                    ewma_fam.add(ewma, labels={"slot": str(slot)})
+                fams.append(ewma_fam)
             hits = self.counters["prefix_hits"]
             misses = self.counters["prefix_misses"]
             for gname, help_, value in (

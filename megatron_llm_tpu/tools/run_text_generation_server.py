@@ -176,6 +176,28 @@ def main(argv=None) -> int:
     ap.add_argument("--spec_ngram", type=int, default=3,
                     help="trailing n-gram length the speculative drafter "
                          "matches on (with --draft_len)")
+    ap.add_argument("--draft_model", default=None,
+                    help="resident draft model preset (config.PRESETS, "
+                         "e.g. 'tiny') for tree speculation: a small "
+                         "model lives on-device next to the target, "
+                         "drafts top-k branch trees each iteration, and "
+                         "the target verifies the whole tree in one "
+                         "fused forward (docs/serving.md, 'Tree "
+                         "speculation & resident drafts').  Beats the "
+                         "n-gram drafter on random traffic; requires "
+                         "--draft_len > 0.  Draft vocab/positions are "
+                         "forced to the target's "
+                         "(models/families.py:draft_model)")
+    ap.add_argument("--draft_load", default=None,
+                    help="checkpoint directory for --draft_model; "
+                         "absent = random init (trajectories stay "
+                         "bitwise-correct — a bad draft only lowers the "
+                         "acceptance rate — but expect no speedup)")
+    ap.add_argument("--spec_reprobe_interval", type=int, default=None,
+                    help="decode steps between speculation re-probes "
+                         "after a slot's acceptance EWMA backs it off "
+                         "to plain decode; default: engine default "
+                         "(EngineConfig.spec_reprobe_interval)")
     ap.add_argument("--no_spec", action="store_true",
                     help="force engine-side speculative decoding off "
                          "(overrides --draft_len; diagnostic)")
@@ -250,6 +272,30 @@ def main(argv=None) -> int:
               f"mlp={pol.mlp or 'fp'}, embedding={pol.embedding or 'fp'}, "
               f"group_size={pol.group_size})")
 
+    draft_cfg = None
+    draft_params = None
+    if args.draft_model and not args.no_spec and args.draft_len > 0:
+        import jax as _jax
+
+        from ..models import model as _model_lib
+
+        # Mirror the target's KV quantization so both paged pools share
+        # one residency policy; vocab/positions are forced inside
+        # families.draft_model.
+        draft_lm = families.draft_model(
+            args.draft_model, lm.cfg,
+            kv_cache_quant=lm.cfg.kv_cache_quant)
+        draft_cfg = draft_lm.cfg
+        if args.draft_load:
+            draft_params = load_params_for_inference(args.draft_load,
+                                                     draft_cfg)
+        else:
+            draft_params = _model_lib.init_params(_jax.random.key(0),
+                                                  draft_cfg)
+            print("draft model: no --draft_load given — RANDOM INIT "
+                  "(tokens stay bitwise-correct, but acceptance will "
+                  "be near zero; load a trained draft for speedup)")
+
     cluster = args.replicas > 1 or args.router or args.disagg is not None
     mesh_ctx = None
     if args.disagg is not None:
@@ -304,6 +350,9 @@ def main(argv=None) -> int:
         kv_pool_blocks=args.kv_pool_blocks,
         spec_draft_len=0 if args.no_spec else args.draft_len,
         spec_ngram=args.spec_ngram,
+        spec_reprobe_interval=args.spec_reprobe_interval,
+        draft_cfg=draft_cfg,
+        draft_params=draft_params,
         trace=not args.no_trace,
         tensor_parallel=args.tp if cluster else 1,
         pipeline_parallel=args.pp if cluster else 1,
@@ -323,9 +372,15 @@ def main(argv=None) -> int:
               f"pool_blocks={args.kv_pool_blocks or 'auto'} "
               "(GET /kv; tools/dump_kv_pool.py)")
     if args.draft_len and not args.no_spec:
-        print(f"speculative decoding: draft_len={args.draft_len} "
-              f"ngram={args.spec_ngram} (greedy requests; "
-              "docs/serving.md 'Speculative decoding')")
+        if draft_cfg is not None:
+            print(f"speculative decoding: draft_len={args.draft_len} "
+                  f"draft_model={args.draft_model} (resident draft + "
+                  "tree verification; docs/serving.md 'Tree "
+                  "speculation & resident drafts')")
+        else:
+            print(f"speculative decoding: draft_len={args.draft_len} "
+                  f"ngram={args.spec_ngram} (greedy requests; "
+                  "docs/serving.md 'Speculative decoding')")
     print("tracing: " + ("disabled (--no_trace)" if args.no_trace
                          else "on (GET /trace; tools/dump_trace.py)"))
     if args.metrics_interval_s > 0:

@@ -1,0 +1,51 @@
+"""Known-bad fixture for R7 (dyn-shape).
+
+Per-iteration operands handed to a jitted callable must be packed at
+fixed arity.  The candidate-tree topology tensors are the canonical
+case: sizing ``depths``/``anc`` by the number of planned chains (or a
+request's generated length) makes every distinct tree geometry a fresh
+executable — the compile storm lands mid-decode.  The good form pads
+to the static node budget and masks in-kernel
+(serving/engine.py:_spec_step_tree).
+"""
+import functools
+
+import jax
+import numpy as np
+
+W = 5  # static node budget
+
+
+def _verify_impl(params, window, depths, anc):
+    return window, depths, anc
+
+
+_verify = functools.partial(jax.jit, static_argnames=("mode",))(_verify_impl)
+
+
+def verify_tree(params, chains, slot):
+    window = np.zeros((len(chains), W), np.int32)  # BAD: dyn-shape
+    depths = np.zeros((len(chains), W), np.int32)  # BAD: dyn-shape
+    return _verify(params, window, depths,
+                   np.zeros((len(chains), W, W), np.int32))  # BAD: dyn-shape
+
+
+def verify_slot(params, slot):
+    # shape from per-request state: one executable per generated length
+    d = np.zeros((1, len(slot.generated)), np.int32)  # BAD: dyn-shape
+    return _verify(params, d, d, d)
+
+
+def verify_fixed(params, chains, S):
+    # GOOD: fixed arity from config-bounded quantities; ragged reality
+    # is packed into the padded tensors and masked in-kernel
+    window = np.zeros((S, W), np.int32)
+    depths = np.zeros((S, W), np.int32)
+    anc = np.zeros((S, W, W), np.int32)
+    return _verify(params, window, depths, anc)
+
+
+def host_side_only(chains):
+    # GOOD: data-dependent shapes that never reach a jitted call are
+    # plain host bookkeeping
+    return np.zeros((len(chains),), np.int32)

@@ -41,6 +41,7 @@ def actual_findings(path: Path, config=None):
         ("bad_r4.py", "tracer-leak"),
         ("bad_r5.py", "lock-discipline"),
         ("bad_r6.py", "dequant-hot-path"),
+        ("bad_r7.py", "dyn-shape"),
     ],
 )
 def test_fixture_findings_exact(name, rule):

@@ -847,3 +847,229 @@ def test_eligibility_matrix_int4():
         "wq": pm["layers"]["attn"]["wq"],   # int8 among int4 siblings
     }}}
     assert not ok(ragged)
+
+
+# ---------------------------------------------------------------------------
+# Tree verification (round 15): parent-pointer candidate trees through the
+# verify arms
+# ---------------------------------------------------------------------------
+
+from megatron_llm_tpu.models.model import cache_move_rows  # noqa: E402
+
+
+def _chain_topology(S, W):
+    """The degenerate tree that IS the linear window: node j at depth j,
+    ancestor closure = identity prefix."""
+    depths = np.tile(np.arange(W), (S, 1)).astype(np.int32)
+    anc = np.tile(np.arange(W), (S, W, 1)).astype(np.int32)
+    return depths, anc
+
+
+def _branched_topology(b, W):
+    """W=4 tree per slot: 0(root) -> 1 -> 3 and 0 -> 2 — a main chain plus
+    a depth-1 hedge, the exact shape the engine's tree planner emits."""
+    assert W == 4
+    depths = np.tile(np.asarray([0, 1, 1, 2], np.int32), (b, 1))
+    anc = np.zeros((b, W, W), np.int32)
+    anc[:, 3, 1] = 1  # node 3's depth-1 ancestor is node 1; depth-0 = 0
+    return depths, anc
+
+
+@pytest.mark.parametrize(
+    "int8",
+    [False, pytest.param(True, marks=pytest.mark.slow)],
+    ids=["fp32", "int8"],
+)
+def test_chain_tree_equals_linear_fused(int8):
+    """An explicit chain topology through the tree arm must be BITWISE
+    identical to the linear-window call with no topology at all — the
+    regression guard that generalizing the verify kernel to trees did not
+    perturb the PLD path (which still passes depths=None)."""
+    (fused_verify, _, _, _, _) = _verify_helpers()
+    bk, W, b = 128, 3, 3
+    cfg, params, rope, tables, k_pool, v_pool = _verify_setup(int8, bk)
+    fills = np.asarray([37, 128, 1], np.int32)
+    x = jax.random.normal(jax.random.key(2), (b, W, cfg.hidden_size),
+                          jnp.float32)
+    jt = jnp.asarray(tables)
+
+    want = fused_verify(
+        cfg, params["layers"], x, k_pool, v_pool, jt, jnp.asarray(fills),
+        rope, interpret=True)
+    depths, anc = _chain_topology(b, W)
+    got = fused_verify(
+        cfg, params["layers"], x, k_pool, v_pool, jt, jnp.asarray(fills),
+        rope, depths=jnp.asarray(depths), anc=jnp.asarray(anc),
+        interpret=True)
+    jax.tree.map(lambda g, w: np.testing.assert_array_equal(
+        np.asarray(g), np.asarray(w)), got, want)
+
+
+@pytest.mark.parametrize(
+    "int8",
+    [False, pytest.param(True, marks=pytest.mark.slow)],
+    ids=["fp32", "int8"],
+)
+def test_branched_tree_fused_matches_sequential(int8):
+    """Every node of a branched tree, verified in ONE fused call, must be
+    bitwise equal to sequentially decoding that node's root path with a
+    host append between steps — the property the engine's accept walk
+    leans on: whichever root-to-leaf path wins, its outputs are exactly
+    what plain decoding of that path would have produced.  fill=126 so
+    depth-2 nodes land across the 128 block boundary."""
+    (fused_verify, _, _, is_q, quant_rows) = _verify_helpers()
+    bk, W, b = 128, 4, 3
+    cfg, params, rope, tables, k_pool, v_pool = _verify_setup(int8, bk)
+    fills = np.asarray([37, 126, 1], np.int32)
+    x = jax.random.normal(jax.random.key(2), (b, W, cfg.hidden_size),
+                          jnp.float32)
+    jt = jnp.asarray(tables)
+    depths, anc = _branched_topology(b, W)
+
+    got_h, k_rows, v_rows = fused_verify(
+        cfg, params["layers"], x, k_pool, v_pool, jt, jnp.asarray(fills),
+        rope, depths=jnp.asarray(depths), anc=jnp.asarray(anc),
+        interpret=True)
+    if is_q(k_pool):
+        k_rows, v_rows = quant_rows(k_rows), quant_rows(v_rows)
+
+    for path in ([0, 1, 3], [0, 2]):
+        ks, vs = k_pool, v_pool
+        for t, node in enumerate(path):
+            fj = jnp.asarray(fills + t, jnp.int32)
+            h, kr, vr = fused_decode_step_paged(
+                cfg, params["layers"], x[:, node], ks, vs, jt, fj, rope,
+                interpret=True)
+            if is_q(ks):
+                kr, vr = quant_rows(kr), quant_rows(vr)
+            np.testing.assert_array_equal(
+                np.asarray(got_h[:, node]), np.asarray(h))
+            jax.tree.map(lambda g, w: np.testing.assert_array_equal(
+                np.asarray(g)[:, [s * W + node for s in range(b)]],
+                np.asarray(w)), (k_rows, v_rows), (kr, vr))
+            bids = jnp.asarray(tables[np.arange(b), (fills + t) // bk],
+                               jnp.int32)
+            offs = jnp.asarray((fills + t) % bk, jnp.int32)
+            ks = cache_append_rows(ks, kr, bids, offs)
+            vs = cache_append_rows(vs, vr, bids, offs)
+
+
+@pytest.mark.parametrize(
+    "int8",
+    [False, pytest.param(True, marks=pytest.mark.slow)],
+    ids=["fp32", "int8"],
+)
+def test_branched_tree_composed_matches_sequential_and_compacts(int8):
+    """The composed verify arm (use_fused=False, the CPU-CI route) under
+    a tree topology: every node's logits bitwise equal the sequential
+    decode of its root path, and after ``cache_move_rows`` compacts the
+    accepted path's node-indexed rows to depth positions, the pool
+    matches the sequential pools row for row.  bk=64 so the tree window
+    straddles a block edge (fill 126) and a slot sits near the table end
+    (fill 200)."""
+    (_, fwd_paged, fwd_verify, _, _) = _verify_helpers()
+    bk, W, b = 64, 4, 3
+    cfg, params, rope, tables, k_pool, v_pool = _verify_setup(int8, bk)
+    fills = np.asarray([37, 126, 200], np.int32)
+    window = jax.random.randint(jax.random.key(5), (b, W), 0,
+                                cfg.vocab_size)
+    jt = jnp.asarray(tables)
+    depths, anc = _branched_topology(b, W)
+    # node-indexed landing spots (node j at position fill + j): what the
+    # engine passes in tree mode before the accept walk re-packs rows
+    bids = np.asarray([[tables[s, (fills[s] + j) // bk] for j in range(W)]
+                       for s in range(b)], np.int32).reshape(-1)
+    offs = np.asarray([[(fills[s] + j) % bk for j in range(W)]
+                       for s in range(b)], np.int32).reshape(-1)
+
+    got_logits, kp, vp = fwd_verify(
+        cfg, params, window, k_pool, v_pool, jt, jnp.asarray(fills),
+        jnp.asarray(bids), jnp.asarray(offs), rope=rope, use_fused=False,
+        tree=(jnp.asarray(depths), jnp.asarray(anc)))
+
+    for path in ([0, 1, 3], [0, 2]):
+        ks, vs = k_pool, v_pool
+        for t, node in enumerate(path):
+            logits, ks, vs = fwd_paged(
+                cfg, params, window[:, node:node + 1], ks, vs, jt,
+                jnp.asarray(fills + t, jnp.int32), rope=rope,
+                use_fused=False)
+            np.testing.assert_array_equal(
+                np.asarray(got_logits[:, node]), np.asarray(logits[:, 0]))
+
+    # accept the [0, 1, 3] path: move its node rows (positions fill+0/1/3)
+    # to depth positions (fill+0/1/2) and compare against the pools the
+    # sequential decode of that path produces, over each slot's live rows
+    path = [0, 1, 3]
+    src_bids = np.asarray([tables[s, (fills[s] + n) // bk]
+                           for s in range(b) for n in path], np.int32)
+    src_offs = np.asarray([(fills[s] + n) % bk
+                           for s in range(b) for n in path], np.int32)
+    dst_bids = np.asarray([tables[s, (fills[s] + t) // bk]
+                           for s in range(b) for t in range(len(path))],
+                          np.int32)
+    dst_offs = np.asarray([(fills[s] + t) % bk
+                           for s in range(b) for t in range(len(path))],
+                          np.int32)
+    kp2 = cache_move_rows(kp, src_bids, src_offs, dst_bids, dst_offs)
+    vp2 = cache_move_rows(vp, src_bids, src_offs, dst_bids, dst_offs)
+
+    ks, vs = k_pool, v_pool
+    for t, node in enumerate(path):
+        _, ks, vs = fwd_paged(
+            cfg, params, window[:, node:node + 1], ks, vs, jt,
+            jnp.asarray(fills + t, jnp.int32), rope=rope, use_fused=False)
+    gk, gv = cache_gather_blocks(kp2, jt), cache_gather_blocks(vp2, jt)
+    wk, wv = cache_gather_blocks(ks, jt), cache_gather_blocks(vs, jt)
+
+    def cmp(g, w):
+        g, w = np.asarray(g), np.asarray(w)
+        for s in range(b):
+            n = fills[s] + len(path)
+            np.testing.assert_array_equal(g[:, s, :, :n], w[:, s, :, :n])
+    jax.tree.map(cmp, (gk, gv), (wk, wv))
+
+
+@pytest.mark.slow
+def test_branched_tree_fused_matches_sequential_int4():
+    """The branched-tree bitwise bar under int4 group-wise weight
+    residency: accept criterion coverage for the third precision arm
+    (fp32/int8/int4) of the tree verify."""
+    (fused_verify, _, _, _, _) = _verify_helpers()
+    bk, W, b = 128, 4, 3
+    cfg, params, _, _, rope = _policy_setup(
+        "int4", 64, b=b, fill=128, num_attention_heads=4, num_kv_heads=2)
+    k_cache, v_cache, _ = _prefill_cache(
+        cfg, params, b, 256, 128, jax.random.key(1))
+    rng = np.random.default_rng(7)
+    tables = _shuffled_tables(b, 256 // bk, rng)
+    k_pool = _pool_from_cache(k_cache, bk, tables)
+    v_pool = _pool_from_cache(v_cache, bk, tables)
+    fills = np.asarray([37, 126, 1], np.int32)
+    x = jax.random.normal(jax.random.key(2), (b, W, cfg.hidden_size),
+                          jnp.float32)
+    jt = jnp.asarray(tables)
+    depths, anc = _branched_topology(b, W)
+
+    got_h, k_rows, v_rows = fused_verify(
+        cfg, params["layers"], x, k_pool, v_pool, jt, jnp.asarray(fills),
+        rope, depths=jnp.asarray(depths), anc=jnp.asarray(anc),
+        interpret=True)
+
+    for path in ([0, 1, 3], [0, 2]):
+        ks, vs = k_pool, v_pool
+        for t, node in enumerate(path):
+            fj = jnp.asarray(fills + t, jnp.int32)
+            h, kr, vr = fused_decode_step_paged(
+                cfg, params["layers"], x[:, node], ks, vs, jt, fj, rope,
+                interpret=True)
+            np.testing.assert_array_equal(
+                np.asarray(got_h[:, node]), np.asarray(h))
+            jax.tree.map(lambda g, w: np.testing.assert_array_equal(
+                np.asarray(g)[:, [s * W + node for s in range(b)]],
+                np.asarray(w)), (k_rows, v_rows), (kr, vr))
+            bids = jnp.asarray(tables[np.arange(b), (fills + t) // bk],
+                               jnp.int32)
+            offs = jnp.asarray((fills + t) % bk, jnp.int32)
+            ks = cache_append_rows(ks, kr, bids, offs)
+            vs = cache_append_rows(vs, vr, bids, offs)
