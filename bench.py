@@ -732,6 +732,29 @@ def _serving_disagg_point(platform: str):
         peak_flops=chip_peak_flops(platform))
 
 
+def _serving_lora_point():
+    """Multi-tenant LoRA serving point (serving/adapters/, docs/serving.md
+    "Multi-tenant LoRA & live weight swap"): adapter-decorated traffic vs
+    the same traffic on an adapter-less engine at identical geometry,
+    plus a tenant-rotation wave through the LRU slot arena.  Gates:
+    ``serving_lora_itl_overhead`` — resident-adapter ITL p50 over the
+    base engine's — must stay ≤ 10% (lora_overhead_check; the price of
+    the always-compiled grouped epilogue), and
+    ``serving_lora_cache_hit_rate`` (repeat-pair tenant arrivals hitting
+    the pinned arena slot) gates in --compare."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.serving.bench import run_lora_serving_bench
+
+    prompt_len, gen_len = 128, 64
+    cfg = _bench_model(prompt_len + gen_len, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return run_lora_serving_bench(
+        cfg, params, num_requests=16, prompt_len=prompt_len,
+        gen_len=gen_len, slots=8, n_adapters=8, cache_slots=4, rank=8)
+
+
 def _transient_error_types():
     """The error classes worth retrying: the axon-tunneled compile service
     occasionally throws a transient remote-compile XlaRuntimeError.
@@ -800,12 +823,22 @@ _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
                      # bar on real hardware) is the prefill-engine claim
                      "serving_disagg.serving_disagg_ttft_p99_ratio",
                      "serving_disagg.serving_disagg_qps_ratio",
-                     "serving_disagg.serving_disagg_prefill_mfu")
+                     "serving_disagg.serving_disagg_prefill_mfu",
+                     # multi-tenant LoRA: repeat-pair tenant arrivals must
+                     # keep hitting the pinned arena slot (a drop means
+                     # admission stopped reusing residency); the ITL
+                     # overhead gate rides separately in
+                     # lora_overhead_check because smaller is better there
+                     "serving_lora.serving_lora_cache_hit_rate")
 _REGRESSION_TOLERANCE = 0.10
 # Tracing must stay effectively free on the serving hot path: the mixed
 # point's ITL p50 with the span recorder on may exceed the untraced rerun
 # riding in the same record by at most this fraction.
 _TRACE_OVERHEAD_TOLERANCE = 0.10
+# The grouped LoRA epilogue rides in the fused decode step whenever a
+# registry is attached; serving_lora's resident-adapter ITL p50 may
+# exceed the adapter-less engine's by at most this fraction.
+_LORA_OVERHEAD_TOLERANCE = 0.10
 
 # Bumped when the record's shape changes (new points / renamed keys) so
 # --compare across old records is interpretable.
@@ -818,7 +851,10 @@ _TRACE_OVERHEAD_TOLERANCE = 0.10
 # v7: + serving_spec_tree point (resident-draft tree speculation: random-
 #     traffic ITL speedup vs draft-off + acceptance; the n-gram
 #     serving_spec point rides unchanged for the PLD baseline)
-_BENCH_SCHEMA_VERSION = 7
+# v8: + serving_lora point (multi-tenant LoRA: resident-adapter ITL vs
+#     adapter-less base engine + LRU arena hit rate under tenant
+#     rotation)
+_BENCH_SCHEMA_VERSION = 8
 
 
 def _run_metadata(platform: str, device_count: int) -> dict:
@@ -899,6 +935,29 @@ def trace_overhead_check(record: dict):
     return line, ok
 
 
+def lora_overhead_check(record: dict):
+    """→ (line, ok): the LoRA-epilogue-overhead gate.  The serving_lora
+    point records resident-adapter ITL p50 against the adapter-less base
+    engine's at identical geometry; attaching a registry is only
+    acceptable as a serving default while the adapter-decorated number
+    stays within _LORA_OVERHEAD_TOLERANCE of base (running without a
+    registry — which keeps the pre-LoRA executable — is the escape
+    hatch if this ever trips)."""
+    sl = record.get("serving_lora") or {}
+    lora = sl.get("serving_lora_itl_ms_p50")
+    base = sl.get("serving_lora_base_itl_ms_p50")
+    if not lora or not base:
+        return ("# lora-overhead gate: skipped "
+                "(no lora/base ITL pair in record)"), True
+    overhead = lora / base - 1.0
+    ok = lora <= (1.0 + _LORA_OVERHEAD_TOLERANCE) * base
+    line = (f"# lora-overhead gate: serving_lora_itl_ms_p50 {lora:g} "
+            f"with adapters vs {base:g} base ({overhead:+.1%}, limit "
+            f"+{_LORA_OVERHEAD_TOLERANCE:.0%})"
+            + ("" if ok else "  << REGRESSION"))
+    return line, ok
+
+
 def compare_records(prev: dict, cur: dict):
     """Per-metric deltas between two BENCH records → (lines, regressed).
 
@@ -957,11 +1016,16 @@ def _run_compare(prev_path: str, cur_record: dict) -> int:
         print("#" + line, flush=True)
     trace_line, trace_ok = trace_overhead_check(cur_record)
     print(trace_line, flush=True)
-    if regressed or not trace_ok:
+    lora_line, lora_ok = lora_overhead_check(cur_record)
+    print(lora_line, flush=True)
+    if regressed or not trace_ok or not lora_ok:
         if regressed:
             print(f"# REGRESSED: {', '.join(regressed)}", flush=True)
         if not trace_ok:
             print("# REGRESSED: tracing overhead over limit", flush=True)
+        if not lora_ok:
+            print("# REGRESSED: LoRA epilogue overhead over limit",
+                  flush=True)
         return 1
     print("# no headline regression", flush=True)
     return 0
@@ -1005,6 +1069,8 @@ def _child_main(spec_json: str) -> None:
         out = _retry(_serving_prefix_point)
     elif kind == "serving_paged":
         out = _retry(_serving_paged_point)
+    elif kind == "serving_lora":
+        out = _retry(_serving_lora_point)
     elif kind == "serving_spec":
         out = _retry(_serving_spec_point)
     elif kind == "serving_spec_tree":
@@ -1207,6 +1273,10 @@ def main() -> None:
                           {"kind": "serving_spec",
                            "platform": platform},
                           timeout_s=1800)
+    serving_lora = _point("serving/lora",
+                          {"kind": "serving_lora",
+                           "platform": platform},
+                          timeout_s=1800)
     # headline quoted at 7B width (decode_7b geometry) so the
     # beat-the-PLD-ceiling claim holds at deployment matmul shapes; on
     # CPU the wide model would blow the point timeout, so the simulated
@@ -1297,6 +1367,8 @@ def main() -> None:
         record["serving_paged"] = serving_paged
     if serving_spec is not None:
         record["serving_spec"] = serving_spec
+    if serving_lora is not None:
+        record["serving_lora"] = serving_lora
     if serving_spec_tree is not None:
         record["serving_spec_tree"] = serving_spec_tree
     if serving_cluster is not None:

@@ -81,6 +81,18 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="stochastic-depth rate at the last layer "
                         "(reference DropPath, transformer.py:43-64)")
 
+    g = p.add_argument_group("lora")
+    g.add_argument("--lora_rank", type=int, default=0,
+                   help="train a LoRA adapter of this rank against the "
+                        "frozen base model instead of full finetuning "
+                        "(0 = off); checkpoints are adapter-only and "
+                        "servable via serving/adapters/")
+    g.add_argument("--lora_targets", nargs="*", default=None,
+                   help="projections to adapt (default: wq wv); choose "
+                        "from wq wk wv wo w_gate w_up w_down")
+    g.add_argument("--lora_alpha", type=float, default=None,
+                   help="LoRA alpha (default: rank, i.e. scale 1.0)")
+
     g = p.add_argument_group("parallelism")
     g.add_argument("--tp", "--tensor_parallel", type=int, default=1,
                    dest="tp")
@@ -415,6 +427,32 @@ def main(argv=None) -> int:
                  f"gbs={cfg.train.global_batch_size} "
                  f"seq={cfg.train.seq_length}")
     train_ds, valid_ds, test_ds = build_datasets(args, cfg)
+
+    if args.lora_rank:
+        # adapter-only finetune against a frozen base: the base comes
+        # from --load (params-only restore; the optimizer state of a
+        # full checkpoint is never read) or fresh init for smoke runs,
+        # and --save receives an adapter-only checkpoint
+        import jax as _jax
+
+        from megatron_llm_tpu import checkpointing
+        from megatron_llm_tpu.models import model as model_lib
+        from megatron_llm_tpu.training.lora import lora_finetune
+
+        if cfg.train.load:
+            base = checkpointing.load_params_for_inference(
+                cfg.train.load, cfg.model)
+            print_rank_0(f" loaded frozen base from {cfg.train.load}")
+        else:
+            print_rank_0(" no --load: LoRA against a fresh random base "
+                         "(smoke runs only)")
+            base = model_lib.init_params(
+                _jax.random.key(cfg.train.seed), cfg.model)
+        lora_finetune(cfg, base, train_ds, rank=args.lora_rank,
+                      targets=args.lora_targets, alpha=args.lora_alpha,
+                      eod_token=eod, save=cfg.train.save)
+        return 0
+
     pretrain(cfg, train_ds, valid_ds, test_ds, eod_token=eod)
     return 0
 

@@ -60,6 +60,12 @@ RULES: Dict[str, str] = {
         "distinct shape compiles a new executable; pack per-iteration "
         "operands (e.g. candidate-tree topology tensors) at fixed "
         "arity and mask in-kernel"),
+    "adapter-materialize": (
+        "per-request LoRA adapter-factor materialization "
+        "(.factors read, install_adapter, merge_adapter) in a kernels/ "
+        "file or a # tpulint: hot-path function — adapter deltas must "
+        "be served from the resident slot arena installed once at "
+        "admission, not rebuilt per request in the decode loop"),
     "suppression": (
         "malformed tpulint suppression (unknown rule id or missing "
         "reason) — suppressions must document why"),

@@ -1,4 +1,4 @@
-"""tpulint rule families R1-R6, tuned to this codebase's idioms.
+"""tpulint rule families R1-R8, tuned to this codebase's idioms.
 
 The module model (``ModuleContext``) understands the repo's jit
 conventions before any rule runs:
@@ -819,9 +819,63 @@ def rule_dynamic_operand_shape(ctx: ModuleContext) -> List[Finding]:
     return findings
 
 
+# -- R8: per-request adapter-factor materialization in hot paths -------------
+
+_ADAPTER_FNS = ("merge_adapter", "install_adapter")
+
+
+def rule_adapter_materialize(ctx: ModuleContext) -> List[Finding]:
+    """The multi-tenant LoRA bytes math works only while adapter
+    factors are *resident*: ``AdapterRegistry.acquire`` installs them
+    into the device slot arena once per cache miss (at admission) and
+    the decode epilogue indexes the arena by slot id — O(rank · hidden)
+    extra reads, zero per-request uploads.  Re-materializing factor
+    tensors inside a kernels/ file or a ``tpulint: hot-path`` function
+    — reading an adapter's host-side ``.factors`` tree, re-running
+    ``install_adapter``, or ``merge_adapter``-folding ΔW into the base
+    — re-uploads per-request tensors every step (and, for merge, clones
+    the full weight tree per tenant).  Cold paths (admission, training,
+    checkpoint export) are exempt."""
+    findings: List[Finding] = []
+    in_kernels = f"/{ctx.config.kernel_dir}/" in f"/{ctx.path}"
+    seen: Set[Tuple[int, int]] = set()
+    for fn in _functions(ctx.tree):
+        if not (in_kernels or ctx.is_hot_function(fn)):
+            continue
+        qual = ctx.qualname_of(fn)
+        where = "a kernels/ file" if in_kernels else "a hot-path function"
+        for node in ast.walk(fn):
+            msg = None
+            if isinstance(node, ast.Call):
+                p = dotted_path(node.func)
+                if p is not None and p[-1] in _ADAPTER_FNS:
+                    what = ("folds ΔW into a fresh copy of the base "
+                            "weights" if p[-1] == "merge_adapter"
+                            else "re-uploads the factor tensors")
+                    msg = (f"{p[-1]} {what} on every call inside {where} "
+                           "— install once at admission "
+                           "(AdapterRegistry.acquire) and index the "
+                           "resident arena by slot id instead")
+            elif (isinstance(node, ast.Attribute)
+                    and node.attr == "factors"
+                    and isinstance(node.ctx, ast.Load)):
+                msg = (f".factors reads the host-side per-adapter factor "
+                       f"tree inside {where} — serve the delta from the "
+                       "resident slot arena (lora_arenas + slot ids), "
+                       "never per-request host tensors")
+            if msg is None or (node.lineno, node.col_offset) in seen:
+                continue
+            seen.add((node.lineno, node.col_offset))
+            findings.append(Finding(
+                ctx.path, node.lineno, node.col_offset,
+                "adapter-materialize", msg, qual))
+    return findings
+
+
 ALL_RULES = (rule_recompile, rule_host_sync, rule_donation,
              rule_tracer_leak, rule_lock_discipline,
-             rule_dequant_hot_path, rule_dynamic_operand_shape)
+             rule_dequant_hot_path, rule_dynamic_operand_shape,
+             rule_adapter_materialize)
 
 
 def run_all(ctx: ModuleContext) -> List[Finding]:

@@ -143,7 +143,7 @@ def _int4_tile(ref, s_ref, cdt, gsz: int):
 
 
 def _decode_step_kernel(per_row: bool, aq: int, mq: int, gsz: int,
-                        cq8: bool,
+                        cq8: bool, lsr: int, lt: tuple,
                         nk: int, nm: int, block_k: int,
                         b: int, nq: int, nkv: int, g: int, d: int,
                         eps: float, scale: float, act,
@@ -176,9 +176,21 @@ def _decode_step_kernel(per_row: bool, aq: int, mq: int, gsz: int,
     kc_ref, vc_ref, *refs = refs
     if cq8:
         kcs_ref, vcs_ref, *refs = refs
+    # lsr/lt: the grouped LoRA epilogue — lsr = stacked arena rank
+    # (n_slots · r, 0 = no LoRA), lt the static target-projection tuple.
+    # Operands are one (b_pad, lsr) slot mask plus per-target stacked
+    # A/B factor pairs (ops/lora.py arena layout, α/r folded into B).
+    lmask_ref = None
+    lab_refs = {}
+    if lsr:
+        lmask_ref, *refs = refs
+        for t in lt:
+            la_t, lb_t, *refs = refs
+            lab_refs[t] = (la_t, lb_t)
     (xo_ref, kr_ref, vr_ref,
      x_scr, q_scr, kn_scr, vn_scr, ctx_scr, xn2_scr,
-     m_scr, l_scr, acc_scr) = refs
+     m_scr, l_scr, acc_scr, *extra_scr) = refs
+    lxa_scr = extra_scr[0] if (lsr and "w_down" in lt) else None
     li = pl.program_id(0)
     ki = pl.program_id(1)
     n_layers = pl.num_programs(0)
@@ -198,6 +210,22 @@ def _decode_step_kernel(per_row: bool, aq: int, mq: int, gsz: int,
         if mq == 4:
             return _int4_tile(ref, s_ref, cdt, gsz)
         return ref[0].astype(cdt) if mq else ref[0]
+
+    def lora_add(y, xin, t):
+        # grouped LoRA epilogue (ops/lora.py arena algebra):
+        # y += ((x·A)⊙mask)·B in fp32.  The mask one-hot selects each
+        # row's adapter slot's rank columns of the stacked arena, so
+        # rows under DIFFERENT adapters coexist in one pair of dots; a
+        # slot-less row's all-zero mask row makes its delta exactly
+        # ±0.0, keeping base-only rows bit-identical in tokens/logprobs
+        if not lsr or t not in lt:
+            return y
+        la_t, lb_t = lab_refs[t]
+        ldims = (((1,), (0,)), ((), ()))
+        xa = jax.lax.dot_general(xin, la_t[0], ldims,
+                                 preferred_element_type=f32)
+        return y + jax.lax.dot_general(xa * lmask_ref[...], lb_t[0],
+                                       ldims, preferred_element_type=f32)
 
     @pl.when(jnp.logical_and(li == 0, ki == 0))
     def _first():
@@ -240,6 +268,9 @@ def _decode_step_kernel(per_row: bool, aq: int, mq: int, gsz: int,
             q = q * qs_ref[0]
             k = k * ks_ref[0]
             v = v * vs_ref[0]
+        q = lora_add(q, xn, "wq")
+        k = lora_add(k, xn, "wk")
+        v = lora_add(v, xn, "wv")
         for j in range(nkv):
             kj = rope_head(k[:, j * d:(j + 1) * d])
             vj = v[:, j * d:(j + 1) * d]
@@ -328,6 +359,11 @@ def _decode_step_kernel(per_row: bool, aq: int, mq: int, gsz: int,
             preferred_element_type=f32)                   # (b_pad, h)
         if aq == 8:
             attn = attn * os_ref[0]
+        attn = lora_add(attn, ctx_scr[...], "wo")
+        if lxa_scr is not None:
+            # fresh layer: zero the w_down LoRA accumulator the MLP
+            # chunk ticks fold into
+            lxa_scr[...] = jnp.zeros(lxa_scr.shape, f32)
         x1 = x_scr[...] + attn
         nw2 = post_nw_ref[0].astype(f32)
         xn2_scr[...] = x1 * jax.lax.rsqrt(
@@ -359,12 +395,36 @@ def _decode_step_kernel(per_row: bool, aq: int, mq: int, gsz: int,
             # same reason: whole groups live inside one chunk.)
             gate = gate * gs_ref[0]
             up = up * us_ref[0]
-        hid = (act(gate) * up).astype(cdt)
+        gate = lora_add(gate, xn2_scr[...], "w_gate")
+        up = lora_add(up, xn2_scr[...], "w_up")
+        hid32 = act(gate) * up
+        hid = hid32.astype(cdt)
         part = jax.lax.dot_general(hid, w_d, dims,
                                    preferred_element_type=f32)
         if mq == 8:
             part = part * ds_ref[0]
+        if lxa_scr is not None:
+            # w_down LoRA contracts over the FULL ffn axis while the
+            # down tiles stream f_chunk rows per tick: accumulate this
+            # chunk's x·A partial; (·⊙mask)·B applies once after the
+            # last chunk (_lora_down) — exact because the chunks
+            # partition the contraction
+            la_d = lab_refs["w_down"][0]
+            lxa_scr[...] = lxa_scr[...] + jax.lax.dot_general(
+                hid32, la_d[0], dims, preferred_element_type=f32)
         x_scr[...] = x_scr[...] + part
+
+    if lxa_scr is not None:
+        # runs after _mlp_chunk on the same (last-MLP) tick — pl.when
+        # blocks execute in definition order — so the accumulator holds
+        # every chunk's partial before B is applied
+        @pl.when(jnp.logical_and(ki == nk + nm - 1, "finish" in phases))
+        def _lora_down():
+            ldims = (((1,), (0,)), ((), ()))
+            lb_d = lab_refs["w_down"][1]
+            x_scr[...] = x_scr[...] + jax.lax.dot_general(
+                lxa_scr[...] * lmask_ref[...], lb_d[0], ldims,
+                preferred_element_type=f32)
 
     @pl.when(jnp.logical_and(li == n_layers - 1, ki == nk + nm - 1))
     def _emit():
@@ -372,7 +432,8 @@ def _decode_step_kernel(per_row: bool, aq: int, mq: int, gsz: int,
 
 
 def _decode_step_kernel_paged(aq: int, mq: int, gsz: int,
-                              cq8: bool, W: int, tree: bool,
+                              cq8: bool, lsr: int, lt: tuple,
+                              W: int, tree: bool,
                               ntb: int, nm: int, block_k: int,
                               b: int, nq: int, nkv: int, g: int, d: int,
                               eps: float, scale: float, act,
@@ -427,9 +488,22 @@ def _decode_step_kernel_paged(aq: int, mq: int, gsz: int,
     kc_ref, vc_ref, *refs = refs
     if cq8:
         kcs_ref, vcs_ref, *refs = refs
+    # grouped LoRA epilogue operands (see _decode_step_kernel): one
+    # (b_pad, lsr) per-row slot mask + stacked A/B arena pairs per
+    # target.  Verify windows repeat each slot's mask row W times, so
+    # every window row (and its drafts) scores under the REQUESTER's
+    # adapter.
+    lmask_ref = None
+    lab_refs = {}
+    if lsr:
+        lmask_ref, *refs = refs
+        for t in lt:
+            la_t, lb_t, *refs = refs
+            lab_refs[t] = (la_t, lb_t)
     (xo_ref, kr_ref, vr_ref,
      x_scr, q_scr, kn_scr, vn_scr, ctx_scr, xn2_scr,
-     m_scr, l_scr, acc_scr) = refs
+     m_scr, l_scr, acc_scr, *extra_scr) = refs
+    lxa_scr = extra_scr[0] if (lsr and "w_down" in lt) else None
     li = pl.program_id(0)
     ki = pl.program_id(1)
     n_layers = pl.num_programs(0)
@@ -446,6 +520,22 @@ def _decode_step_kernel_paged(aq: int, mq: int, gsz: int,
         if mq == 4:
             return _int4_tile(ref, s_ref, cdt, gsz)
         return ref[0].astype(cdt) if mq else ref[0]
+
+    def lora_add(y, xin, t):
+        # grouped LoRA epilogue (ops/lora.py arena algebra):
+        # y += ((x·A)⊙mask)·B in fp32.  The mask one-hot selects each
+        # row's adapter slot's rank columns of the stacked arena, so
+        # rows under DIFFERENT adapters coexist in one pair of dots; a
+        # slot-less row's all-zero mask row makes its delta exactly
+        # ±0.0, keeping base-only rows bit-identical in tokens/logprobs
+        if not lsr or t not in lt:
+            return y
+        la_t, lb_t = lab_refs[t]
+        ldims = (((1,), (0,)), ((), ()))
+        xa = jax.lax.dot_general(xin, la_t[0], ldims,
+                                 preferred_element_type=f32)
+        return y + jax.lax.dot_general(xa * lmask_ref[...], lb_t[0],
+                                       ldims, preferred_element_type=f32)
 
     @pl.when(jnp.logical_and(li == 0, ki == 0))
     def _first():
@@ -478,6 +568,9 @@ def _decode_step_kernel_paged(aq: int, mq: int, gsz: int,
             q = q * qs_ref[0]
             k = k * ks_ref[0]
             v = v * vs_ref[0]
+        q = lora_add(q, xn, "wq")
+        k = lora_add(k, xn, "wk")
+        v = lora_add(v, xn, "wv")
         for j in range(nkv):
             kj = rope_head(k[:, j * d:(j + 1) * d])
             vj = v[:, j * d:(j + 1) * d]
@@ -632,6 +725,11 @@ def _decode_step_kernel_paged(aq: int, mq: int, gsz: int,
             preferred_element_type=f32)                   # (b_pad, h)
         if aq == 8:
             attn = attn * os_ref[0]
+        attn = lora_add(attn, ctx_scr[...], "wo")
+        if lxa_scr is not None:
+            # fresh layer: zero the w_down LoRA accumulator the MLP
+            # chunk ticks fold into
+            lxa_scr[...] = jnp.zeros(lxa_scr.shape, f32)
         x1 = x_scr[...] + attn
         nw2 = post_nw_ref[0].astype(f32)
         xn2_scr[...] = x1 * jax.lax.rsqrt(
@@ -652,12 +750,36 @@ def _decode_step_kernel_paged(aq: int, mq: int, gsz: int,
         if mq == 8:
             gate = gate * gs_ref[0]
             up = up * us_ref[0]
-        hid = (act(gate) * up).astype(cdt)
+        gate = lora_add(gate, xn2_scr[...], "w_gate")
+        up = lora_add(up, xn2_scr[...], "w_up")
+        hid32 = act(gate) * up
+        hid = hid32.astype(cdt)
         part = jax.lax.dot_general(hid, w_d, dims,
                                    preferred_element_type=f32)
         if mq == 8:
             part = part * ds_ref[0]
+        if lxa_scr is not None:
+            # w_down LoRA contracts over the FULL ffn axis while the
+            # down tiles stream f_chunk rows per tick: accumulate this
+            # chunk's x·A partial; (·⊙mask)·B applies once after the
+            # last chunk (_lora_down) — exact because the chunks
+            # partition the contraction
+            la_d = lab_refs["w_down"][0]
+            lxa_scr[...] = lxa_scr[...] + jax.lax.dot_general(
+                hid32, la_d[0], dims, preferred_element_type=f32)
         x_scr[...] = x_scr[...] + part
+
+    if lxa_scr is not None:
+        # runs after _mlp_chunk on the same (last-MLP) tick — pl.when
+        # blocks execute in definition order — so the accumulator holds
+        # every chunk's partial before B is applied
+        @pl.when(jnp.logical_and(ki == nk + nm - 1, "finish" in phases))
+        def _lora_down():
+            ldims = (((1,), (0,)), ((), ()))
+            lb_d = lab_refs["w_down"][1]
+            x_scr[...] = x_scr[...] + jax.lax.dot_general(
+                lxa_scr[...] * lmask_ref[...], lb_d[0], ldims,
+                preferred_element_type=f32)
 
     @pl.when(jnp.logical_and(li == n_layers - 1, ki == nk + nm - 1))
     def _emit():
@@ -786,7 +908,7 @@ def _class_itemsizes(params, aq: int, mq: int) -> tuple[float, float]:
 
 
 def fused_decode_eligible(cfg, params, k_cache, s: int,
-                          platform: str) -> bool:
+                          platform: str, lora_sr: int = 0) -> bool:
     """Static predicate for the dense fused path: the module-docstring
     scope (RMSNorm GLU rotary stack, single token, no mesh), the
     per-class weight-precision matrix of ``_stack_eligible`` (plain /
@@ -803,6 +925,10 @@ def fused_decode_eligible(cfg, params, k_cache, s: int,
 
     if s != 1:
         return False
+    if lora_sr and lora_sr % 128 != 0:
+        # the (h, Sr) arena tiles and (b, Sr) mask need a lane-aligned
+        # stacked rank; registries pad n_slots·r or keep the composed path
+        return False
     elig = _stack_eligible(cfg, params, platform)
     if elig is None:
         return False
@@ -815,7 +941,7 @@ def fused_decode_eligible(cfg, params, k_cache, s: int,
         return False
     attn_item, mlp_item = _class_itemsizes(params, aq, mq)
     return _pick_block_k(cfg, b, max_len, attn_item, mlp_item,
-                         kc.dtype.itemsize) >= 128
+                         kc.dtype.itemsize, lora_sr=lora_sr) >= 128
 
 
 def _mesh_shards_stack(mesh) -> bool:
@@ -842,7 +968,7 @@ def _mesh_shards_stack(mesh) -> bool:
 
 def fused_paged_decode_eligible(cfg, params, k_pool, n_slots: int,
                                 table_blocks: int, platform: str,
-                                mesh=None) -> bool:
+                                mesh=None, lora_sr: int = 0) -> bool:
     """Static predicate for the PAGED fused path (fused_decode_step_paged).
 
     Same stack scope as fused_decode_eligible, with the shape checks on
@@ -855,6 +981,8 @@ def fused_paged_decode_eligible(cfg, params, k_pool, n_slots: int,
     from ..ops.kv_quant import is_quantized_cache
 
     if n_slots < 1 or table_blocks < 1:
+        return False
+    if lora_sr and lora_sr % 128 != 0:
         return False
     if _mesh_shards_stack(mesh):
         return False
@@ -872,13 +1000,15 @@ def fused_paged_decode_eligible(cfg, params, k_pool, n_slots: int,
     # VMEM term loses its batch factor, but the broadcast-reduce scratch
     # is still over all b rows (the masked no-op trick computes them all)
     return _vmem_fit(cfg, n_slots, block_k, attn_item, mlp_item,
-                     1 if cq8 else kc.dtype.itemsize, cache_rows=1)
+                     1 if cq8 else kc.dtype.itemsize, cache_rows=1,
+                     lora_sr=lora_sr)
 
 
 def fused_paged_verify_eligible(cfg, params, k_pool, n_slots: int,
                                 window: int, table_blocks: int,
                                 platform: str, mesh=None,
-                                tree: bool = False) -> bool:
+                                tree: bool = False,
+                                lora_sr: int = 0) -> bool:
     """Static predicate for the speculative verify kernel
     (fused_decode_verify_paged): the paged predicate with the row batch
     widened to ``n_slots * window`` — the flattened (slot, window-pos)
@@ -891,6 +1021,8 @@ def fused_paged_verify_eligible(cfg, params, k_pool, n_slots: int,
     from ..ops.kv_quant import is_quantized_cache
 
     if n_slots < 1 or window < 1 or table_blocks < 1:
+        return False
+    if lora_sr and lora_sr % 128 != 0:
         return False
     if _mesh_shards_stack(mesh):
         return False
@@ -906,7 +1038,7 @@ def fused_paged_verify_eligible(cfg, params, k_pool, n_slots: int,
     attn_item, mlp_item = _class_itemsizes(params, aq, mq)
     return _vmem_fit(cfg, n_slots * window, block_k, attn_item, mlp_item,
                      1 if cq8 else kc.dtype.itemsize, cache_rows=1,
-                     extra_bcast=2 if tree else 0)
+                     extra_bcast=2 if tree else 0, lora_sr=lora_sr)
 
 
 def _mlp_chunks(ffn: int, cap: int = 4) -> int:
@@ -928,7 +1060,8 @@ def _default_block_k(cache_int8: bool) -> int:
 
 
 def _pick_block_k(cfg, b: int, max_len: int, attn_itemsize: float,
-                  mlp_itemsize: float, cache_itemsize: int) -> int:
+                  mlp_itemsize: float, cache_itemsize: int,
+                  lora_sr: int = 0) -> int:
     """Largest cache block that fits the VMEM estimate: start from the
     dtype-appropriate default and halve while the budget rejects it (the
     fp32 broadcast-reduce temporaries scale with block_k, so a wide int8
@@ -938,7 +1071,8 @@ def _pick_block_k(cfg, b: int, max_len: int, attn_itemsize: float,
     while max_len % bk:
         bk //= 2
     while bk >= 128 and not _vmem_fit(cfg, b, bk, attn_itemsize,
-                                      mlp_itemsize, cache_itemsize):
+                                      mlp_itemsize, cache_itemsize,
+                                      lora_sr=lora_sr):
         bk //= 2
     return bk
 
@@ -947,7 +1081,8 @@ def _vmem_fit(cfg, b: int, block_k: int, attn_itemsize: float,
               mlp_itemsize: float, cache_itemsize: int,
               budget: int = 100 * 1024 * 1024,
               cache_rows: int | None = None,
-              extra_bcast: int = 0) -> bool:
+              extra_bcast: int = 0,
+              lora_sr: int = 0) -> bool:
     """Whole-layer-resident VMEM estimate: the kernel holds one layer's
     weights + two KV blocks, double-buffered, plus fp32 scratch.  Layers
     wider than the budget (e.g. 7B-width: ~354 MB/layer bf16) must keep
@@ -988,7 +1123,58 @@ def _vmem_fit(cfg, b: int, block_k: int, attn_itemsize: float,
                    + int4_tmp
                    # the (b, nkv, block_k, d) broadcast-reduce temporaries
                    + n_tmp * b * nkv * block_k * d)
-    return int(blocks + scratch) <= budget
+    lora_bytes = 0
+    if lora_sr:
+        # stacked LoRA arena blocks (fp32, double-buffered), charged for
+        # all seven targets — the predicates don't see the target set,
+        # and overcharging only declines fusion.  A factors ride full;
+        # gate/up B and down A chunk with the MLP ticks.
+        f_chunk = ffn // _mlp_chunks(ffn)
+        arena_elts = (h * lora_sr + lora_sr * nq * d            # wq
+                      + 2 * (h * lora_sr + lora_sr * nkv * d)   # wk, wv
+                      + nq * d * lora_sr + lora_sr * h          # wo
+                      + 2 * (h * lora_sr + lora_sr * f_chunk)   # gate, up
+                      + f_chunk * lora_sr + lora_sr * h)        # down
+        # mask operand + x·A temporaries + the w_down accumulator scratch
+        lora_bytes = arena_elts * 4 * 2 + 6 * b_pad * lora_sr * 4
+    return int(blocks + scratch + lora_bytes) <= budget
+
+
+def _lora_specs(lt, lsr, b_pad, h, nq, nkv, d, f_chunk, nk, nm):
+    """BlockSpecs for the LoRA mask + per-target stacked A/B arena
+    operands, in the kernel's unpacking order (mask, then (A, B) per
+    target).  A factors ride whole per layer; the gate/up B columns and
+    the down A rows chunk with the MLP ticks, mirroring the base w_gate/
+    w_up/w_down streaming so the epilogue adds no per-layer DMA burst."""
+    def fixed(shape):
+        return pl.BlockSpec(shape, lambda li, ki, *s: (0,) * len(shape))
+
+    def per_layer(shape):
+        return pl.BlockSpec(
+            (1,) + shape, lambda li, ki, *s: (li,) + (0,) * len(shape))
+
+    def col_chunk():  # gate/up B: walks the ffn columns with MLP ticks
+        def idx(li, ki, *s):
+            return (li, 0, jnp.clip(ki - nk, 0, nm - 1))
+        return pl.BlockSpec((1, lsr, f_chunk), idx)
+
+    def row_chunk():  # down A: walks the ffn rows with MLP ticks
+        def idx(li, ki, *s):
+            return (li, jnp.clip(ki - nk, 0, nm - 1), 0)
+        return pl.BlockSpec((1, f_chunk, lsr), idx)
+
+    specs = [fixed((b_pad, lsr))]
+    for t in lt:
+        if t in ("wq", "wk", "wv"):
+            o = nq * d if t == "wq" else nkv * d
+            specs += [per_layer((h, lsr)), per_layer((lsr, o))]
+        elif t == "wo":
+            specs += [per_layer((nq * d, lsr)), per_layer((lsr, h))]
+        elif t in ("w_gate", "w_up"):
+            specs += [per_layer((h, lsr)), col_chunk()]
+        else:  # w_down
+            specs += [row_chunk(), per_layer((lsr, h))]
+    return specs
 
 
 def fused_decode_step(
@@ -1004,6 +1190,10 @@ def fused_decode_step(
     #                        at its own depth, free slots ride at fill 0)
     rope: tuple,           # (cos, sin) tables from rope_tables(cfg)
     *,
+    lora=None,             # (arenas, mask): per-target stacked LoRA A/B
+    #                        factors (ops/lora.py:make_arenas layout) +
+    #                        a [b, Sr] fp32 per-row slot mask
+    #                        (ops/lora.py:slot_mask) — None = base only
     block_k: int | None = None,
     interpret: bool | None = None,
 ):
@@ -1049,12 +1239,21 @@ def fused_decode_step(
     gsz = (int4_group_size(attn_p["wq"]) if aq == 4
            else int4_group_size(mlp_p["w_gate"]) if mq == 4 else 0)
 
+    lsr, lt = 0, ()
+    if lora is not None:
+        from ..ops.lora import LORA_TARGETS
+
+        arenas, lmask = lora
+        lt = tuple(t for t in LORA_TARGETS if t in arenas)
+        lsr = int(arenas[lt[0]]["a"].shape[-1])
+
     if block_k is None:
         # same probe as fused_decode_eligible, so the block the predicate
         # accepted is the block the call actually launches with
         attn_item, mlp_item = _class_itemsizes({"layers": stacked}, aq, mq)
         block_k = _pick_block_k(cfg, b, max_len, attn_item, mlp_item,
-                                1 if cq8 else k_arr.dtype.itemsize)
+                                1 if cq8 else k_arr.dtype.itemsize,
+                                lora_sr=lsr)
     block_k = min(block_k, max_len)
     while max_len % block_k:
         block_k //= 2
@@ -1115,6 +1314,13 @@ def fused_decode_step(
     # keeps the (block_k, 1) block legal (flash_decode _scale_block_spec)
     cache_scales = (k_cache["scale"][..., None],
                     v_cache["scale"][..., None]) if cq8 else ()
+    lora_ops = ()
+    if lsr:
+        lmask_p = jnp.asarray(lmask, jnp.float32)
+        if b_pad != b:
+            lmask_p = jnp.pad(lmask_p, ((0, b_pad - b), (0, 0)))
+        lora_ops = (lmask_p,) + tuple(
+            a for t in lt for a in (arenas[t]["a"], arenas[t]["b"]))
     operands = (
         x_p, rot, *rope_rows,
         stacked["input_norm"]["scale"][:, None, :],
@@ -1123,7 +1329,7 @@ def fused_decode_step(
         wm_a(attn_p["wo"]),
         wm_m(mlp_p["w_gate"]), wm_m(mlp_p["w_up"]), wm_m(mlp_p["w_down"]),
         *weight_scales,
-        k_arr, v_arr, *cache_scales,
+        k_arr, v_arr, *cache_scales, *lora_ops,
     )
 
     def fixed(shape):
@@ -1199,6 +1405,8 @@ def fused_decode_step(
         *attn_scale_specs, *mlp_scale_specs,
         cache_spec(), cache_spec(),
         *([cache_scale_spec(), cache_scale_spec()] if cq8 else []),
+        *(_lora_specs(lt, lsr, b_pad, h, nq, nkv, d, f_chunk, nk, nm)
+          if lsr else []),
     ]
     out_specs = [
         fixed((b_pad, h)),
@@ -1224,13 +1432,16 @@ def fused_decode_step(
         pltpu.VMEM((g, b, nkv, 128), jnp.float32),     # online-softmax l
         pltpu.VMEM((g, b, nkv, d), jnp.float32),       # online-softmax acc
     ]
+    if lsr and "w_down" in lt:
+        # w_down LoRA x·A accumulator (see _mlp_chunk / _lora_down)
+        scratch.append(pltpu.VMEM((b_pad, lsr), jnp.float32))
 
     # jax < 0.5 exposes the TPU compiler params under the old name
     compiler_params_cls = getattr(pltpu, "CompilerParams", None) \
         or pltpu.TPUCompilerParams
     hidden, k_rows, v_rows = pl.pallas_call(
         functools.partial(_decode_step_kernel, per_row, aq, mq, gsz, cq8,
-                          nk, nm, block_k,
+                          lsr, lt, nk, nm, block_k,
                           b, nq, nkv, g, d, eps, scale, act),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -1263,6 +1474,8 @@ def fused_decode_step_paged(
     fills: jax.Array,    # [b] int32 per-row fills (free slots at 0)
     rope: tuple,         # (cos, sin) tables from rope_tables(cfg)
     *,
+    lora=None,           # (arenas, [b, Sr] slot mask) — see
+    #                      fused_decode_step; None = base only
     interpret: bool | None = None,
 ):
     """Paged fused decode step: the dense kernel's contract — returns
@@ -1279,7 +1492,7 @@ def fused_decode_step_paged(
     """
     fills = jnp.asarray(fills, jnp.int32)
     return _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables,
-                             fills, fills, rope, window=1,
+                             fills, fills, rope, window=1, lora=lora,
                              interpret=interpret)
 
 
@@ -1302,6 +1515,10 @@ def fused_decode_verify_paged(
     anc: jax.Array | None = None,     # [S, W, W] int32 parent-pointer
     #                      closure: anc[s, j, dd] = node index of row j's
     #                      ancestor at depth dd.  Required iff depths is.
+    lora=None,           # (arenas, [S, Sr] per-SLOT mask): every window
+    #                      row — the pending token and each draft — is
+    #                      verified under its requester's adapter (the
+    #                      mask row repeats W times)
     interpret: bool | None = None,
 ):
     """Batched variable-length speculative verify: the paged fused step
@@ -1337,15 +1554,22 @@ def fused_decode_verify_paged(
         pos = (fills[:, None]
                + jnp.asarray(depths, jnp.int32)).reshape(-1)
         anc_flat = jnp.asarray(anc, jnp.int32).reshape(S, W * W)
+    if lora is not None:
+        # expand the per-slot mask to the flattened (slot, window-pos)
+        # row batch: drafts verify under the requester's adapter
+        arenas, lmask = lora
+        lora = (arenas, jnp.repeat(jnp.asarray(lmask, jnp.float32),
+                                   W, axis=0))
     hidden, k_rows, v_rows = _fused_paged_call(
         cfg, stacked, x.reshape(S * W, h), k_pool, v_pool, tables, pos,
-        fills, rope, window=W, tree_anc=anc_flat, interpret=interpret)
+        fills, rope, window=W, tree_anc=anc_flat, lora=lora,
+        interpret=interpret)
     return hidden.reshape(S, W, h), k_rows, v_rows
 
 
 def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
                       fills, rope, *, window: int, tree_anc=None,
-                      interpret: bool | None = None):
+                      lora=None, interpret: bool | None = None):
     """Shared launch builder for the paged decode/verify kernels.
 
     ``x`` is the flattened [b = S·window, h] row batch, ``pos`` the [b]
@@ -1396,6 +1620,20 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
         s_rows = jnp.pad(s_rows, ((0, b_pad - b), (0, 0)))
     rot = _pair_swap_matrix(d)
 
+    lsr, lt = 0, ()
+    lora_ops = ()
+    if lora is not None:
+        from ..ops.lora import LORA_TARGETS
+
+        arenas, lmask = lora
+        lt = tuple(t for t in LORA_TARGETS if t in arenas)
+        lsr = int(arenas[lt[0]]["a"].shape[-1])
+        lmask_p = jnp.asarray(lmask, jnp.float32)
+        if b_pad != b:
+            lmask_p = jnp.pad(lmask_p, ((0, b_pad - b), (0, 0)))
+        lora_ops = (lmask_p,) + tuple(
+            a for t in lt for a in (arenas[t]["a"], arenas[t]["b"]))
+
     attn_p, mlp_p = stacked["attn"], stacked["mlp"]
     aq = weight_bits(attn_p["wq"])
     mq = weight_bits(mlp_p["w_gate"])
@@ -1435,7 +1673,7 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
         wm_a(attn_p["wo"]),
         wm_m(mlp_p["w_gate"]), wm_m(mlp_p["w_up"]), wm_m(mlp_p["w_down"]),
         *weight_scales,
-        k_arr, v_arr, *cache_scales,
+        k_arr, v_arr, *cache_scales, *lora_ops,
     )
 
     # index maps take BOTH prefetched scalars (lens, tables) — varargs
@@ -1515,6 +1753,8 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
         *attn_scale_specs, *mlp_scale_specs,
         cache_spec(d), cache_spec(d),
         *([cache_spec(1), cache_spec(1)] if cq8 else []),
+        *(_lora_specs(lt, lsr, b_pad, h, nq, nkv, d, f_chunk, nk, nm)
+          if lsr else []),
     ]
     out_specs = [
         fixed((b_pad, h)),
@@ -1538,13 +1778,18 @@ def _fused_paged_call(cfg, stacked, x, k_pool, v_pool, tables, pos,
         pltpu.VMEM((g, b, nkv, d), jnp.float32),       # online-softmax acc
     ]
 
+    if lsr and "w_down" in lt:
+        # w_down LoRA x·A accumulator (see _mlp_chunk / _lora_down)
+        scratch.append(pltpu.VMEM((b_pad, lsr), jnp.float32))
+
     compiler_params_cls = getattr(pltpu, "CompilerParams", None) \
         or pltpu.TPUCompilerParams
     tree = tree_anc is not None
     prefetch = (lens, tables) if not tree \
         else (lens, tables, jnp.asarray(tree_anc, jnp.int32))
     hidden, k_rows, v_rows = pl.pallas_call(
-        functools.partial(_decode_step_kernel_paged, aq, mq, gsz, cq8, W,
+        functools.partial(_decode_step_kernel_paged, aq, mq, gsz, cq8,
+                          lsr, lt, W,
                           tree, ntb, nm, block_k,
                           b, nq, nkv, g, d, eps, scale, act),
         grid_spec=pltpu.PrefetchScalarGridSpec(

@@ -118,12 +118,17 @@ def forward_hidden(
     rng: Optional[jax.Array] = None,
     deterministic: bool = True,
     rope: Optional[tuple] = None,
+    lora=None,
 ):
     """Forward through the final norm → ``(hidden [b,s,h], moe_aux)``.
 
     The pre-unembedding split lets the training loss use the fused
     linear+CE head (parallel/cross_entropy.fused_linear_cross_entropy)
-    without materializing fp32 logits."""
+    without materializing fp32 logits.
+
+    ``lora`` is ``(arenas, mask)`` — layer-stacked LoRA arena factors
+    plus the per-row column mask (ops/lora.py) — applied as projection
+    epilogues down the stack; None means base weights only."""
     if rope is None:
         cos, sin = rope_tables(cfg)
     else:
@@ -150,7 +155,8 @@ def forward_hidden(
         deterministic=deterministic,
         seq_shard_axes=seq_axes,
     )
-    x, moe_aux = stack_forward(cfg, params["layers"], x, side, stack_rng)
+    x, moe_aux = stack_forward(cfg, params["layers"], x, side, stack_rng,
+                               lora=lora)
     x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps,
                    impl=cfg.norm_impl)
     return x, moe_aux
@@ -168,6 +174,7 @@ def forward(
     deterministic: bool = True,
     rope: Optional[tuple] = None,
     return_aux: bool = False,
+    lora=None,
 ):
     """Full forward to logits [b, s, padded_vocab] (fp32).
 
@@ -178,7 +185,7 @@ def forward(
     x, moe_aux = forward_hidden(
         cfg, params, tokens, position_ids=position_ids,
         segment_ids=segment_ids, tokentype_ids=tokentype_ids, rng=rng,
-        deterministic=deterministic, rope=rope)
+        deterministic=deterministic, rope=rope, lora=lora)
     logits = unembed(cfg, params, x)
     logits = logits.astype(jnp.float32)
     if return_aux:
@@ -199,6 +206,7 @@ def forward_cached(
     empty_cache: bool = False,
     last_logit_only: bool = False,
     logit_rows: Optional[jax.Array] = None,
+    lora=None,
 ):
     """Incremental forward for generation: consume ``tokens`` positioned at
     ``cache_len..cache_len+s``, append their K/V to the cache, and return
@@ -237,8 +245,13 @@ def forward_cached(
 
     from ..kernels.decode_step import fused_decode_eligible
 
+    lora_sr = 0
+    if lora is not None:
+        from ..ops.lora import arena_sr
+
+        lora_sr = arena_sr(lora[0])
     if fused_decode_eligible(cfg, params, k_cache, s,
-                             jax.default_backend()):
+                             jax.default_backend(), lora_sr):
         # single-token fast path: the whole stack in one Pallas kernel
         # (kernels/decode_step.py) — the caller-visible contract (returned
         # logits + updated caches) is identical to the composed path.
@@ -255,7 +268,7 @@ def forward_cached(
 
         hidden, k_rows, v_rows = fused_decode_step(
             cfg, params["layers"], x[:, 0], k_cache, v_cache, cache_len,
-            (cos, sin))
+            (cos, sin), lora=lora)
         x = hidden[:, None, :]
         new_k = cache_update(k_cache, k_rows, cache_len)
         new_v = cache_update(v_cache, v_rows, cache_len)
@@ -264,7 +277,8 @@ def forward_cached(
                               position_ids=position_ids, deterministic=True,
                               cache_is_empty=empty_cache)
         x, new_k, new_v = stack_forward_cached(
-            cfg, params["layers"], x, side, k_cache, v_cache, cache_len)
+            cfg, params["layers"], x, side, k_cache, v_cache, cache_len,
+            lora=lora)
     x = norm_apply(cfg.norm_type, x, params["final_norm"], cfg.norm_eps,
                    impl=cfg.norm_impl)
     if last_logit_only:
@@ -287,6 +301,7 @@ def forward_cached_paged(
     *,
     rope: Optional[tuple] = None,
     use_fused: bool = False,
+    lora=None,
 ):
     """Single-token decode over the paged block pool.
 
@@ -325,7 +340,7 @@ def forward_cached_paged(
         x = embed(cfg, params, tokens, fills[:, None])
         hidden, k_rows, v_rows = fused_decode_step_paged(
             cfg, params["layers"], x[:, 0], k_pool, v_pool, tables, fills,
-            (cos, sin))
+            (cos, sin), lora=lora)
         if is_quantized_cache(k_pool):
             k_rows = quantize_rows(k_rows)
             v_rows = quantize_rows(v_rows)
@@ -339,7 +354,7 @@ def forward_cached_paged(
     k_dense = cache_gather_blocks(k_pool, tables)
     v_dense = cache_gather_blocks(v_pool, tables)
     logits, k_dense, v_dense = forward_cached(
-        cfg, params, tokens, k_dense, v_dense, fills, rope=rope)
+        cfg, params, tokens, k_dense, v_dense, fills, rope=rope, lora=lora)
     k_pool = cache_append_rows(
         k_pool, cache_rows_at(k_dense, fills), bids, offs)
     v_pool = cache_append_rows(
@@ -361,6 +376,7 @@ def forward_cached_paged_verify(
     rope: Optional[tuple] = None,
     use_fused: bool = False,
     tree: Optional[tuple] = None,
+    lora=None,
 ):
     """Batched variable-length speculative *verify* over the paged pool.
 
@@ -439,7 +455,7 @@ def forward_cached_paged_verify(
         x = embed(cfg, params, window, pos)
         hidden, k_rows, v_rows = fused_decode_verify_paged(
             cfg, params["layers"], x, k_pool, v_pool, tables, fills, rope,
-            depths=depths, anc=anc)
+            depths=depths, anc=anc, lora=lora)
         if is_quantized_cache(k_pool):
             k_rows = quantize_rows(k_rows)
             v_rows = quantize_rows(v_rows)
@@ -456,7 +472,7 @@ def forward_cached_paged_verify(
         for j in range(W):
             lj, k_dense, v_dense = forward_cached(
                 cfg, params, window[:, j:j + 1], k_dense, v_dense, fills + j,
-                rope=rope)
+                rope=rope, lora=lora)
             steps.append(lj)
         logits = jnp.concatenate(steps, axis=1)
         k_pool = cache_append_rows(
@@ -503,7 +519,7 @@ def forward_cached_paged_verify(
         pj = fills + depths[:, j]
         lj, k_dense, v_dense = forward_cached(
             cfg, params, window[:, j:j + 1], k_dense, v_dense, pj,
-            rope=rope)
+            rope=rope, lora=lora)
         steps.append(lj)
         kr = cache_rows_at(k_dense, pj)
         vr = cache_rows_at(v_dense, pj)

@@ -214,9 +214,31 @@ def _layer_rates(cfg: ModelConfig, layer_idx):
     return hidden, cfg.drop_path_rate * frac
 
 
+def _lora_add(y: jax.Array, x: jax.Array, lora, target: str) -> jax.Array:
+    """Add the grouped LoRA epilogue for ``target`` onto projection output
+    ``y`` (input ``x``), or return ``y`` untouched when the layer's lora
+    bundle is absent or doesn't adapt this target.
+
+    ``lora`` is ``(factors, mask)``: per-layer arena slices
+    ``{target: {"a": [in, Sr], "b": [Sr, out]}}`` plus the per-row column
+    mask ``[b, Sr]`` (ops/lora.py:slot_mask).  The delta is fp32 with ±0
+    contributions from masked columns, so rows whose slot is -1 (or whose
+    adapter differs) are bitwise-unaffected at the token level — the same
+    contract as the fused kernel's in-kernel epilogue."""
+    if lora is None:
+        return y
+    factors, mask = lora
+    f = factors.get(target)
+    if f is None:
+        return y
+    from ..ops.lora import lora_delta
+
+    return (y + lora_delta(x, f["a"], f["b"], mask)).astype(y.dtype)
+
+
 def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
                     side: AttnSideInputs, layer_rng,
-                    kv_cache: Optional[tuple] = None):
+                    kv_cache: Optional[tuple] = None, lora=None):
     """QKV projection → RoPE → attention → output projection.
 
     Parity: megatron/model/transformer.py:412-565 (ParallelAttention) with
@@ -228,15 +250,20 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
     transformer.py:423-496).  When given, the return value is
     ``(out, (new_k_rows, new_v_rows))`` — the new tokens' [b, nkv, s, d]
     rows, NOT an updated cache; the caller owns the write-back.
+
+    ``lora`` is the per-layer ``(factors, mask)`` bundle (see
+    :func:`_lora_add`); deltas land right after each base projection,
+    before bias/reshape/RoPE — the same insertion points as the fused
+    decode kernel's epilogue.
     """
     b, s, h = x.shape
     d = cfg.head_dim
     nq = cfg.num_attention_heads
     nkv = cfg.kv_heads
 
-    q = proj(cfg, x, p["wq"])
-    k = proj(cfg, x, p["wk"])
-    v = proj(cfg, x, p["wv"])
+    q = _lora_add(proj(cfg, x, p["wq"]), x, lora, "wq")
+    k = _lora_add(proj(cfg, x, p["wk"]), x, lora, "wk")
+    v = _lora_add(proj(cfg, x, p["wv"]), x, lora, "wv")
     if "bq" in p:
         q = q + p["bq"]
         k = k + p["bk"]
@@ -310,7 +337,8 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
             block_q=cfg.flash_block_q,
             block_k=cfg.flash_block_k,
         )
-    out = proj(cfg, ctx.reshape(b, s, nq * d), p["wo"])
+    ctx2d = ctx.reshape(b, s, nq * d)
+    out = _lora_add(proj(cfg, ctx2d, p["wo"]), ctx2d, lora, "wo")
     if "bo" in p:
         out = out + p["bo"]
     if kv_cache is not None:
@@ -322,14 +350,15 @@ def attention_block(cfg: ModelConfig, p: Params, x: jax.Array,
     return out
 
 
-def mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
+def mlp_block(cfg: ModelConfig, p: Params, x: jax.Array,
+              lora=None) -> jax.Array:
     """(gated) MLP.  Parity: megatron/model/transformer.py:77-141
     (ParallelMLP) with the GLU split expressed as two separate projections so
     tensor sharding never slices across the gate/up boundary."""
     act = get_activation(cfg.activation)
     if is_glu(cfg.activation):
-        gate = proj(cfg, x, p["w_gate"])
-        up = proj(cfg, x, p["w_up"])
+        gate = _lora_add(proj(cfg, x, p["w_gate"]), x, lora, "w_gate")
+        up = _lora_add(proj(cfg, x, p["w_up"]), x, lora, "w_up")
         if "b_gate" in p:
             gate = gate + p["b_gate"]
             up = up + p["b_up"]
@@ -338,17 +367,17 @@ def mlp_block(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
         hidden = jnp.concatenate([gate, up], axis=-1)
         hidden = act(hidden)
     else:
-        hidden = proj(cfg, x, p["w_up"])
+        hidden = _lora_add(proj(cfg, x, p["w_up"]), x, lora, "w_up")
         if "b_up" in p:
             hidden = hidden + p["b_up"]
         hidden = act(hidden)
-    out = proj(cfg, hidden, p["w_down"])
+    out = _lora_add(proj(cfg, hidden, p["w_down"]), hidden, lora, "w_down")
     if "b_down" in p:
         out = out + p["b_down"]
     return out
 
 
-def _mlp_dispatch(cfg: ModelConfig, p: Params, x: jax.Array):
+def _mlp_dispatch(cfg: ModelConfig, p: Params, x: jax.Array, lora=None):
     """Dense or routed MLP → ``(out, aux)``.
 
     ``aux`` is a scalar 0 for dense models and the MoE stats dict
@@ -357,14 +386,16 @@ def _mlp_dispatch(cfg: ModelConfig, p: Params, x: jax.Array):
     if cfg.num_experts > 0:
         from .moe import moe_block
 
+        # MoE experts are never LoRA targets (registry rejects mlp
+        # targets for num_experts > 0); attention adapters still apply
         return moe_block(cfg, p, x)
-    return mlp_block(cfg, p, x), jnp.zeros((), jnp.float32)
+    return mlp_block(cfg, p, x, lora=lora), jnp.zeros((), jnp.float32)
 
 
 def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
                   side: AttnSideInputs, layer_rng=None,
                   kv_cache: Optional[tuple] = None,
-                  layer_idx=None):
+                  layer_idx=None, lora=None):
     """One pre-LN residual block, sequential or Falcon-parallel.
 
     Parity: megatron/model/transformer.py:695-817
@@ -406,9 +437,11 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
     new_cache = None
     if kv_cache is not None:
         attn_out, new_cache = attention_block(cfg, p["attn"], h1, side,
-                                              layer_rng, kv_cache)
+                                              layer_rng, kv_cache,
+                                              lora=lora)
     else:
-        attn_out = attention_block(cfg, p["attn"], h1, side, layer_rng)
+        attn_out = attention_block(cfg, p["attn"], h1, side, layer_rng,
+                                   lora=lora)
 
     if cfg.parallel_attn:
         if cfg.parallel_layernorm:
@@ -416,13 +449,13 @@ def layer_forward(cfg: ModelConfig, p: Params, x: jax.Array,
                                 cfg.norm_eps, impl=cfg.norm_impl)
         else:
             mlp_in = h1
-        mlp_out, aux = _mlp_dispatch(cfg, p["mlp"], mlp_in)
+        mlp_out, aux = _mlp_dispatch(cfg, p["mlp"], mlp_in, lora=lora)
         result = residual + branch_drop(attn_out + mlp_out, 2)
     else:
         x = residual + branch_drop(attn_out, 2)
         h2 = norm_apply(cfg.norm_type, x, p["post_attn_norm"],
                         cfg.norm_eps, impl=cfg.norm_impl)
-        m, aux = _mlp_dispatch(cfg, p["mlp"], h2)
+        m, aux = _mlp_dispatch(cfg, p["mlp"], h2, lora=lora)
         result = x + branch_drop(m, 3)
     result = seq_constrain(result, side.seq_shard_axes)
     if kv_cache is not None:
@@ -442,23 +475,35 @@ def _remat_policy(cfg: ModelConfig):
 
 
 def stack_forward(cfg: ModelConfig, stacked: Params, x: jax.Array,
-                  side: AttnSideInputs, base_rng=None, layer_offset=0):
+                  side: AttnSideInputs, base_rng=None, layer_offset=0,
+                  lora=None):
     """Run all layers with lax.scan over the stacked parameter pytree.
 
     Returns ``(hidden, moe_aux)`` — the aux load-balance loss summed over
     layers (0 for dense models).  ``layer_offset`` is the global index of
     the first layer in ``stacked`` (nonzero for pipeline chunks) so the
     LIMA/drop-path per-layer rate ramps stay global.
+
+    ``lora`` is ``(arenas, mask)`` with layer-stacked arena factors
+    (leading L axis, joining the scan xs) — the LoRA finetune path runs
+    through here with the factors as the differentiable operand.
     """
+    arenas, mask = lora if lora is not None else (None, None)
 
     def body(carry, inp):
         h, idx, aux_sum = carry
-        layer_params, = inp
+        if arenas is not None:
+            layer_params, ar_l = inp
+            layer_lora = (ar_l, mask)
+        else:
+            layer_params, = inp
+            layer_lora = None
         rng = None
         if base_rng is not None:
             rng = jax.random.fold_in(base_rng, idx)
         h, aux = layer_forward(cfg, layer_params, h, side, rng,
-                               layer_idx=layer_offset + idx)
+                               layer_idx=layer_offset + idx,
+                               lora=layer_lora)
         return (h, idx + 1, jax.tree.map(jnp.add, aux_sum, aux)), None
 
     policy = _remat_policy(cfg)
@@ -473,7 +518,8 @@ def stack_forward(cfg: ModelConfig, stacked: Params, x: jax.Array,
         aux0 = stats_zero(cfg)
     else:
         aux0 = jnp.zeros((), jnp.float32)
-    (x, _, aux), _ = jax.lax.scan(body, (x, 0, aux0), (stacked,))
+    xs = (stacked,) if arenas is None else (stacked, arenas)
+    (x, _, aux), _ = jax.lax.scan(body, (x, 0, aux0), xs)
     return x, aux
 
 
@@ -481,7 +527,7 @@ def stack_forward_cached(cfg: ModelConfig, stacked: Params, x: jax.Array,
                          side: AttnSideInputs,
                          k_cache: jax.Array,  # [L, b, nkv, max_len, d]
                          v_cache: jax.Array,
-                         cache_len: jax.Array):
+                         cache_len: jax.Array, lora=None):
     """Scan over layers threading a per-layer KV cache (decode path).
 
     The cache is stacked on the leading layer axis, mirroring the stacked
@@ -496,15 +542,23 @@ def stack_forward_cached(cfg: ModelConfig, stacked: Params, x: jax.Array,
     ``cache_len``.  Parity: the reference's InferenceParams threading
     through ParallelTransformer (transformer.py:423-496,1158-1246).
     """
+    arenas, mask = lora if lora is not None else (None, None)
+
     def body(h, inp):
-        layer_params, k_l, v_l = inp  # per-layer cache slices, read-only xs
+        if arenas is not None:
+            layer_params, k_l, v_l, ar_l = inp
+            layer_lora = (ar_l, mask)
+        else:
+            layer_params, k_l, v_l = inp  # per-layer slices, read-only xs
+            layer_lora = None
         h, _aux, (k_rows, v_rows) = layer_forward(
             cfg, layer_params, h, side, None,
-            kv_cache=(k_l, v_l, cache_len))
+            kv_cache=(k_l, v_l, cache_len), lora=layer_lora)
         return h, (k_rows, v_rows)
 
-    x, (rows_k, rows_v) = jax.lax.scan(
-        body, x, (stacked, k_cache, v_cache))
+    xs = ((stacked, k_cache, v_cache) if arenas is None
+          else (stacked, k_cache, v_cache, arenas))
+    x, (rows_k, rows_v) = jax.lax.scan(body, x, xs)
     # one batched row write [L, b, nkv, s_new, d] — XLA aliases the DUS
     # with the loop-carried cache buffer, so decode writes s_new rows
     # instead of round-tripping the whole cache.  cache_update also

@@ -11,6 +11,10 @@
   Prometheus export (docs/observability.md).
 - ``bench.py`` — serving-throughput measurement (requests/s, token
   latency), consumed by the repo-level ``bench.py``.
+- ``adapters/`` — multi-tenant LoRA: adapter registry + device-arena
+  residency (LRU + ref pinning) so thousands of registered adapters
+  share one base model, different adapters coexisting per-row in one
+  decode batch.
 - ``cluster/`` — multi-chip serving: engines sharded over tp submeshes
   (``cluster/sharded.py``) behind a replicated health-aware router with
   drain-based failover (``cluster/router.py``), plus disaggregated
@@ -20,6 +24,7 @@
   and 'Disaggregated prefill/decode'.
 """
 
+from .adapters import AdapterRegistry
 from .cluster import Router, RouterConfig, RouterHandle, build_cluster, \
     build_disagg_cluster, build_sharded_engine
 from .engine import (
@@ -35,6 +40,7 @@ from .queue import QueueFull, RequestQueue
 from .slots import SlotAllocator
 
 __all__ = [
+    "AdapterRegistry",
     "EngineConfig",
     "Router",
     "RouterConfig",

@@ -345,6 +345,141 @@ def run_prefix_serving_bench(cfg, params, *, num_requests: int = 16,
     }
 
 
+def run_lora_serving_bench(cfg, params, *, num_requests: int = 16,
+                           prompt_len: int = 128, gen_len: int = 64,
+                           slots: int = 8, n_adapters: int = 8,
+                           cache_slots: int = 4, rank: int = 8,
+                           seed: int = 0) -> dict:
+    """Multi-tenant LoRA serving point (serving/adapters/, docs/serving.md
+    "Multi-tenant LoRA & live weight swap").
+
+    Three measured pieces:
+
+    - **base ITL** — the same traffic through an engine with NO adapter
+      registry: the pre-LoRA decode executable, the overhead baseline;
+    - **resident-adapter ITL** — adapter-decorated traffic where every
+      served adapter fits the arena (no parking, no install in the
+      window), so the gap to base ITL is EXACTLY the grouped-epilogue
+      cost riding in the fused decode step.  The headline
+      ``serving_lora_itl_overhead`` must stay ≤ 10% (bench.py's
+      lora_overhead_check, the always-on-epilogue acceptance bar);
+    - **rotation wave** — ``n_adapters`` > ``cache_slots`` tenants
+      arriving in repeat pairs, so admissions hit, miss+install, and
+      evict against the LRU arena: ``serving_lora_cache_hit_rate``
+      (gated in --compare) plus install/eviction counts.
+    """
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from ..ops.lora import init_lora_adapter
+    from .adapters.registry import AdapterRegistry
+    from .engine import EngineConfig, ServingEngine
+    from .metrics import ServingMetrics
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(num_requests)]
+    ecfg_kw = dict(
+        max_batch_size=slots,
+        max_seq_len=min(prompt_len + gen_len, cfg.max_position_embeddings),
+        max_queue_size=max(2 * num_requests, slots),
+        prefill_bucket=prompt_len,
+    )
+
+    def drive(engine, adapter_ids, make_stream):
+        """One traffic wave: request i carries adapter_ids[i % len]."""
+        handles = [engine.submit(p, max_new_tokens=gen_len,
+                                 use_eos_stop=False,
+                                 on_token=make_stream(),
+                                 adapter_id=adapter_ids[i
+                                                        % len(adapter_ids)])
+                   for i, p in enumerate(prompts)]
+        t0 = time.perf_counter()
+        results = [h.result(timeout=600) for h in handles]
+        dt = time.perf_counter() - t0
+        n_tokens = sum(len(r.tokens) - r.prompt_len for r in results)
+        return n_tokens / dt
+
+    # --- baseline: no registry, the pre-LoRA decode executable ---------
+    base_engine = ServingEngine(cfg, params, EngineConfig(**ecfg_kw)).start()
+    itl_base, stream_base = _itl_recorder()
+    try:
+        _warmup_executables(base_engine, [(prompts[0], 2)])
+        base_engine.metrics = ServingMetrics(slots)
+        base_tps = drive(base_engine, [None], stream_base)
+    finally:
+        base_engine.shutdown()
+
+    # --- multi-tenant engine: n_adapters tenants, cache_slots arena ----
+    def adapter(i):
+        ad = init_lora_adapter(cfg, jax.random.key(1000 + i), rank)
+        # non-zero B so the epilogue moves real bytes (zero-init B would
+        # measure an adapter that is numerically absent)
+        return dataclasses.replace(ad, factors={
+            t: {"a": f["a"],
+                "b": jax.random.normal(jax.random.key(2000 + i),
+                                       f["b"].shape, f["b"].dtype) * 0.02}
+            for t, f in ad.factors.items()})
+
+    registry = AdapterRegistry(cfg, n_slots=cache_slots, rank=rank)
+    ids = [f"tenant-{i}" for i in range(n_adapters)]
+    for i, aid in enumerate(ids):
+        registry.register(aid, adapter(i))
+
+    engine = ServingEngine(
+        cfg, params, EngineConfig(adapter_cache_slots=cache_slots,
+                                  **ecfg_kw),
+        adapters=registry).start()
+    itl_lora, stream_lora = _itl_recorder()
+    try:
+        # warmup compiles the LoRA-epilogue decode executable AND the
+        # base path (slot -1 rows) outside the window
+        engine.submit(prompts[0], max_new_tokens=2, use_eos_stop=False,
+                      adapter_id=ids[0]).result(timeout=600)
+        engine.submit(prompts[0], max_new_tokens=2,
+                      use_eos_stop=False).result(timeout=600)
+        engine.metrics = ServingMetrics(slots)
+
+        # resident wave: every adapter fits the arena alongside base
+        # rows — the measured gap to base ITL is pure epilogue cost
+        resident_ids = ids[:max(1, cache_slots - 1)] + [None]
+        lora_tps = drive(engine, resident_ids, stream_lora)
+
+        # rotation wave: all tenants through the LRU arena in repeat
+        # pairs (the second of each pair should hit the pinned slot)
+        engine.metrics = ServingMetrics(slots)
+        rotate_ids = [ids[(i // 2) % n_adapters]
+                      for i in range(num_requests)]
+        drive(engine, rotate_ids, lambda: None)
+        rot = engine.metrics.snapshot()
+    finally:
+        engine.shutdown()
+
+    base_p50 = itl_base.percentile(50) * 1e3
+    lora_p50 = itl_lora.percentile(50) * 1e3
+    return {
+        "serving_lora_itl_ms_p50": round(lora_p50, 3),
+        "serving_lora_itl_ms_p99": round(itl_lora.percentile(99) * 1e3, 3),
+        "serving_lora_base_itl_ms_p50": round(base_p50, 3),
+        "serving_lora_itl_overhead": round(lora_p50 / base_p50 - 1.0, 4),
+        "serving_lora_tokens_per_sec": round(lora_tps, 1),
+        "serving_lora_base_tokens_per_sec": round(base_tps, 1),
+        "serving_lora_cache_hit_rate": round(rot["adapter_hit_rate"], 4),
+        "serving_lora_installs": rot["adapter_installs"],
+        "serving_lora_evictions": rot["adapter_evictions"],
+        "serving_lora_resident_bytes": rot["adapter_resident_bytes"],
+        "serving_lora_n_adapters": n_adapters,
+        "serving_lora_cache_slots": cache_slots,
+        "serving_lora_rank": rank,
+        "serving_lora_num_requests": num_requests,
+        "serving_lora_prompt_len": prompt_len,
+        "serving_lora_gen_len": gen_len,
+        "serving_lora_slots": slots,
+    }
+
+
 def run_paged_serving_bench(cfg, params, *, num_requests: int = 12,
                             prompt_lens: tuple = (32, 512, 4096),
                             gen_len: int = 64, kv_block_size: int = 64,
@@ -1002,6 +1137,10 @@ def main() -> None:
     out.update(run_prefix_serving_bench(cfg, params, num_requests=4,
                                         shared_len=64, unique_len=8,
                                         gen_len=8, slots=2, block=8))
+    out.update(run_lora_serving_bench(cfg, params, num_requests=6,
+                                      prompt_len=8, gen_len=8, slots=2,
+                                      n_adapters=3, cache_slots=2,
+                                      rank=4))
     out.update(run_paged_serving_bench(cfg, params, num_requests=6,
                                        prompt_lens=(8, 32, 128),
                                        gen_len=8, kv_block_size=8,

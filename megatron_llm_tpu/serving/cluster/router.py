@@ -10,8 +10,11 @@ through one engine.
 Dispatch is least-loaded over per-replica ``ServingMetrics``/
 ``SLOTracker`` snapshots: replicas whose SLO burn says unhealthy are
 deprioritized (not excluded — a degraded replica beats a dropped
-request), draining/dead replicas are excluded, and ties break on
-(queue depth + active slots, -free blocks).  Streamed requests are
+request), draining/dead replicas are excluded, and ties break first on
+**adapter affinity** (a replica whose LoRA arena already holds the
+request's adapter decodes without an install — see
+``serving/adapters/``), then on (queue depth + active slots, -free
+blocks).  Streamed requests are
 sticky by construction — a request is dispatched to one replica and its
 tokens stream from there — and an optional ``sticky_key`` spec field
 pins related requests (e.g. one conversation hitting the same replica's
@@ -46,6 +49,14 @@ lists, RNG seed + fold counter, stream callback — so nothing is
 regenerated and the client stream is bitwise-continuous by
 construction, with in-flight shipments tracked in ``_shipments`` (and
 attributed by the LedgerSanitizer via the pool's shipment ledger).
+
+The router is also the deploy plane for **live weight swap**:
+``rolling_swap`` walks the replicas one at a time — stop routing new
+work there, pull its queued requests onto siblings, live-migrate its
+in-flight decodes away, then ``engine.swap_params`` (which fences at an
+iteration boundary, so anything unmigratable — e.g. mid-prefill — rides
+through in place without losing a token) and undrain.  At every instant
+all but one replica serve, and no client stream replays or drops.
 
 Every router lock comes from ``analysis.sanitizers.make_lock`` so the
 lock-order cycle detector covers the router ↔ engine interleavings, and
@@ -138,6 +149,16 @@ class Replica:
             "completed": self.completed,
             "slo": e.metrics.slo.snapshot(),
         }
+
+
+def _no_affinity(r: Replica, adapter_id: Optional[str]) -> bool:
+    """Sort-key term for adapter affinity: ``False`` (sorts first) when
+    the replica's LoRA arena already holds the adapter.  Base requests
+    (``adapter_id is None``) see every replica as equal."""
+    if adapter_id is None:
+        return False
+    a = r.engine.adapters
+    return not (a is not None and a.is_resident(adapter_id))
 
 
 class _Routed:
@@ -236,6 +257,7 @@ class Router:
         self.ships_total = 0          # prefill → decode KV handoffs
         self.migrations_total = 0     # live decode rebalances
         self.ship_bytes_total = 0     # dense KV payload moved (both kinds)
+        self.rolling_swaps_total = 0  # completed rolling_swap deploys
         self._shipments: dict[str, dict] = {}  # ship_id -> in-flight entry
         # disaggregation: prefill-role engines hand each finished prefill's
         # KV blocks to the router for placement on a decode replica
@@ -314,7 +336,7 @@ class Router:
         user_on_token = spec.pop("on_token", None)
         t0 = time.perf_counter()
         with self._lock:
-            replica = self._pick(sticky_key)
+            replica = self._pick(sticky_key, spec.get("adapter_id"))
             if replica is None:
                 raise QueueFull("no usable replica (all draining/dead)")
             rr = _Routed(spec, user_on_token, sticky_key, None, replica)
@@ -334,12 +356,18 @@ class Router:
                        replica=replica.id, queue_depth=qd)
         return RouterHandle(self, rr)
 
-    def _pick(self, sticky_key: Optional[str]) -> Optional[Replica]:
+    def _pick(self, sticky_key: Optional[str],
+              adapter_id: Optional[str] = None) -> Optional[Replica]:
         """Least-loaded usable replica (router lock held).
 
         Phase routing: a new (or resubmitted) request starts with its
         prefill, so decode-specialized replicas are a last resort — they
-        only take fresh work when no prefill-capable replica is usable."""
+        only take fresh work when no prefill-capable replica is usable.
+
+        Adapter affinity is a *tiebreak*, slotted between health and
+        load: a replica with the request's adapter already arena-
+        resident skips a LoRA install, but never at the cost of routing
+        to an unhealthy replica or one materially more loaded."""
         usable = [r for r in self.replicas
                   if not r.draining and r.alive()]
         front = [r for r in usable if r.role != "decode"]
@@ -354,13 +382,14 @@ class Router:
                     return r
         burn = self.config.slo_max_burn
         return min(usable,
-                   key=lambda r: (not r.healthy(burn),) + r.load())
+                   key=lambda r: (not r.healthy(burn),
+                                  _no_affinity(r, adapter_id)) + r.load())
 
-    def _pick_decode(self,
-                     exclude: Optional[Replica] = None) -> Optional[Replica]:
+    def _pick_decode(self, exclude: Optional[Replica] = None,
+                     adapter_id: Optional[str] = None) -> Optional[Replica]:
         """Least-loaded usable decode-capable replica for a KV shipment
         (router lock held); prefill-specialized replicas never receive
-        shipments."""
+        shipments.  Same adapter-affinity tiebreak as ``_pick``."""
         usable = [r for r in self.replicas
                   if not r.draining and r.alive() and r is not exclude
                   and r.role != "prefill"]
@@ -368,7 +397,8 @@ class Router:
             return None
         burn = self.config.slo_max_burn
         return min(usable,
-                   key=lambda r: (not r.healthy(burn),) + r.load())
+                   key=lambda r: (not r.healthy(burn),
+                                  _no_affinity(r, adapter_id)) + r.load())
 
     # -- completion / failover --------------------------------------------
 
@@ -408,7 +438,7 @@ class Router:
         if rr.resubmits >= self.config.max_resubmits:
             self._fail(rr, f"{why}; resubmit budget exhausted")
             return
-        target = self._pick(None)
+        target = self._pick(None, rr.spec.get("adapter_id"))
         if target is None or target.id == old_replica:
             target = next((r for r in self.replicas
                            if r.id != old_replica and not r.draining
@@ -509,6 +539,104 @@ class Router:
                 return r
         raise KeyError(f"unknown replica {replica_id!r}")
 
+    # -- multi-tenant LoRA + live weight swap ------------------------------
+
+    def register_adapter(self, adapter_id: str, adapter) -> None:
+        """Register a LoRA adapter on every replica's registry (each
+        replica owns a ``clone()`` — see ``build_cluster``), so a
+        request naming it is routable anywhere.  Raises ``ValueError``
+        when the cluster was built without adapter support."""
+        n = 0
+        for r in self.replicas:
+            reg = r.engine.adapters
+            if reg is not None:
+                reg.register(adapter_id, adapter)
+                n += 1
+        if n == 0:
+            raise ValueError(
+                "no replica carries an adapter registry; build the "
+                "cluster with adapters=AdapterRegistry(...)")
+
+    def rolling_swap(self, new_params,
+                     timeout: Optional[float] = None) -> dict:
+        """Zero-downtime base-weight deploy: swap ``new_params`` into
+        every live replica, one at a time, while its siblings serve.
+
+        Per replica: (1) stop routing new work to it and pull its
+        queued (not-yet-started) requests onto siblings — the same
+        atomic ``queue.remove`` handoff as ``drain_replica``; (2)
+        live-migrate its in-flight decodes away (``migrate_request``:
+        KV blocks and the live request object move together, so client
+        streams stay bitwise-continuous — no replay suppression); (3)
+        ``engine.swap_params`` — anything that could not move
+        (mid-prefill, or no usable sibling) rides through the
+        iteration-boundary fence in place without losing a token; (4)
+        undrain.  With N ≥ 2 replicas, N−1 are serving at every
+        instant; with N == 1 this degrades to a plain in-place
+        ``swap_params`` (still token-lossless, but briefly stalls
+        admission on that replica).
+
+        ``new_params`` must match each replica's resident tree in
+        structure/shapes/dtypes (``swap_params`` validates before
+        touching anything; zero recompiles by construction).  For
+        tp-sharded replicas pass a tree laid out like the resident
+        params — jit re-lays a mismatched sharding at a one-time
+        transfer cost, never a correctness cost.  Returns a per-replica
+        report dict; an engine ``ValueError`` (tree mismatch)
+        propagates with the offending replica undrained and untouched.
+        """
+        self.start()
+        timeout = (self.config.drain_timeout_s
+                   if timeout is None else timeout)
+        report: dict = {"replicas": [], "requeued": 0, "migrated": 0}
+        for r in self.replicas:
+            if r.dead or not r.alive():
+                continue
+            t0 = time.perf_counter()
+            with self._lock:
+                siblings = [x for x in self.replicas
+                            if x is not r and not x.draining and x.alive()]
+                r.draining = True
+                moved = []
+                if siblings:
+                    # queued requests hop now rather than wait out the
+                    # fence; _failover replays nothing (0 delivered)
+                    for rr in list(self._pending.values()):
+                        if (rr.replica is r
+                                and r.engine.queue.remove(rr.handle._req)):
+                            moved.append(rr)
+                    for rr in moved:
+                        self._failover(rr, f"{r.id} rolling swap")
+                active = ([rr for rr in self._pending.values()
+                           if rr.replica is r and not rr.done_event.is_set()]
+                          if siblings else [])
+            migrated = 0
+            for rr in active:
+                # False (mid-prefill / just finished / dest refused) is
+                # fine: the request rides through the swap fence at home
+                if self.migrate_request(RouterHandle(self, rr),
+                                        timeout=timeout):
+                    migrated += 1
+            try:
+                r.engine.swap_params(new_params)
+            finally:
+                r.draining = False
+            report["replicas"].append({"replica": r.id,
+                                       "requeued": len(moved),
+                                       "migrated": migrated})
+            report["requeued"] += len(moved)
+            report["migrated"] += migrated
+            self.trace.add("swap", t0, time.perf_counter(),
+                           args={"replica": r.id, "requeued": len(moved),
+                                 "migrated": migrated})
+            EVENT_LOG.emit("router", "replica_swapped", replica=r.id,
+                           requeued=len(moved), migrated=migrated)
+        with self._lock:
+            self.rolling_swaps_total += 1
+        EVENT_LOG.emit("router", "rolling_swap_done",
+                       replicas=len(report["replicas"]))
+        return report
+
     # -- KV-block shipping: prefill handoff + live migration ---------------
 
     def _dispatch_shipment(self, ship: KVShipment, src: Replica) -> None:
@@ -525,7 +653,8 @@ class Router:
         t0 = time.perf_counter()
         req = ship.meta["req"]
         with self._lock:
-            target = self._pick_decode(exclude=src)
+            target = self._pick_decode(exclude=src,
+                                       adapter_id=ship.meta.get("adapter_id"))
             if target is not None:
                 self._shipments[ship.ship_id] = {
                     "ship_id": ship.ship_id, "kind": "prefill_handoff",
@@ -592,7 +721,9 @@ class Router:
         with self._lock:
             dst = (self._replica(to_replica_id)
                    if to_replica_id is not None
-                   else self._pick_decode(exclude=src))
+                   else self._pick_decode(
+                       exclude=src,
+                       adapter_id=rr.spec.get("adapter_id")))
         if dst is None or dst is src or dst.draining or not dst.alive():
             return False
         req = rr.handle._req
@@ -700,6 +831,7 @@ class Router:
                 "ships_total": self.ships_total,
                 "migrations_total": self.migrations_total,
                 "ship_bytes_total": self.ship_bytes_total,
+                "rolling_swaps_total": self.rolling_swaps_total,
                 "pending": len(self._pending),
                 "sticky_keys": len(self._sticky),
             },
@@ -771,6 +903,9 @@ class _RouterMetrics:
                          ).add(r.ships_total),
             MetricFamily("cluster_migrations_total", "counter",
                          "live decode migrations").add(r.migrations_total),
+            MetricFamily("cluster_rolling_swaps_total", "counter",
+                         "completed rolling weight-swap deploys"
+                         ).add(r.rolling_swaps_total),
             MetricFamily("cluster_ship_bytes_total", "counter",
                          "dense KV bytes shipped between replicas"
                          ).add(r.ship_bytes_total),

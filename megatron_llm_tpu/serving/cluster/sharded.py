@@ -26,6 +26,7 @@ from typing import Optional, Sequence
 import jax
 
 from ...config import ModelConfig, ParallelConfig
+from ..adapters.registry import AdapterRegistry
 from ..engine import EngineConfig, ServingEngine
 from ..metrics import ServingMetrics
 
@@ -52,6 +53,7 @@ def build_sharded_engine(cfg: ModelConfig, params,
                          metrics: Optional[ServingMetrics] = None,
                          draft_cfg: Optional[ModelConfig] = None,
                          draft_params=None,
+                         adapters: Optional[AdapterRegistry] = None,
                          ) -> ServingEngine:
     """One engine over one submesh.
 
@@ -61,6 +63,11 @@ def build_sharded_engine(cfg: ModelConfig, params,
     if any) follow with their own config's specs.  With pp·tp == 1 and
     no explicit devices this returns the ordinary single-chip engine
     (mesh=None) so the fused single-device kernels stay eligible.
+
+    ``adapters`` (multi-tenant LoRA registry) is handed to the engine
+    as-is; the arenas are tiny (rank · hidden per slot per target) and
+    jit re-lays them onto the submesh at first use, so no explicit
+    reshard pass is needed.
     """
     from ...parallel import mesh as mesh_lib
 
@@ -69,7 +76,7 @@ def build_sharded_engine(cfg: ModelConfig, params,
     if tp_eff == 1 and devices is None:
         return ServingEngine(cfg, params, engine_config, metrics=metrics,
                              draft_cfg=draft_cfg,
-                             draft_params=draft_params)
+                             draft_params=draft_params, adapters=adapters)
     assert cfg.num_attention_heads % tp_eff == 0, (
         f"serving re-layout shards heads over pp·tp = {tp_eff}, which "
         f"must divide num_attention_heads = {cfg.num_attention_heads}")
@@ -85,7 +92,7 @@ def build_sharded_engine(cfg: ModelConfig, params,
                                         mesh))
     return ServingEngine(cfg, sharded, engine_config, metrics=metrics,
                          mesh=mesh, draft_cfg=draft_cfg,
-                         draft_params=sharded_draft)
+                         draft_params=sharded_draft, adapters=adapters)
 
 
 def build_cluster(cfg: ModelConfig, params,
@@ -95,13 +102,20 @@ def build_cluster(cfg: ModelConfig, params,
                   router_config=None,
                   devices: Optional[Sequence[jax.Device]] = None,
                   draft_cfg: Optional[ModelConfig] = None,
-                  draft_params=None):
+                  draft_params=None,
+                  adapters: Optional[AdapterRegistry] = None):
     """N sharded engine replicas on disjoint device slices behind one
     :class:`~..cluster.router.Router`.
 
     Replica metrics are constructed with ``register=False`` so they
     don't fight over the process-wide ``"serving"`` collector; the
     router registers one ``"cluster"`` collector aggregating them.
+
+    An ``adapters`` registry is ``clone()``d per replica — arena slots
+    and pin counts are scheduler-thread state and must stay replica-
+    local, while the host-side adapter store is shared by reference.
+    Adapters registered *after* the cluster is built go through
+    ``Router.register_adapter`` so every replica sees them.
     """
     from ...parallel import mesh as mesh_lib
     from .router import Router, RouterConfig
@@ -117,7 +131,8 @@ def build_cluster(cfg: ModelConfig, params,
             cfg, params, engine_config,
             metrics=ServingMetrics(engine_config.max_batch_size,
                                    register=False),
-            draft_cfg=draft_cfg, draft_params=draft_params))
+            draft_cfg=draft_cfg, draft_params=draft_params,
+            adapters=adapters))
     else:
         meshes = mesh_lib.replica_submeshes(parallel, replicas,
                                             devices=devices)
@@ -127,7 +142,8 @@ def build_cluster(cfg: ModelConfig, params,
                 devices=mesh.devices.flatten().tolist(),
                 metrics=ServingMetrics(engine_config.max_batch_size,
                                        register=False),
-                draft_cfg=draft_cfg, draft_params=draft_params))
+                draft_cfg=draft_cfg, draft_params=draft_params,
+                adapters=None if adapters is None else adapters.clone()))
     return Router(engines, router_config or RouterConfig())
 
 
@@ -139,7 +155,8 @@ def build_disagg_cluster(cfg: ModelConfig, params,
                          router_config=None,
                          devices: Optional[Sequence[jax.Device]] = None,
                          draft_cfg: Optional[ModelConfig] = None,
-                         draft_params=None):
+                         draft_params=None,
+                         adapters: Optional[AdapterRegistry] = None):
     """Disaggregated prefill/decode cluster: ``prefill_replicas``
     prefill-specialized engines + ``decode_replicas`` decode engines on
     disjoint device slices behind one phase-routing Router
@@ -161,6 +178,11 @@ def build_disagg_cluster(cfg: ModelConfig, params,
     prefill entirely and the adopting decode replica rebuilds the draft
     KV from the shipped request's tokens — a shipment carries no draft
     state.
+
+    An ``adapters`` registry is cloned per replica (see
+    ``build_cluster``); a shipment carries only the request's
+    ``adapter_id``, and the adopting decode replica re-pins the adapter
+    out of its own clone at install.
     """
     import dataclasses as _dc
 
@@ -191,5 +213,6 @@ def build_disagg_cluster(cfg: ModelConfig, params,
             prefill_cfg if is_prefill else cfg, params, ec, parallel,
             devices=mesh.devices.flatten().tolist(),
             metrics=ServingMetrics(ec.max_batch_size, register=False),
-            draft_cfg=draft_cfg, draft_params=draft_params))
+            draft_cfg=draft_cfg, draft_params=draft_params,
+            adapters=None if adapters is None else adapters.clone()))
     return Router(engines, router_config or RouterConfig())

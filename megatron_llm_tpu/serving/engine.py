@@ -97,6 +97,20 @@ Greedy requests reproduce the one-shot ``generation.generate_tokens``
 trajectory token-for-token (tested bitwise on CPU fp32, the same
 equivalence bar the PLD path meets), pipelined or not, speculative or
 not.
+
+**Multi-tenant LoRA** (``serving/adapters/``): requests may name an
+``adapter_id`` and the engine serves them against one shared base model
+plus a device-resident stacked LoRA arena.  Admission pins the adapter
+in the arena (parking at the queue head when every arena slot is pinned,
+the same FIFO backpressure as KV-pool pressure); every jitted step takes
+the arena plus a per-row arena-slot vector and builds the one-hot rank
+mask INSIDE the jit, so different adapters coexist per-row in one decode
+batch with ONE compiled executable however many adapters rotate through.
+Base requests ride with slot -1 (an exactly-zero delta).  Prefix-cache
+blocks never cross tenants: adapter requests skip both match and offer,
+since their K/V rows differ from the base model's.  ``swap_params``
+replaces the base weights at an iteration boundary for zero-downtime
+deploys (the router rolls it replica by replica).
 """
 
 from __future__ import annotations
@@ -118,6 +132,8 @@ from ..generation.sampling import NEG_INF
 from ..models import model as model_lib
 from ..obs.logging import EVENT_LOG
 from ..obs.trace import TraceRecorder, device_annotation
+from ..ops.lora import arena_sr, slot_mask
+from .adapters.registry import AdapterRegistry
 from .block_pool import BlockPool
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
@@ -222,6 +238,22 @@ class EngineConfig:
     #                               Costs one host pass over the slot
     #                               tables per iteration — tests/debug
     #                               only, default off.
+    adapter_cache_slots: int = 0  # multi-tenant LoRA (serving/adapters/):
+    #                               device-resident arena slots the
+    #                               engine's AdapterRegistry may hold at
+    #                               once.  Any number of adapters can be
+    #                               registered host-side; residency is
+    #                               LRU with ref pinning (an adapter is
+    #                               pinned while any KV slot serves it,
+    #                               unpinned residents evict on demand).
+    #                               When every arena slot is pinned,
+    #                               admission parks the request at the
+    #                               queue head — the same FIFO
+    #                               backpressure as KV-pool pressure.
+    #                               0 = no adapter serving (the registry,
+    #                               if any, sizes itself).  Must match
+    #                               the registry's n_slots when both are
+    #                               set.
     role: str = "mixed"           # disaggregated prefill/decode
     #                               (docs/serving.md): "prefill" runs a
     #                               request's prefill + first token, then
@@ -281,7 +313,8 @@ class _Request:
                  top_p: float = 0.0, seed: Optional[int] = None,
                  use_eos_stop: bool = True, return_logprobs: bool = False,
                  on_token: Optional[Callable[[int], None]] = None,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 adapter_id: Optional[str] = None):
         self.id = next(self._ids)
         self.rid = f"req-{self.id}"  # correlation id: every log line and
         #                              trace span of this request carries it
@@ -298,6 +331,9 @@ class _Request:
         self.use_eos_stop = bool(use_eos_stop)
         self.return_logprobs = bool(return_logprobs)
         self.on_token = on_token
+        # multi-tenant LoRA: which registered adapter decorates the base
+        # model for this request; None = the base model alone
+        self.adapter_id = adapter_id
 
         self.generated: List[int] = []
         self.logprobs: List[float] = []
@@ -401,21 +437,39 @@ def _sample_slots(logits, seeds, counters, greedy, temps, top_ks, top_ps,
     return tok, tok_lp
 
 
+def _lora_operand(arenas, slots, rank: int):
+    """Arena + per-row arena-slot vector -> the ``(arenas, mask)`` pair
+    the model layer consumes.  The one-hot rank mask is built INSIDE the
+    jitted step from the tiny ``[S]`` int32 slot vector, so the host
+    never materializes per-request factor tensors (tpulint R8) and the
+    step stays one compiled executable as adapters churn — slot -1 rows
+    (base-model requests, free slots) get an all-zero mask and therefore
+    an exactly-zero delta."""
+    if rank == 0 or arenas is None:
+        return None
+    n_slots = arena_sr(arenas) // rank
+    return arenas, slot_mask(slots, n_slots, rank)
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("cfg", "max_seq_len", "want_logprobs"))
-def _prefill_impl(cfg: ModelConfig, params, tokens, length, *,
-                  max_seq_len: int, want_logprobs: bool):
+                   static_argnames=("cfg", "max_seq_len", "want_logprobs",
+                                    "lora_rank"))
+def _prefill_impl(cfg: ModelConfig, params, tokens, length,
+                  lora_arenas=None, lora_slots=None, *,
+                  max_seq_len: int, want_logprobs: bool,
+                  lora_rank: int = 0):
     """Prefill one request (batch 1, possibly bucket-padded prompt) into a
     fresh batch-1 cache.  Rows past ``length`` hold pad-token K/V, but the
     slot's fill level masks them and committed tokens overwrite them in
     order before the fill ever reaches them (the PLD ragged-prefill
     argument, generation/speculative.py)."""
     rope = model_lib.rope_tables(cfg)
+    lora = _lora_operand(lora_arenas, lora_slots, lora_rank)
     k, v = model_lib.init_kv_cache(cfg, 1, max_seq_len)
     if want_logprobs:
         logits, k, v = model_lib.forward_cached(
             cfg, params, tokens, k, v, jnp.int32(0), rope=rope,
-            empty_cache=True)
+            empty_cache=True, lora=lora)
         lp = jax.nn.log_softmax(logits, axis=-1)
         picked = jnp.take_along_axis(
             lp[:, :-1], tokens[:, 1:, None], axis=-1)[..., 0]  # [1, L-1]
@@ -424,7 +478,7 @@ def _prefill_impl(cfg: ModelConfig, params, tokens, length, *,
         return last, picked, k, v
     logits, k, v = model_lib.forward_cached(
         cfg, params, tokens, k, v, jnp.int32(0), rope=rope,
-        empty_cache=True, logit_rows=length - 1)
+        empty_cache=True, logit_rows=length - 1, lora=lora)
     return logits[:, 0], None, k, v
 
 
@@ -436,8 +490,9 @@ def _first_token_impl(cfg: ModelConfig, last_logits, seeds, counters,
 
 
 def _decode_impl(cfg: ModelConfig, params, k_pool, v_pool, tables, pending,
-                 fills, seeds, counters, greedy, temps, top_ks, top_ps, *,
-                 use_fused: bool):
+                 fills, seeds, counters, greedy, temps, top_ks, top_ps,
+                 lora_arenas=None, lora_slots=None, *,
+                 use_fused: bool, lora_rank: int = 0):
     """One batched decode step over every slot: feed each slot's pending
     token at its own fill position, scatter its K/V row into the pool
     block its table names, sample the next token per slot.  Free slots
@@ -448,22 +503,24 @@ def _decode_impl(cfg: ModelConfig, params, k_pool, v_pool, tables, pending,
     rope = model_lib.rope_tables(cfg)
     logits, k_pool, v_pool = model_lib.forward_cached_paged(
         cfg, params, pending[:, None], k_pool, v_pool, tables, fills,
-        rope=rope, use_fused=use_fused)
+        rope=rope, use_fused=use_fused,
+        lora=_lora_operand(lora_arenas, lora_slots, lora_rank))
     tok, tok_lp = _sample_slots(logits[:, 0], seeds, counters, greedy,
                                 temps, top_ks, top_ps, cfg.vocab_size)
     return tok, tok_lp, k_pool, v_pool
 
 
 _decode_donated = functools.partial(
-    jax.jit, static_argnames=("cfg", "use_fused"),
+    jax.jit, static_argnames=("cfg", "use_fused", "lora_rank"),
     donate_argnums=(2, 3))(_decode_impl)
 _decode_plain = functools.partial(
-    jax.jit, static_argnames=("cfg", "use_fused"))(_decode_impl)
+    jax.jit, static_argnames=("cfg", "use_fused", "lora_rank"))(_decode_impl)
 
 
 def _verify_impl(cfg: ModelConfig, params, k_pool, v_pool, tables, window,
                  fills, bids, offs, seeds, counters, greedy, temps, top_ks,
-                 top_ps, *, use_fused: bool):
+                 top_ps, lora_arenas=None, lora_slots=None, *,
+                 use_fused: bool, lora_rank: int = 0):
     """One speculative verify step over every slot: feed each slot's
     ``[pending, draft...]`` window at its own fill positions and score
     ALL window positions in one forward
@@ -478,7 +535,8 @@ def _verify_impl(cfg: ModelConfig, params, k_pool, v_pool, tables, window,
     rope = model_lib.rope_tables(cfg)
     logits, k_pool, v_pool = model_lib.forward_cached_paged_verify(
         cfg, params, window, k_pool, v_pool, tables, fills, bids, offs,
-        rope=rope, use_fused=use_fused)
+        rope=rope, use_fused=use_fused,
+        lora=_lora_operand(lora_arenas, lora_slots, lora_rank))
     tok0, tok0_lp = _sample_slots(logits[:, 0], seeds, counters, greedy,
                                   temps, top_ks, top_ps, cfg.vocab_size)
     V = logits.shape[-1]
@@ -493,16 +551,17 @@ def _verify_impl(cfg: ModelConfig, params, k_pool, v_pool, tables, window,
 
 
 _verify_donated = functools.partial(
-    jax.jit, static_argnames=("cfg", "use_fused"),
+    jax.jit, static_argnames=("cfg", "use_fused", "lora_rank"),
     donate_argnums=(2, 3))(_verify_impl)
 _verify_plain = functools.partial(
-    jax.jit, static_argnames=("cfg", "use_fused"))(_verify_impl)
+    jax.jit, static_argnames=("cfg", "use_fused", "lora_rank"))(_verify_impl)
 
 
 def _verify_tree_impl(cfg: ModelConfig, params, k_pool, v_pool, tables,
                       window, depths, anc, fills, bids, offs, seeds,
-                      counters, greedy, temps, top_ks, top_ps, *,
-                      use_fused: bool):
+                      counters, greedy, temps, top_ks, top_ps,
+                      lora_arenas=None, lora_slots=None, *,
+                      use_fused: bool, lora_rank: int = 0):
     """Tree-verify twin of ``_verify_impl``: the window columns are the
     nodes of a per-slot candidate tree (``depths``/``anc``, see
     forward_cached_paged_verify) instead of a linear run, so one forward
@@ -517,7 +576,8 @@ def _verify_tree_impl(cfg: ModelConfig, params, k_pool, v_pool, tables,
     rope = model_lib.rope_tables(cfg)
     logits, k_pool, v_pool = model_lib.forward_cached_paged_verify(
         cfg, params, window, k_pool, v_pool, tables, fills, bids, offs,
-        rope=rope, use_fused=use_fused, tree=(depths, anc))
+        rope=rope, use_fused=use_fused, tree=(depths, anc),
+        lora=_lora_operand(lora_arenas, lora_slots, lora_rank))
     tok0, tok0_lp = _sample_slots(logits[:, 0], seeds, counters, greedy,
                                   temps, top_ks, top_ps, cfg.vocab_size)
     V = logits.shape[-1]
@@ -532,10 +592,11 @@ def _verify_tree_impl(cfg: ModelConfig, params, k_pool, v_pool, tables,
 
 
 _verify_tree_donated = functools.partial(
-    jax.jit, static_argnames=("cfg", "use_fused"),
+    jax.jit, static_argnames=("cfg", "use_fused", "lora_rank"),
     donate_argnums=(2, 3))(_verify_tree_impl)
 _verify_tree_plain = functools.partial(
-    jax.jit, static_argnames=("cfg", "use_fused"))(_verify_tree_impl)
+    jax.jit, static_argnames=("cfg", "use_fused", "lora_rank"))(
+        _verify_tree_impl)
 
 
 # number of candidate branches the resident draft model surfaces per
@@ -671,8 +732,9 @@ def _merge_pending(tok, mask, vals):
 
 
 def _prefill_chunk_impl(cfg: ModelConfig, params, tokens, off, logit_row,
-                        k_small, v_small, *, max_seq_len: int, first: bool,
-                        last: bool):
+                        k_small, v_small, lora_arenas=None,
+                        lora_slots=None, *, max_seq_len: int, first: bool,
+                        last: bool, lora_rank: int = 0):
     """One bounded chunk of a chunked prefill (batch 1, fixed chunk width).
 
     ``off`` is the chunk's start position; the batch-1 cache is created on
@@ -687,16 +749,19 @@ def _prefill_chunk_impl(cfg: ModelConfig, params, tokens, off, logit_row,
     logits, k_small, v_small = model_lib.forward_cached(
         cfg, params, tokens, k_small, v_small, off, rope=rope,
         empty_cache=first,
+        lora=_lora_operand(lora_arenas, lora_slots, lora_rank),
         **(dict(logit_rows=logit_row) if last
            else dict(last_logit_only=True)))
     return logits[:, 0], k_small, v_small
 
 
 _prefill_chunk_donated = functools.partial(
-    jax.jit, static_argnames=("cfg", "max_seq_len", "first", "last"),
+    jax.jit, static_argnames=("cfg", "max_seq_len", "first", "last",
+                              "lora_rank"),
     donate_argnums=(5, 6))(_prefill_chunk_impl)
 _prefill_chunk_plain = functools.partial(
-    jax.jit, static_argnames=("cfg", "max_seq_len", "first", "last"))(
+    jax.jit, static_argnames=("cfg", "max_seq_len", "first", "last",
+                              "lora_rank"))(
         _prefill_chunk_impl)
 
 
@@ -735,6 +800,12 @@ class _SlotState:
         #                           carried no draft — drives the
         #                           periodic re-probe once the budget
         #                           collapses to zero
+        self.adapter_slot = -1    # LoRA arena slot serving this request
+        #                           (-1 = base model; the per-row mask
+        #                           the jitted steps build from it zeroes
+        #                           the delta exactly).  The registry pin
+        #                           under this slot is held until
+        #                           retirement / extraction.
         self.draft_fill = 0       # rows of this slot's context absorbed
         #                           into the resident draft model's
         #                           shadow KV pool (<= fill + 1; 0 when
@@ -775,6 +846,7 @@ class _PrefillState:
         self.k_small = None       # batch-1 cache, created on chunk 0
         self.v_small = None
         self.lease = None         # PrefixLease behind a pre-advanced done
+        self.adapter_slot = -1    # pinned LoRA arena slot (-1 = base)
 
 
 class ServingEngine:
@@ -789,7 +861,8 @@ class ServingEngine:
                  engine_config: Optional[EngineConfig] = None,
                  metrics: Optional[ServingMetrics] = None,
                  mesh=None, draft_cfg: Optional[ModelConfig] = None,
-                 draft_params=None):
+                 draft_params=None,
+                 adapters: Optional[AdapterRegistry] = None):
         self.cfg = cfg
         self.params = params
         # Resident draft model (speculative decoding beyond prompt
@@ -818,6 +891,25 @@ class ServingEngine:
         assert self.config.max_seq_len <= cfg.max_position_embeddings, (
             f"max_seq_len {self.config.max_seq_len} exceeds the model's "
             f"max_position_embeddings {cfg.max_position_embeddings}")
+        # Multi-tenant LoRA (serving/adapters/): the registry owns the
+        # device arena; the engine pins adapters at admission and threads
+        # the arena + a per-row slot vector through every jitted step.
+        self.adapters = adapters
+        if self.config.adapter_cache_slots and adapters is None:
+            raise ValueError(
+                "EngineConfig.adapter_cache_slots is set but no "
+                "AdapterRegistry was passed to the engine")
+        if (adapters is not None and self.config.adapter_cache_slots
+                and adapters.n_slots != self.config.adapter_cache_slots):
+            raise ValueError(
+                f"AdapterRegistry has {adapters.n_slots} arena slots but "
+                f"EngineConfig.adapter_cache_slots="
+                f"{self.config.adapter_cache_slots}")
+        if adapters is not None and adapters._metrics is None:
+            # late-bound: the engine (and bench harness) swaps its
+            # metrics object between warmup and measurement
+            adapters._metrics = lambda: self.metrics
+        self._lora_rank = 0 if adapters is None else adapters.rank
         # sanitizer resolution comes first so every lock/condition the
         # engine (and its queue) creates below is order-tracked
         self._sanitize = bool(self.config.sanitize) or sanitizers.env_enabled()
@@ -935,10 +1027,16 @@ class ServingEngine:
                 from ..ops.quant import precision_route
                 self._precision_route = precision_route(self.params)
                 from ..kernels.decode_step import fused_paged_decode_eligible
+                # adapter arenas ride inside the fused kernels as an
+                # epilogue; the stacked rank participates in the VMEM
+                # budget and the predicate declines to fuse (the composed
+                # path still applies the adapter — never silently
+                # dropped) when it doesn't fit or isn't lane-aligned
+                lsr = 0 if self.adapters is None else self.adapters.sr
                 self._fused_decode = fused_paged_decode_eligible(
                     self.cfg, self.params, pool.k_pool,
                     cfg_e.max_batch_size, self.slots.table_blocks,
-                    jax.default_backend(), mesh=self.mesh)
+                    jax.default_backend(), mesh=self.mesh, lora_sr=lsr)
                 if cfg_e.spec_draft_len > 0:
                     from ..kernels.decode_step import (
                         fused_paged_verify_eligible)
@@ -950,7 +1048,8 @@ class ServingEngine:
                         self.cfg, self.params, pool.k_pool,
                         cfg_e.max_batch_size, cfg_e.spec_draft_len + 1,
                         self.slots.table_blocks, jax.default_backend(),
-                        mesh=self.mesh, tree=self._draft_enabled)
+                        mesh=self.mesh, tree=self._draft_enabled,
+                        lora_sr=lsr)
                 if self._draft_enabled:
                     # shadow paged pool for the draft model: SAME block
                     # count and block size as the target pool so the
@@ -1053,12 +1152,14 @@ class ServingEngine:
                top_p: float = 0.0, seed: Optional[int] = None,
                use_eos_stop: bool = True, return_logprobs: bool = False,
                on_token: Optional[Callable[[int], None]] = None,
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               adapter_id: Optional[str] = None) -> RequestHandle:
         return self.submit_many([dict(
             prompt=prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
             temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
             use_eos_stop=use_eos_stop, return_logprobs=return_logprobs,
-            on_token=on_token, deadline_s=deadline_s)])[0]
+            on_token=on_token, deadline_s=deadline_s,
+            adapter_id=adapter_id)])[0]
 
     def submit_many(self, specs: Sequence[dict]) -> List[RequestHandle]:
         """Validate + enqueue a batch of requests all-or-nothing.
@@ -1090,6 +1191,17 @@ class ServingEngine:
                     f"prompt ({len(req.prompt)} tokens) + max_new_tokens "
                     f"({req.max_new_tokens}) exceeds the per-slot sequence "
                     f"budget ({self.config.max_seq_len})")
+            if req.adapter_id is not None:
+                if self.adapters is None:
+                    self.metrics.inc("rejected_invalid")
+                    raise ValueError(
+                        f"request names adapter {req.adapter_id!r} but "
+                        "the engine has no adapter registry")
+                if not self.adapters.known(req.adapter_id):
+                    self.metrics.inc("rejected_invalid")
+                    raise ValueError(
+                        f"unknown adapter {req.adapter_id!r} (register "
+                        "it before submitting)")
             pool = self.slots.pool
             need = -(-(len(req.prompt) + req.max_new_tokens)
                      // pool.block_size)
@@ -1266,6 +1378,7 @@ class ServingEngine:
         if self.prefix_cache is not None:
             # unpin without offering: the slot holds a partial prefill
             self.prefix_cache.release(ps.lease)
+        self._release_adapter(ps.req)
         self.slots.release(ps.slot)
         self._finish(ps.req, reason)
         self.metrics.set_gauges(slots_active=self.slots.active_slots)
@@ -1312,6 +1425,33 @@ class ServingEngine:
             if pool.reserve(need):
                 return True
         return False
+
+    def _acquire_adapter(self, req: _Request) -> Optional[int]:
+        """Pin the request's adapter in the device arena.  Returns the
+        arena slot (-1 for base-model requests) or ``None`` when every
+        arena slot is pinned by other active requests — the caller parks
+        the request at the queue head, the same FIFO backpressure shape
+        as KV-pool pressure."""
+        if req.adapter_id is None:
+            return -1
+        return self.adapters.acquire(req.adapter_id)
+
+    def _release_adapter(self, req: _Request) -> None:
+        if req.adapter_id is not None and self.adapters is not None:
+            self.adapters.release(req.adapter_id)
+
+    def _lora_args(self, aslots) -> dict:
+        """Keyword operands threading the adapter arena + per-row arena-
+        slot vector into a jitted step.  Empty for base-only engines, so
+        their call signatures (and compiled executables) are untouched;
+        with a registry the operand SHAPES never change — only arena
+        contents and the tiny int vector — so steps stay one executable
+        as adapters churn."""
+        if self.adapters is None:
+            return {}
+        return dict(lora_arenas=self.adapters.arenas,
+                    lora_slots=jnp.asarray(np.asarray(aslots, np.int32)),
+                    lora_rank=self._lora_rank)
 
     def _next_admission(self) -> Optional[_Request]:
         """The next request to admit: the parked one first (FIFO order is
@@ -1378,8 +1518,16 @@ class ServingEngine:
         padded = min(-(-plen // chunk) * chunk, self.config.max_seq_len)
         slot = self.slots.alloc()
         assert slot is not None
+        aslot = self._acquire_adapter(req)
+        if aslot is None:
+            # arena fully pinned: park at the queue head (FIFO under
+            # adapter-cache pressure, same shape as pool pressure)
+            self.slots.release(slot)
+            self._held = req
+            return
         lease = None
-        if self.prefix_cache is not None:
+        # adapter K/V never crosses tenants: no prefix match, no offer
+        if self.prefix_cache is not None and req.adapter_id is None:
             t_pm = time.perf_counter()
             lease = self.prefix_cache.match_and_acquire(req.prompt)
             self.trace.add(
@@ -1395,12 +1543,14 @@ class ServingEngine:
             # retirements free blocks; nothing was allocated yet
             if self.prefix_cache is not None:
                 self.prefix_cache.release(lease)
+            self._release_adapter(req)
             self.slots.release(slot)
             self._held = req
             return
         self.slots.set_reservation(slot, need)
         ps = _PrefillState(req, slot, padded)
         ps.lease = lease
+        ps.adapter_slot = aslot
         if lease is not None:
             # prefix hit: gather the shared blocks into the batch-1
             # working cache (their pool blocks themselves are shared by
@@ -1434,7 +1584,8 @@ class ServingEngine:
                 jnp.asarray([len(req.prompt) - 1 - off], jnp.int32),
                 ps.k_small, ps.v_small,
                 max_seq_len=self.slots.width,
-                first=(off == 0), last=last)
+                first=(off == 0), last=last,
+                **self._lora_args([ps.adapter_slot]))
         ps.done = off + c
         self.metrics.inc("prefill_chunks")
         if not last:
@@ -1465,6 +1616,7 @@ class ServingEngine:
                        chunked=True)
         st = _SlotState(req, fill=len(req.prompt), pending=first_tok)
         st.lease = ps.lease
+        st.adapter_slot = ps.adapter_slot
         self._active[ps.slot] = st
         if self._draft_enabled and self.config.role != "prefill":
             self._draft_prefill(ps.slot, st)
@@ -1485,12 +1637,25 @@ class ServingEngine:
         request's worst-case block count."""
         slot = self.slots.alloc()
         assert slot is not None
+        aslot = self._acquire_adapter(req)
+        if aslot is None:
+            # every arena slot is pinned by an active request: park at
+            # the queue head and retry as retirements drop pins (nothing
+            # allocated yet — acquire pinned nothing on None)
+            self.slots.release(slot)
+            self._held = req
+            return False
         plen = len(req.prompt)
         bucket = max(1, self.config.prefill_bucket)
         # prompt-logprob requests need every prompt logit in one pass, so
-        # they always take the cold whole-prompt prefill
+        # they always take the cold whole-prompt prefill.  Adapter
+        # requests skip the prefix cache entirely (match AND offer):
+        # their K/V rows carry the adapter's wk/wv deltas, so sharing
+        # them with base-model (or other-adapter) requests would be
+        # numerically wrong in both directions.
         lease = None
-        if self.prefix_cache is not None and not req.return_logprobs:
+        if (self.prefix_cache is not None and not req.return_logprobs
+                and req.adapter_id is None):
             t_pm = time.perf_counter()
             lease = self.prefix_cache.match_and_acquire(req.prompt)
             self.trace.add(
@@ -1504,6 +1669,7 @@ class ServingEngine:
         if not self._try_reserve(need):
             if self.prefix_cache is not None:
                 self.prefix_cache.release(lease)
+            self._release_adapter(req)
             self.slots.release(slot)
             self._held = req
             return False
@@ -1532,7 +1698,7 @@ class ServingEngine:
                     jnp.int32(matched),
                     jnp.asarray([suffix - 1], jnp.int32), k_small, v_small,
                     max_seq_len=self.slots.width, first=False,
-                    last=True)
+                    last=True, **self._lora_args([aslot]))
         else:
             padded = -(-plen // bucket) * bucket
             padded = min(padded, self.config.max_seq_len)
@@ -1543,7 +1709,8 @@ class ServingEngine:
                     self.cfg, self.params, jnp.asarray(tokens),
                     jnp.asarray([plen], jnp.int32),
                     max_seq_len=self.slots.width,
-                    want_logprobs=req.return_logprobs)
+                    want_logprobs=req.return_logprobs,
+                    **self._lora_args([aslot]))
             if req.return_logprobs:
                 req.logprobs.extend(
                     np.asarray(picked)[0, :plen - 1].tolist())
@@ -1574,6 +1741,7 @@ class ServingEngine:
 
         st = _SlotState(req, fill=plen, pending=first)
         st.lease = lease
+        st.adapter_slot = aslot
         self._active[slot] = st
         if self._draft_enabled and self.config.role != "prefill":
             # prefill-role engines hand the slot off immediately; the
@@ -1879,6 +2047,7 @@ class ServingEngine:
         temps = np.ones((S,), np.float32)
         top_ks = np.zeros((S,), np.int32)
         top_ps = np.zeros((S,), np.float32)
+        aslots = np.full((S,), -1, np.int32)
         bids = np.zeros((S * W,), np.int32)  # default: the trash block
         offs = np.zeros((S * W,), np.int32)
         bk = self.slots.pool.block_size
@@ -1893,6 +2062,7 @@ class ServingEngine:
             temps[slot] = st.req.temperature
             top_ks[slot] = st.req.top_k
             top_ps[slot] = st.req.top_p
+            aslots[slot] = st.adapter_slot
             st.fresh = False
             # every window row that may commit needs its destination
             # block resolved (lazily allocated / COWed) BEFORE the
@@ -1921,7 +2091,8 @@ class ServingEngine:
                 jnp.asarray(seeds), jnp.asarray(counters),
                 jnp.asarray(greedy), jnp.asarray(temps),
                 jnp.asarray(top_ks), jnp.asarray(top_ps),
-                use_fused=self._fused_verify)
+                use_fused=self._fused_verify,
+                **self._lora_args(aslots))
         self.slots.set_pools(k_pool, v_pool)
         # tpulint: allow[host-sync] verify steps are synchronous by
         # design: the next dispatch's fill vector depends on how many
@@ -2056,6 +2227,7 @@ class ServingEngine:
         temps = np.ones((S,), np.float32)
         top_ks = np.zeros((S,), np.int32)
         top_ps = np.zeros((S,), np.float32)
+        aslots = np.full((S,), -1, np.int32)
         bids = np.zeros((S * W,), np.int32)  # default: the trash block
         offs = np.zeros((S * W,), np.int32)
         n_real = {}
@@ -2068,6 +2240,11 @@ class ServingEngine:
             temps[slot] = st.req.temperature
             top_ks[slot] = st.req.top_k
             top_ps[slot] = st.req.top_p
+            # the (base) draft model proposed this tree, but acceptance
+            # is judged under the REQUESTER's adapter: the target verify
+            # applies the slot's arena columns, so committed tokens are
+            # bitwise what adapter-decorated plain decode would emit
+            aslots[slot] = st.adapter_slot
             st.fresh = False
             # node list in BFS order (depths non-decreasing, parents
             # before children, deepest node last — the kernel's per-row
@@ -2122,7 +2299,8 @@ class ServingEngine:
                 jnp.asarray(seeds), jnp.asarray(counters),
                 jnp.asarray(greedy), jnp.asarray(temps),
                 jnp.asarray(top_ks), jnp.asarray(top_ps),
-                use_fused=self._fused_verify)
+                use_fused=self._fused_verify,
+                **self._lora_args(aslots))
         # tpulint: allow[host-sync] verify steps are synchronous by
         # design: the accepted path decides the next fill vector AND
         # whether rows must move, so there is nothing to overlap
@@ -2251,6 +2429,7 @@ class ServingEngine:
         temps = np.ones((S,), np.float32)
         top_ks = np.zeros((S,), np.int32)
         top_ps = np.zeros((S,), np.float32)
+        aslots = np.full((S,), -1, np.int32)  # -1 rows: zero LoRA delta
         for slot, st in self._active.items():
             fills[slot] = st.fill
             seeds[slot] = st.req.seed
@@ -2259,6 +2438,7 @@ class ServingEngine:
             temps[slot] = st.req.temperature
             top_ks[slot] = st.req.top_k
             top_ps[slot] = st.req.top_p
+            aslots[slot] = st.adapter_slot
             overrides[slot] = st.pending
             if st.fresh:
                 override_mask[slot] = True
@@ -2301,7 +2481,8 @@ class ServingEngine:
                 jnp.asarray(counters), jnp.asarray(greedy),
                 jnp.asarray(temps),
                 jnp.asarray(top_ks), jnp.asarray(top_ps),
-                use_fused=self._fused_decode)
+                use_fused=self._fused_decode,
+                **self._lora_args(aslots))
         self.slots.set_pools(k_pool, v_pool)
         try:  # start the host copy now so it overlaps the next dispatch
             tok.copy_to_host_async()
@@ -2397,11 +2578,16 @@ class ServingEngine:
             # donate the slot's block-aligned prompt prefix back (a pure
             # ref-count adoption of blocks the slot already owns) before
             # the slot releases them, then unpin the admission lease (so
-            # the request's own prefix blocks were protected throughout)
-            self.prefix_cache.offer(st.req.prompt, self.slots.tables[slot])
+            # the request's own prefix blocks were protected throughout).
+            # Adapter requests never offer: their K/V rows carry the
+            # adapter's deltas and must not seed base-model prefills.
+            if st.req.adapter_id is None:
+                self.prefix_cache.offer(st.req.prompt,
+                                        self.slots.tables[slot])
             self.prefix_cache.release(st.lease)
             self.metrics.set_gauges(
                 prefix_blocks=self.prefix_cache.blocks)
+        self._release_adapter(st.req)
         self.slots.release(slot)
         self._finish(st.req, reason)
         self._update_pool_gauges()
@@ -2510,6 +2696,11 @@ class ServingEngine:
         pool.begin_ship(ship_id, req.rid, bids, nbytes)
         if self.prefix_cache is not None:
             self.prefix_cache.release(st.lease)
+        # the destination re-pins the adapter at install (raising — so
+        # the router reinstalls here — when it can't); dropping our pin
+        # AFTER export is safe: eviction only reuses arena columns, the
+        # host-side factors stay registered
+        self._release_adapter(req)
         self.slots.release(slot)
         self._update_pool_gauges()
         self.metrics.set_gauges(slots_active=self.slots.active_slots)
@@ -2521,7 +2712,8 @@ class ServingEngine:
             meta={"req": req, "fill": st.fill, "count": st.count,
                   "pending": st.pending, "spec_ewma": st.spec_ewma,
                   "spec_stall": st.spec_stall,
-                  "draft_fill": st.draft_fill})
+                  "draft_fill": st.draft_fill,
+                  "adapter_id": req.adapter_id})
 
     def install_shipment(self, ship: KVShipment) -> int:
         """Adopt a shipment into a free slot of this engine.  Scheduler
@@ -2539,10 +2731,29 @@ class ServingEngine:
         slot = self.slots.alloc()
         if slot is None:
             raise RuntimeError("no free slot for shipment install")
+        # adapter requests need their adapter registered AND pinnable
+        # here; any failure raises so the router reinstalls at the
+        # source, whose registry still holds the factors
+        aslot = -1
+        if req.adapter_id is not None:
+            if self.adapters is None or not self.adapters.known(
+                    req.adapter_id):
+                self.slots.release(slot)
+                raise RuntimeError(
+                    f"shipment {ship.ship_id} needs adapter "
+                    f"{req.adapter_id!r}, not registered on this engine")
+            got = self.adapters.acquire(req.adapter_id)
+            if got is None:
+                self.slots.release(slot)
+                raise RuntimeError(
+                    f"adapter arena fully pinned; cannot install "
+                    f"shipment {ship.ship_id}")
+            aslot = got
         bk = pool.block_size
         total = -(-(len(req.prompt) + req.max_new_tokens) // bk)
         need = ship.n_live + max(0, total - ship.n_live)
         if not self._try_reserve(need):
+            self._release_adapter(req)
             self.slots.release(slot)
             raise RuntimeError(
                 f"pool cannot reserve {need} blocks for shipment install")
@@ -2564,6 +2775,7 @@ class ServingEngine:
         st.count = ship.meta["count"]
         st.spec_ewma = ship.meta["spec_ewma"]
         st.spec_stall = ship.meta["spec_stall"]
+        st.adapter_slot = aslot  # may differ from the source's arena slot
         st.fresh = True  # next dispatch feeds the host-known pending token
         self._active[slot] = st
         if self._draft_enabled and self.config.role != "prefill":
@@ -2580,3 +2792,53 @@ class ServingEngine:
             self._wake.notify_all()
         self.queue.notify()
         return slot
+
+    # -- live weight swap (zero-downtime deploys) --------------------------
+
+    def swap_params(self, new_params):
+        """Replace the base model weights at an iteration boundary and
+        return the old tree (double-buffered: the caller decides when to
+        drop it, so a rolling deploy can fall back instantly).
+
+        Runs on the scheduler thread between iterations via
+        ``call_in_scheduler``: the in-flight pipelined step — dispatched
+        against the OLD weights — is processed normally first, so no
+        sampled token is lost, duplicated, or recomputed; every later
+        step runs the new weights.  The tree must match the resident
+        params' structure/shapes/dtypes exactly, so every compiled
+        executable (and the fused-kernel eligibility resolved at
+        ``start()``) carries over with zero recompiles.  Adapter arenas
+        are untouched: LoRA factors compose with whichever base is
+        resident.  In-flight requests simply continue — mid-generation
+        tokens after the fence come from the new weights, which is the
+        semantics a weight deploy wants; callers needing whole-request
+        consistency drain or migrate first (router.rolling_swap).
+        Callable from any thread; before ``start()`` it swaps inline."""
+        try:
+            same = jax.tree.all(jax.tree.map(
+                lambda a, b: a.shape == b.shape and a.dtype == b.dtype,
+                self.params, new_params))
+        except ValueError:
+            same = False
+        if not same:
+            raise ValueError(
+                "swap_params needs a tree matching the resident params' "
+                "structure/shapes/dtypes (same executables, zero "
+                "recompiles); retrain/export with the serving layout")
+
+        def _swap():
+            self._flush_inflight()
+            old, self.params = self.params, new_params
+            from ..ops.quant import precision_route
+            # tpulint: allow[lock-discipline] scheduler thread only (via
+            # call_in_scheduler when the loop is live) — single-writer,
+            # same discipline as every other step-loop mutation
+            self._precision_route = precision_route(self.params)
+            self.metrics.inc("param_swaps")
+            EVENT_LOG.emit("engine", "param_swap",
+                           active_slots=len(self._active))
+            return old
+
+        if self._thread is None or not self._thread.is_alive():
+            return _swap()
+        return self.call_in_scheduler(_swap)

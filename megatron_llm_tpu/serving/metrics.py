@@ -136,6 +136,15 @@ _COUNTERS = (
     # so spec_steps flat-lining while decode_iterations climbs is the
     # policy working, not a bug.
     "spec_proposed", "spec_accepted", "spec_steps",
+    # multi-tenant LoRA (serving/adapters/): admissions whose adapter was
+    # already arena-resident vs installed cold, unpinned adapters evicted
+    # under the adapter_cache_slots budget, and arena column installs.
+    # A steady workload showing adapter_misses climbing means the live
+    # adapter set exceeds the arena (raise adapter_cache_slots).
+    "adapter_hits", "adapter_misses", "adapter_evictions",
+    "adapter_installs",
+    # live base-weight swap (engine.swap_params): completed swaps
+    "param_swaps",
     # disaggregated prefill/decode (serving/cluster/): KV-block shipments
     # this engine exported (prefill handoffs + migrations out) and
     # adopted (installs in).  On a prefill-role replica ships_out
@@ -196,6 +205,9 @@ class ServingMetrics:
         # generic; samples here are token counts, not seconds)
         self.prefix_hit_tokens = LatencyHistogram()
         self.prefix_blocks = 0   # gauge: blocks resident in the cache
+        # multi-tenant LoRA arena gauges (serving/adapters/registry.py)
+        self.adapter_resident = 0
+        self.adapter_resident_bytes = 0
         # tokens committed per participating slot per speculative verify
         # step (accepted draft prefix + the bonus token; samples are
         # token counts, not seconds)
@@ -244,7 +256,9 @@ class ServingMetrics:
                    blocks_free: Optional[int] = None,
                    blocks_used: Optional[int] = None,
                    kv_cache_util: Optional[float] = None,
-                   num_slots: Optional[int] = None) -> None:
+                   num_slots: Optional[int] = None,
+                   adapter_resident: Optional[int] = None,
+                   adapter_resident_bytes: Optional[int] = None) -> None:
         with self._lock:
             if num_slots is not None:
                 self.num_slots = num_slots
@@ -260,6 +274,10 @@ class ServingMetrics:
                 self.blocks_used = blocks_used
             if kv_cache_util is not None:
                 self.kv_cache_util = kv_cache_util
+            if adapter_resident is not None:
+                self.adapter_resident = adapter_resident
+            if adapter_resident_bytes is not None:
+                self.adapter_resident_bytes = adapter_resident_bytes
 
     def observe_decode_iteration(self, batch: int, seconds: float) -> None:
         """One scheduler decode step over ``batch`` active slots."""
@@ -357,6 +375,13 @@ class ServingMetrics:
                 "prefix_blocks": self.prefix_blocks,
                 "prefix_hit_tokens": self.prefix_hit_tokens.snapshot(
                     suffix=""),
+                # multi-tenant LoRA arena residency
+                "adapter_hit_rate": (
+                    self.counters["adapter_hits"]
+                    / max(1, self.counters["adapter_hits"]
+                          + self.counters["adapter_misses"])),
+                "adapter_resident": self.adapter_resident,
+                "adapter_resident_bytes": self.adapter_resident_bytes,
                 # paged KV pool occupancy
                 "blocks_free": self.blocks_free,
                 "blocks_used": self.blocks_used,
@@ -459,6 +484,17 @@ class ServingMetrics:
                     ("serving_prefix_hit_rate",
                      "prefix-cache admission hit rate",
                      hits / max(1, hits + misses)),
+                    ("serving_adapter_resident",
+                     "LoRA adapters resident in the arena",
+                     self.adapter_resident),
+                    ("serving_adapter_resident_bytes",
+                     "fp32 factor bytes resident in the LoRA arena",
+                     self.adapter_resident_bytes),
+                    ("serving_adapter_hit_rate",
+                     "adapter-cache admission hit rate",
+                     self.counters["adapter_hits"]
+                     / max(1, self.counters["adapter_hits"]
+                           + self.counters["adapter_misses"])),
                     ("serving_blocks_free",
                      "KV pool blocks on the free list", self.blocks_free),
                     ("serving_blocks_used",

@@ -568,6 +568,147 @@ CONVERTERS_TO_HF = {
 
 
 # ---------------------------------------------------------------------------
+# PEFT LoRA adapters (Llama family)
+# ---------------------------------------------------------------------------
+
+# PEFT module name → native projection target (ops/lora.py naming).
+_PEFT_TO_NATIVE = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+    "gate_proj": "w_gate",
+    "up_proj": "w_up",
+    "down_proj": "w_down",
+}
+
+
+def lora_from_peft(state_dict: Mapping[str, "Array"], peft_config: Mapping,
+                   cfg: ModelConfig):
+    """HF PEFT LoRA state dict → native :class:`~...ops.lora.LoRAAdapter`.
+
+    PEFT stores per layer ``lora_A.weight`` [r, in] / ``lora_B.weight``
+    [out, r] against the HF base weights; the native epilogue computes
+    ``x @ A @ B`` against transposed weights, so both factors transpose
+    on the way in.  Q/K need one extra step: the HF base Q/K projections
+    live in rotate-half RoPE layout, and the delta must follow its base
+    — ``ΔW_hf = B_hf @ A_hf`` permutes only along the output dim, so the
+    inverse permutation lands entirely on ``lora_B`` (``A`` touches only
+    the input dim and passes through untouched).
+
+    Factors stay raw — ``α/r`` is recorded on the adapter and folded at
+    arena install, exactly as with natively-trained adapters.
+    """
+    from ..ops.lora import LoRAAdapter, lora_target_shapes, validate_adapter
+
+    for key, why in (
+            ("use_rslora", "rsLoRA scales by α/sqrt(r), not α/r"),
+            ("use_dora", "DoRA adds a magnitude vector the arena "
+                         "epilogue does not model")):
+        if peft_config.get(key):
+            raise ValueError(f"unsupported PEFT option {key}=True ({why})")
+    for key in ("rank_pattern", "alpha_pattern"):
+        if peft_config.get(key):
+            raise ValueError(
+                f"unsupported PEFT option {key!r}: per-module ranks/alphas "
+                "don't fit the single-rank arena layout")
+
+    rank = int(peft_config["r"])
+    alpha = float(peft_config.get("lora_alpha", rank))
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    nkv = cfg.kv_heads
+
+    # Key layout varies across PEFT versions:
+    #   base_model.model.model.layers.N.self_attn.q_proj.lora_A.weight
+    #   ...q_proj.lora_A.default.weight   (multi-adapter PEFT)
+    # Normalize to "model.layers.N.<module>.<proj>.lora_{A,B}.weight".
+    sd = {}
+    for k, v in state_dict.items():
+        k = k.removeprefix("base_model.model.")
+        k = k.replace(".lora_A.default.", ".lora_A.").replace(
+            ".lora_B.default.", ".lora_B.")
+        sd[k] = _np(v)
+
+    present = sorted({
+        proj for k in sd
+        for proj in _PEFT_TO_NATIVE
+        if f".{proj}.lora_" in k})
+    if not present:
+        raise ValueError(
+            "no recognized LoRA tensors in the PEFT state dict "
+            f"(looked for {sorted(_PEFT_TO_NATIVE)} modules)")
+    shapes = lora_target_shapes(cfg)
+    unknown = [p for p in present if _PEFT_TO_NATIVE[p] not in shapes]
+    if unknown:
+        raise ValueError(
+            f"PEFT adapter targets {unknown}, which this model config "
+            "does not have (non-GLU model with gate_proj?)")
+
+    factors = {}
+    for proj in present:
+        native = _PEFT_TO_NATIVE[proj]
+        fin, fout = shapes[native]
+        a_layers, b_layers = [], []
+        for i in range(cfg.num_layers):
+            base = f"model.layers.{i}." + (
+                "self_attn." if native in ("wq", "wk", "wv", "wo")
+                else "mlp.") + proj
+            try:
+                a_hf = sd[base + ".lora_A.weight"]
+                b_hf = sd[base + ".lora_B.weight"]
+            except KeyError as e:
+                raise ValueError(
+                    f"PEFT adapter is missing {e.args[0]!r}: partial-layer "
+                    "adapters (layers_to_transform) are not supported — "
+                    "the arena stacks every layer") from None
+            if a_hf.shape != (rank, fin) or b_hf.shape != (fout, rank):
+                raise ValueError(
+                    f"layer {i} {proj}: lora_A {a_hf.shape} / lora_B "
+                    f"{b_hf.shape} don't match rank={rank}, "
+                    f"in={fin}, out={fout}")
+            if native == "wq":
+                b_hf = hf_to_interleaved(b_hf, nq, d)
+            elif native == "wk":
+                b_hf = hf_to_interleaved(b_hf, nkv, d)
+            a_layers.append(a_hf.T)
+            b_layers.append(b_hf.T)
+        factors[native] = {
+            "a": np.stack(a_layers).astype(np.float32),
+            "b": np.stack(b_layers).astype(np.float32),
+        }
+
+    adapter = LoRAAdapter(rank=rank, alpha=alpha,
+                          targets=tuple(factors), factors=factors)
+    validate_adapter(cfg, adapter)
+    return adapter
+
+
+def load_peft_adapter(path: str, cfg: ModelConfig):
+    """Load a PEFT LoRA checkpoint directory (``adapter_config.json`` +
+    ``adapter_model.safetensors`` or ``adapter_model.bin``) as a native
+    adapter, ready for ``AdapterRegistry.register`` or
+    ``ops/lora.py:save_adapter``."""
+    import json
+    from pathlib import Path
+
+    root = Path(path)
+    with open(root / "adapter_config.json") as f:
+        peft_config = json.load(f)
+    st = root / "adapter_model.safetensors"
+    if st.exists():
+        from safetensors.numpy import load_file
+
+        state_dict = load_file(st)
+    else:
+        import torch
+
+        state_dict = torch.load(root / "adapter_model.bin",
+                                map_location="cpu", weights_only=True)
+    return lora_from_peft(state_dict, peft_config, cfg)
+
+
+# ---------------------------------------------------------------------------
 # Config derivation (reference: verify_correctness.py + finetune.py read the
 # arch hyperparameters from CLI args; here they come from the HF config)
 # ---------------------------------------------------------------------------

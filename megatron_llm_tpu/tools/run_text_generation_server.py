@@ -190,9 +190,13 @@ def main(argv=None) -> int:
                          "(models/families.py:draft_model)")
     ap.add_argument("--draft_load", default=None,
                     help="checkpoint directory for --draft_model; "
-                         "absent = random init (trajectories stay "
+                         "required unless --allow_random_draft is given")
+    ap.add_argument("--allow_random_draft", action="store_true",
+                    help="allow --draft_model without --draft_load: the "
+                         "draft runs RANDOM-INIT (trajectories stay "
                          "bitwise-correct — a bad draft only lowers the "
-                         "acceptance rate — but expect no speedup)")
+                         "acceptance rate — but expect no speedup; "
+                         "smoke-test escape hatch, refused otherwise)")
     ap.add_argument("--spec_reprobe_interval", type=int, default=None,
                     help="decode steps between speculation re-probes "
                          "after a slot's acceptance EWMA backs it off "
@@ -289,12 +293,20 @@ def main(argv=None) -> int:
         if args.draft_load:
             draft_params = load_params_for_inference(args.draft_load,
                                                      draft_cfg)
-        else:
+        elif args.allow_random_draft:
             draft_params = _model_lib.init_params(_jax.random.key(0),
                                                   draft_cfg)
             print("draft model: no --draft_load given — RANDOM INIT "
                   "(tokens stay bitwise-correct, but acceptance will "
                   "be near zero; load a trained draft for speedup)")
+        else:
+            # A random draft silently serves at a *loss* (every verify
+            # forward wasted); make that an explicit opt-in, not a
+            # default a typo'd --draft_load path can fall into.
+            ap.error("--draft_model without --draft_load would serve a "
+                     "random-init draft (near-zero acceptance, pure "
+                     "overhead); pass --draft_load CKPT, or "
+                     "--allow_random_draft for smoke tests")
 
     cluster = args.replicas > 1 or args.router or args.disagg is not None
     mesh_ctx = None

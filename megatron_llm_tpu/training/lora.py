@@ -1,0 +1,195 @@
+"""LoRA finetuning: train low-rank adapter factors against a frozen base.
+
+The serving side (``serving/adapters/``) consumes adapters produced
+here.  Training differentiates through the SAME epilogue the serving
+stack applies — ``ops/lora.py:lora_delta`` as a projection epilogue
+inside ``models/transformer.py`` — with a single-slot "arena" (Sr = r)
+and an all-ones mask, so a trained adapter's math is identical at
+serve time by construction, not by re-implementation.
+
+Only the A/B factor tree is trainable: the loss closes over the base
+params and ``jax.value_and_grad`` runs over the factors alone, so no
+base gradient, master copy, or optimizer moment is ever materialized —
+the whole optimizer state is O(rank · hidden · layers · targets).
+B is zero-init (``init_lora_adapter``), so step 0 reproduces the base
+model bitwise and training departs smoothly from it.
+
+Checkpoints are adapter-only (``ops/lora.py:save_adapter``): a
+directory with the factor tree + hyperparams that
+``AdapterRegistry.register_path`` and ``tools/hf_interop.py`` both
+speak.  The base checkpoint is never rewritten.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import RuntimeConfig
+from ..models import model as model_lib
+from ..models.transformer import rope_tables
+from ..ops import lora as lora_lib
+from ..parallel.cross_entropy import cross_entropy, masked_mean_loss
+from . import optimizer as opt_lib
+from .schedule import learning_rate, weight_decay
+
+PyTree = Any
+
+
+def _check_targets(cfg: RuntimeConfig, targets: Sequence[str]) -> None:
+    # mirror the serving registry's MoE guard: the expert dispatch routes
+    # tokens through per-expert weights the single stacked delta doesn't
+    # model, so MLP targets would silently train against the wrong math
+    if cfg.model.num_experts > 0:
+        moe = [t for t in targets if t in ("w_gate", "w_up", "w_down")]
+        if moe:
+            raise ValueError(
+                f"LoRA MLP targets {moe} unsupported with MoE "
+                f"(num_experts={cfg.model.num_experts}); use attention "
+                "targets only")
+
+
+def make_lora_step(cfg: RuntimeConfig, base_params,
+                   adapter: lora_lib.LoRAAdapter):
+    """Jitted ``(factors, opt_state, batch, it) -> (factors, opt_state,
+    metrics)`` step: grad-accumulated CE loss over a ``[accum, micro,
+    seq]`` batch, AdamW/SGD on the factor tree only.
+
+    ``scale = α/r`` is folded into B inside the loss (the same fold the
+    arena install does), so checkpointed factors stay raw and the
+    delta's magnitude matches serving exactly.
+    """
+    rank = adapter.rank
+    scale = adapter.scale
+    rope = rope_tables(cfg.model)
+    ocfg = cfg.optimizer
+    train_iters = cfg.train.train_iters
+
+    def loss_fn(factors, mb):
+        arenas = {t: {"a": f["a"], "b": f["b"] * jnp.float32(scale)}
+                  for t, f in factors.items()}
+        mask = jnp.ones((mb["tokens"].shape[0], rank), jnp.float32)
+        logits, aux = model_lib.forward(
+            cfg.model, base_params, mb["tokens"],
+            position_ids=mb.get("position_ids"),
+            segment_ids=mb.get("segment_ids"),
+            deterministic=True, rope=rope, return_aux=True,
+            lora=(arenas, mask))
+        per_token = cross_entropy(logits, mb["labels"],
+                                  vocab_size=cfg.model.vocab_size)
+        loss = masked_mean_loss(per_token, mb["loss_mask"])
+        if cfg.model.num_experts > 0:
+            loss = loss + cfg.model.moe_aux_loss_coeff * aux
+        return loss
+
+    @jax.jit
+    def step(factors, opt_state, batch, it):
+        accum = next(iter(batch.values())).shape[0]
+
+        def body(carry, mb):
+            gsum, lsum = carry
+            loss, grads = jax.value_and_grad(loss_fn)(factors, mb)
+            return (jax.tree.map(jnp.add, gsum, grads), lsum + loss), None
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             factors)
+        (gsum, lsum), _ = jax.lax.scan(body, (zeros, jnp.float32(0.0)),
+                                       batch)
+        grads = jax.tree.map(lambda g: g / accum, gsum)
+        grads, norm = opt_lib.clip_by_global_norm(grads, ocfg.clip_grad)
+        lr = learning_rate(ocfg, it, train_iters)
+        wd = weight_decay(ocfg, it, train_iters)
+        factors, opt_state = opt_lib.optimizer_step(
+            ocfg, factors, grads, opt_state, lr, wd)
+        return factors, opt_state, {"loss": lsum / accum,
+                                    "grad_norm": norm, "lr": lr}
+
+    return step
+
+
+def lora_finetune(
+    cfg: RuntimeConfig,
+    base_params,
+    train_dataset,
+    *,
+    rank: int,
+    targets: Optional[Sequence[str]] = None,
+    alpha: Optional[float] = None,
+    adapter: Optional[lora_lib.LoRAAdapter] = None,
+    eod_token: Optional[int] = None,
+    save: Optional[str] = None,
+) -> lora_lib.LoRAAdapter:
+    """Train a LoRA adapter for ``cfg.train.train_iters`` iterations
+    against frozen ``base_params``; returns (and optionally saves) the
+    trained adapter.
+
+    ``adapter`` resumes/continues an existing adapter (e.g. a PEFT
+    import via ``tools/hf_interop.py``); otherwise a fresh one is
+    initialized from ``rank``/``targets``/``alpha`` with B = 0.  With
+    ``save``, an adapter-only checkpoint lands at ``<save>/adapter`` —
+    the base checkpoint is never touched.
+    """
+    from .driver import _build_train_iterator, print_rank_0
+
+    cfg.validate()
+    if adapter is None:
+        adapter = lora_lib.init_lora_adapter(
+            cfg.model, jax.random.key(cfg.train.seed), rank,
+            targets=targets, alpha=alpha)
+    else:
+        lora_lib.validate_adapter(cfg.model, adapter)
+    _check_targets(cfg, adapter.targets)
+
+    factors = adapter.factors
+    opt_state = opt_lib.init_opt_state(factors, cfg.optimizer)
+    step = make_lora_step(cfg, base_params, adapter)
+
+    gbs = cfg.train.global_batch_size
+    train_iter = _build_train_iterator(cfg, train_dataset, 0, gbs, True,
+                                       eod_token)
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree.leaves(factors))
+    print_rank_0(f" lora finetune: rank={adapter.rank} "
+                 f"alpha={adapter.alpha} targets={adapter.targets} | "
+                 f"{n_params:,} trainable factor params (base frozen)")
+    t0 = time.perf_counter()
+    window_loss, window_n = 0.0, 0
+    for it in range(cfg.train.train_iters):
+        try:
+            batch = next(train_iter)
+        except StopIteration:
+            train_iter = _build_train_iterator(
+                cfg, train_dataset, (it * gbs) % max(len(train_dataset), 1),
+                gbs, True, eod_token)
+            batch = next(train_iter)
+        dev = {k: jnp.asarray(v) for k, v in batch.items()}
+        factors, opt_state, metrics = step(factors, opt_state, dev,
+                                           jnp.int32(it))
+        window_loss += float(metrics["loss"])
+        window_n += 1
+        li = cfg.train.log_interval
+        if li and (it + 1) % li == 0:
+            dt = time.perf_counter() - t0
+            print_rank_0(
+                f" lora iteration {it + 1:8d}/{cfg.train.train_iters:8d} |"
+                f" lm loss: {window_loss / max(window_n, 1):.6E} |"
+                f" learning rate: {float(metrics['lr']):.3E} |"
+                f" grad norm: {float(metrics['grad_norm']):.3f} |"
+                f" elapsed time per iteration (ms): "
+                f"{dt * 1000.0 / max(window_n, 1):.1f} |")
+            window_loss, window_n = 0.0, 0
+            t0 = time.perf_counter()
+
+    trained = dataclasses.replace(
+        adapter, factors=jax.tree.map(np.asarray, factors))
+    if save:
+        path = os.path.join(save, "adapter")
+        lora_lib.save_adapter(path, trained)
+        print_rank_0(f" saved adapter-only checkpoint to {path}")
+    return trained

@@ -1,0 +1,39 @@
+"""Known-bad fixture for R8 (adapter-materialize).
+
+Multi-tenant LoRA pays for itself only while adapter factors live in
+the resident device arena: ``AdapterRegistry.acquire`` installs them
+once per cache miss at admission, and the decode epilogue indexes the
+arena by slot id.  Rebuilding factor tensors per request in a hot-path
+function — reading the host-side ``.factors`` tree, re-running
+``install_adapter``, or ``merge_adapter``-folding ΔW into the base —
+re-uploads per-request tensors every step.  Cold paths (admission,
+training, checkpoint export) may touch factors freely.
+"""
+from megatron_llm_tpu.ops.lora import install_adapter, merge_adapter
+
+
+# tpulint: hot-path
+def decode_step(params, arenas, batch, registry):
+    ad = registry.get(batch.adapter_id)
+    a = ad.factors["wq"]["a"]  # BAD: adapter-materialize
+    arenas = install_adapter(arenas, ad.factors, batch.slot,  # BAD: adapter-materialize
+                             ad.scale, ad.rank)
+    return params, arenas, a
+
+
+# tpulint: hot-path
+def verify_step(params, batch, registry):
+    ad = registry.get(batch.adapter_id)
+    return merge_adapter(params, ad)  # BAD: adapter-materialize
+
+
+def admit(registry, request):
+    # cold path: the registry installs into the arena ONCE per cache
+    # miss at admission — that's the amortized point
+    return registry.acquire(request.adapter_id)
+
+
+def export_merged(params, adapter):
+    # cold path: offline ΔW fold for checkpoint export is the
+    # supported use of merge_adapter
+    return merge_adapter(params, adapter)

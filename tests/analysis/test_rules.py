@@ -42,6 +42,7 @@ def actual_findings(path: Path, config=None):
         ("bad_r5.py", "lock-discipline"),
         ("bad_r6.py", "dequant-hot-path"),
         ("bad_r7.py", "dyn-shape"),
+        ("bad_r8.py", "adapter-materialize"),
     ],
 )
 def test_fixture_findings_exact(name, rule):

@@ -1,0 +1,189 @@
+"""LoRA core math (ops/lora.py): arena install, grouped epilogue,
+masking, merge equivalence, and the adapter checkpoint format.
+
+The invariant everything downstream leans on: a zero-init adapter is an
+exact bitwise no-op, a masked-out slot contributes exact ±0.0, and the
+grouped epilogue at any slot equals the single-adapter delta.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.ops.lora import (
+    LoRAAdapter,
+    arena_sr,
+    init_lora_adapter,
+    install_adapter,
+    load_adapter,
+    lora_delta,
+    lora_target_shapes,
+    make_arenas,
+    merge_adapter,
+    save_adapter,
+    slot_mask,
+    validate_adapter,
+)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_config(num_layers=2, vocab_size=64,
+                       make_vocab_size_divisible_by=8)
+
+
+def _nonzero_adapter(cfg, seed, rank=4, **kw):
+    """init_lora_adapter with a non-trivial B so the delta is visible."""
+    ad = init_lora_adapter(cfg, jax.random.key(seed), rank, **kw)
+    return dataclasses.replace(ad, factors={
+        t: {"a": f["a"],
+            "b": jax.random.normal(jax.random.key(seed + 1000),
+                                   f["b"].shape, f["b"].dtype) * 0.05}
+        for t, f in ad.factors.items()})
+
+
+def test_zero_init_adapter_is_bitwise_noop(cfg):
+    """B = 0 ⇒ forward with the adapter installed equals the base
+    forward bitwise — the property that makes step 0 of finetuning and
+    an untrained tenant exactly the base model."""
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    ad = init_lora_adapter(cfg, jax.random.key(1), rank=4)
+    arenas = make_arenas(cfg, 2, 4, ad.targets)
+    arenas = install_adapter(arenas, ad.factors, 0, ad.scale, ad.rank)
+    toks = jnp.asarray([[3, 5, 7, 11]], jnp.int32)
+    mask = slot_mask(jnp.asarray([0], jnp.int32), 2, 4)
+    base = model_lib.forward(cfg, params, toks)
+    lora = model_lib.forward(cfg, params, toks, lora=(arenas, mask))
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(lora))
+
+
+def test_slot_mask_selects_rank_columns():
+    m = slot_mask(jnp.asarray([0, 2, -1], jnp.int32), n_slots=3, rank=2)
+    expect = np.zeros((3, 6), np.float32)
+    expect[0, 0:2] = 1.0
+    expect[1, 4:6] = 1.0   # slot 2 -> columns [4, 6)
+    # row 2: slot -1 selects nothing
+    np.testing.assert_array_equal(np.asarray(m), expect)
+
+
+@pytest.mark.parametrize("slot", [0, 1, 2])
+def test_grouped_epilogue_matches_single_delta(cfg, slot):
+    """lora_delta through the stacked arena at any slot == the plain
+    x·A·B·α/r of that adapter alone; the other slots' columns are
+    masked to exact zero."""
+    rank, n_slots = 4, 3
+    ads = [_nonzero_adapter(cfg, 10 + i, rank) for i in range(n_slots)]
+    arenas = make_arenas(cfg, n_slots, rank, ads[0].targets)
+    for s, ad in enumerate(ads):
+        arenas = install_adapter(arenas, ad.factors, s, ad.scale, ad.rank)
+    assert arena_sr(arenas) == n_slots * rank
+
+    x = jax.random.normal(jax.random.key(7), (2, cfg.hidden_size),
+                          jnp.float32)
+    mask = slot_mask(jnp.full((2,), slot, jnp.int32), n_slots, rank)
+    ad = ads[slot]
+    for t in ad.targets:
+        layer = 1
+        got = lora_delta(x, arenas[t]["a"][layer], arenas[t]["b"][layer],
+                         mask)
+        a = ad.factors[t]["a"][layer]
+        b = ad.factors[t]["b"][layer] * ad.scale
+        want = (x @ a) @ b
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_masked_out_rows_are_exact_zero(cfg):
+    """Slot -1 rows receive exact ±0.0 delta even with every arena slot
+    populated — the bitwise-stability guarantee for base-model rows in
+    a mixed batch."""
+    rank, n_slots = 4, 2
+    ads = [_nonzero_adapter(cfg, 20 + i, rank) for i in range(n_slots)]
+    arenas = make_arenas(cfg, n_slots, rank, ads[0].targets)
+    for s, ad in enumerate(ads):
+        arenas = install_adapter(arenas, ad.factors, s, ad.scale, ad.rank)
+    x = jax.random.normal(jax.random.key(3), (3, cfg.hidden_size),
+                          jnp.float32)
+    mask = slot_mask(jnp.asarray([-1, -1, -1], jnp.int32), n_slots, rank)
+    d = lora_delta(x, arenas["wq"]["a"][0], arenas["wq"]["b"][0], mask)
+    np.testing.assert_array_equal(np.asarray(d),
+                                  np.zeros_like(np.asarray(d)))
+
+
+def test_install_zeroes_untargeted_slot_columns(cfg):
+    """Installing an adapter that skips a target must zero that slot's
+    columns so the previous occupant cannot leak into its rows."""
+    rank, n_slots = 4, 2
+    full = _nonzero_adapter(cfg, 30, rank)                # all targets
+    only_q = _nonzero_adapter(cfg, 31, rank, targets=("wq",))
+    arenas = make_arenas(cfg, n_slots, rank, full.targets)
+    arenas = install_adapter(arenas, full.factors, 0, full.scale, rank)
+    arenas = install_adapter(arenas, only_q.factors, 0, only_q.scale,
+                             rank)
+    wv_cols = np.asarray(arenas["wv"]["a"][:, :, 0:rank])
+    np.testing.assert_array_equal(wv_cols, np.zeros_like(wv_cols))
+    assert np.any(np.asarray(arenas["wq"]["a"][:, :, 0:rank]) != 0)
+
+
+def test_epilogue_agrees_with_merged_weights(cfg):
+    """forward(lora=...) == forward(merge_adapter(params)) — the
+    multi-tenant path and the single-tenant fold are the same math."""
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    ad = _nonzero_adapter(cfg, 40)
+    arenas = make_arenas(cfg, 1, ad.rank, ad.targets)
+    arenas = install_adapter(arenas, ad.factors, 0, ad.scale, ad.rank)
+    toks = jnp.asarray([[3, 5, 7, 11, 2]], jnp.int32)
+    mask = slot_mask(jnp.asarray([0], jnp.int32), 1, ad.rank)
+    via_arena = model_lib.forward(cfg, params, toks, lora=(arenas, mask))
+    via_merge = model_lib.forward(cfg, merge_adapter(params, ad), toks)
+    np.testing.assert_allclose(np.asarray(via_arena),
+                               np.asarray(via_merge),
+                               atol=5e-4, rtol=5e-4)
+
+
+def test_merge_rejects_quantized_base(cfg):
+    from megatron_llm_tpu.ops.quant import quantize_params, resolve_policy
+
+    params = quantize_params(model_lib.init_params(jax.random.key(0), cfg),
+                             resolve_policy("int8"))
+    with pytest.raises(ValueError, match="quantized"):
+        merge_adapter(params, _nonzero_adapter(cfg, 50))
+
+
+def test_adapter_checkpoint_round_trip(cfg, tmp_path):
+    ad = _nonzero_adapter(cfg, 60, rank=8)
+    save_adapter(str(tmp_path / "adapter"), ad)
+    back = load_adapter(str(tmp_path / "adapter"))
+    assert back.rank == ad.rank and back.alpha == ad.alpha
+    assert back.targets == ad.targets
+    for t in ad.targets:
+        np.testing.assert_array_equal(np.asarray(back.factors[t]["a"]),
+                                      np.asarray(ad.factors[t]["a"]))
+        np.testing.assert_array_equal(np.asarray(back.factors[t]["b"]),
+                                      np.asarray(ad.factors[t]["b"]))
+    validate_adapter(cfg, back)
+
+
+def test_validate_rejects_wrong_shapes(cfg):
+    ad = init_lora_adapter(cfg, jax.random.key(0), rank=4)
+    bad = dataclasses.replace(ad, factors={
+        t: {"a": f["a"][:, :-1, :], "b": f["b"]}
+        for t, f in ad.factors.items()})
+    with pytest.raises(ValueError, match="shape"):
+        validate_adapter(cfg, bad)
+    with pytest.raises(ValueError, match="unknown"):
+        init_lora_adapter(cfg, jax.random.key(0), 4, targets=("nope",))
+
+
+def test_target_shapes_cover_glu(cfg):
+    shapes = lora_target_shapes(cfg)
+    assert shapes["wq"] == (cfg.hidden_size,
+                            cfg.num_attention_heads * cfg.head_dim)
+    assert shapes["wv"][1] == cfg.kv_heads * cfg.head_dim
+    if cfg.is_glu:
+        assert "w_gate" in shapes

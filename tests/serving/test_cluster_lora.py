@@ -1,0 +1,196 @@
+"""Multi-tenant LoRA across the cluster: per-replica registry clones,
+post-build adapter registration, adapter-affinity routing, and the
+rolling weight swap (Router.rolling_swap) as the zero-downtime deploy
+plane.
+
+Runs on the 8-virtual-device CPU mesh from conftest; replicas are
+tp=1 engines on disjoint single-device slices, so the per-engine
+bitwise guarantees of test_adapters.py carry over replica-for-replica.
+"""
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.models import model as model_lib
+from megatron_llm_tpu.ops.lora import init_lora_adapter
+from megatron_llm_tpu.serving import (
+    AdapterRegistry,
+    EngineConfig,
+    build_cluster,
+)
+
+PROMPT = [3, 5, 7, 11, 13]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = tiny_config(num_layers=2, vocab_size=64,
+                      make_vocab_size_divisible_by=8)
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _adapter(cfg, seed, rank=4):
+    ad = init_lora_adapter(cfg, jax.random.key(seed), rank, alpha=32.0)
+    return dataclasses.replace(ad, factors={
+        t: {"a": f["a"],
+            "b": jax.random.normal(jax.random.key(seed + 500),
+                                   f["b"].shape, f["b"].dtype) * 0.05}
+        for t, f in ad.factors.items()})
+
+
+def _cluster(cfg, params, replicas=2, **ecfg):
+    kw = dict(max_batch_size=2, max_seq_len=96, max_queue_size=32,
+              adapter_cache_slots=2, prefix_cache_blocks=0)
+    kw.update(ecfg)
+    reg = AdapterRegistry(cfg, n_slots=2, rank=4)
+    reg.register("tenant-a", _adapter(cfg, 11))
+    return build_cluster(cfg, params, EngineConfig(**kw),
+                         replicas=replicas, adapters=reg).start()
+
+
+def test_routed_adapters_match_alone_and_affinity(tiny):
+    """Adapter requests through the router — including one registered
+    AFTER the cluster was built, via Router.register_adapter — return
+    the same tokens as an alone run, whichever replica serves them
+    (every replica holds the same store via registry clones)."""
+    cfg, params = tiny
+    router = _cluster(cfg, params)
+    try:
+        router.register_adapter("tenant-b", _adapter(cfg, 22))
+
+        def alone(aid):
+            kw = {} if aid is None else {"adapter_id": aid}
+            return router.submit(PROMPT, 8, seed=1, use_eos_stop=False,
+                                 **kw).result(600).tokens
+
+        ref = {aid: alone(aid) for aid in ("tenant-a", "tenant-b", None)}
+        assert ref["tenant-a"] != ref[None] != ref["tenant-b"]
+        handles = [router.submit(PROMPT, 8, seed=1, use_eos_stop=False,
+                                 **({} if aid is None
+                                    else {"adapter_id": aid}))
+                   for aid in ("tenant-a", None, "tenant-b", "tenant-a")]
+        out = [h.result(600).tokens for h in handles]
+        assert out == [ref["tenant-a"], ref[None], ref["tenant-b"],
+                       ref["tenant-a"]]
+        # affinity: the served adapters are resident somewhere, and a
+        # replica that has tenant-a resident wins the tiebreak for it
+        assert any(r.engine.adapters.is_resident("tenant-a")
+                   for r in router.replicas)
+    finally:
+        router.shutdown()
+    for r in router.replicas:
+        assert r.engine.sanitizer_report == []
+
+
+def test_register_adapter_needs_a_registry(tiny):
+    cfg, params = tiny
+    router = build_cluster(cfg, params, EngineConfig(
+        max_batch_size=2, max_seq_len=64), replicas=2).start()
+    try:
+        with pytest.raises(ValueError, match="registry|adapter"):
+            router.register_adapter("t", _adapter(cfg, 1))
+    finally:
+        router.shutdown()
+
+
+def test_rolling_swap_mid_traffic_loses_nothing(tiny):
+    """rolling_swap through a 2-replica cluster mid-traffic: every
+    in-flight stream completes with all its tokens exactly once
+    (draining replicas migrate live decodes to siblings), both replicas
+    end up on the new tree, and the ledgers balance."""
+    cfg, params = tiny
+    router = _cluster(cfg, params)
+    params2 = model_lib.init_params(jax.random.key(99), cfg)
+    got = {}
+    try:
+        handles = []
+        for i in range(4):
+            got[i] = []
+            handles.append(router.submit(
+                PROMPT, 48, seed=2 + i, use_eos_stop=False,
+                adapter_id="tenant-a" if i % 2 else None,
+                on_token=got[i].append))
+        time.sleep(0.05)
+        report = router.rolling_swap(params2)
+        results = [h.result(600) for h in handles]
+    finally:
+        router.shutdown()
+    for i, r in enumerate(results):
+        gen = r.tokens[len(PROMPT):]
+        assert len(gen) == 48, f"request {i} lost tokens"
+        assert got[i] == gen, f"request {i} stream != result"
+    assert len(report["replicas"]) == 2
+    snap = router.snapshot()
+    assert snap["router"]["rolling_swaps_total"] == 1
+    for r in router.replicas:
+        assert r.engine.metrics.snapshot()["param_swaps"] == 1
+        assert not r.draining
+        assert r.engine.sanitizer_report == []
+
+
+def test_migrated_adapter_request_stays_bitwise(tiny):
+    """Live-migrating an adapter-decorated decode mid-stream: the
+    shipment carries only the adapter_id, the destination re-pins it
+    out of its own registry clone, and the finished stream is bitwise
+    equal to an unmigrated run."""
+    cfg, params = tiny
+    router = _cluster(cfg, params)
+    try:
+        ref = router.submit(PROMPT, 32, seed=5, use_eos_stop=False,
+                            adapter_id="tenant-a").result(600).tokens
+        h = router.submit(PROMPT, 32, seed=5, use_eos_stop=False,
+                          adapter_id="tenant-a")
+        time.sleep(0.05)
+        moved = router.migrate_request(h)
+        r = h.result(600)
+        snap = router.snapshot()
+    finally:
+        router.shutdown()
+    assert r.tokens == ref
+    if moved:     # finished-before-migration is a legal race; when the
+        # shipment really happened, the adopting replica re-pinned
+        assert snap["router"]["migrations_total"] >= 1
+    for rep in router.replicas:
+        assert rep.engine.sanitizer_report == []
+
+
+def test_rolling_swap_single_replica_rides_the_fence(tiny):
+    """With no sibling to migrate to, the lone replica swaps in place:
+    nothing is failed or requeued, the stream just crosses the fence."""
+    cfg, params = tiny
+    router = _cluster(cfg, params, replicas=1)
+    params2 = model_lib.init_params(jax.random.key(7), cfg)
+    try:
+        h = router.submit(PROMPT, 32, use_eos_stop=False,
+                          adapter_id="tenant-a")
+        time.sleep(0.05)
+        report = router.rolling_swap(params2)
+        r = h.result(600)
+    finally:
+        router.shutdown()
+    assert len(r.tokens) == len(PROMPT) + 32
+    assert report["migrated"] == 0 and report["requeued"] == 0
+
+
+def test_rolling_swap_rejects_mismatched_tree(tiny):
+    """A bad tree raises out of rolling_swap with the replica undrained
+    — the cluster keeps serving on the old weights."""
+    cfg, params = tiny
+    router = _cluster(cfg, params)
+    bad_cfg = tiny_config(num_layers=1, vocab_size=64,
+                          make_vocab_size_divisible_by=8)
+    try:
+        with pytest.raises(ValueError, match="structure|shape"):
+            router.rolling_swap(model_lib.init_params(jax.random.key(1),
+                                                      bad_cfg))
+        assert all(not r.draining for r in router.replicas)
+        r = router.submit(PROMPT, 6, use_eos_stop=False).result(600)
+        assert len(r.tokens) == len(PROMPT) + 6
+    finally:
+        router.shutdown()

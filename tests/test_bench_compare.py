@@ -36,6 +36,9 @@ def _record(**overrides):
         "serving_prefix": {"serving_prefix_ttft_speedup": 4.0,
                            "serving_prefix_hit_rate": 1.0,
                            "serving_prefix_ttft_ms_hit_p50": 3.0},
+        "serving_lora": {"serving_lora_itl_ms_p50": 10.5,
+                         "serving_lora_base_itl_ms_p50": 10.0,
+                         "serving_lora_cache_hit_rate": 0.6},
     }
     rec.update(overrides)
     return rec
@@ -137,6 +140,34 @@ def test_trace_overhead_gate():
     line, ok = bench.trace_overhead_check(
         _record(serving_mixed={"serving_mixed_tokens_per_sec": 900.0}))
     assert ok and "skipped" in line
+
+
+def test_lora_overhead_gate():
+    """serving_lora ITL p50 with adapters vs the adapter-less base
+    engine: within 10% passes, over fails, and a record without the pair
+    (pre-v8 schema) skips instead of gating."""
+    line, ok = bench.lora_overhead_check(_record())  # 10.5 vs 10.0: +5%
+    assert ok and "lora-overhead" in line
+    slow = _record(serving_lora={
+        "serving_lora_itl_ms_p50": 12.0,
+        "serving_lora_base_itl_ms_p50": 10.0})  # +20% > 10%
+    line, ok = bench.lora_overhead_check(slow)
+    assert not ok and "REGRESSION" in line
+    line, ok = bench.lora_overhead_check(
+        _record(serving_lora={"serving_lora_cache_hit_rate": 0.6}))
+    assert ok and "skipped" in line
+
+
+def test_compare_gates_lora_hit_rate_collapse():
+    """Losing the adapter-arena hit rate (admission stopped reusing
+    residency) gates; ITL jitter alone is reported but rides on the
+    dedicated overhead gate, not the headline diff."""
+    cur = _record(serving_lora={"serving_lora_itl_ms_p50": 10.6,
+                                "serving_lora_base_itl_ms_p50": 10.0,
+                                "serving_lora_cache_hit_rate": 0.0})
+    lines, regressed = bench.compare_records(_record(), cur)
+    assert regressed == ["serving_lora.serving_lora_cache_hit_rate"]
+    assert any("serving_lora_itl_ms_p50" in l for l in lines)
 
 
 def test_cli_compare_prints_run_meta_and_gates_trace_overhead(tmp_path):

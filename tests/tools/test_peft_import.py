@@ -1,0 +1,121 @@
+"""PEFT LoRA adapter import (tools/hf_interop.py:lora_from_peft).
+
+The correctness bar mirrors the base-weight converters: the native
+factor pair must reproduce ``ΔW_hf = B_hf @ A_hf`` exactly — including
+the rotate-half→interleaved permutation on Q/K, which lands entirely on
+``lora_B`` because the permutation only touches the output dim.
+"""
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import tiny_config
+from megatron_llm_tpu.tools.hf_interop import (
+    hf_to_interleaved,
+    lora_from_peft,
+)
+
+_DIMS = {
+    "q_proj": "self_attn", "k_proj": "self_attn", "v_proj": "self_attn",
+    "o_proj": "self_attn", "gate_proj": "mlp", "up_proj": "mlp",
+    "down_proj": "mlp",
+}
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_config(num_layers=2, vocab_size=64,
+                       make_vocab_size_divisible_by=8)
+
+
+def _proj_dims(cfg):
+    h, d = cfg.hidden_size, cfg.head_dim
+    nq, nkv, ffn = cfg.num_attention_heads, cfg.kv_heads, cfg.ffn_size
+    return {"q_proj": (h, nq * d), "k_proj": (h, nkv * d),
+            "v_proj": (h, nkv * d), "o_proj": (nq * d, h),
+            "gate_proj": (h, ffn), "up_proj": (h, ffn),
+            "down_proj": (ffn, h)}
+
+
+def _peft_state_dict(cfg, rank, seed=0, projs=None, layers=None,
+                     versioned_keys=False):
+    rng = np.random.default_rng(seed)
+    dims = _proj_dims(cfg)
+    sd = {}
+    mid = ".default" if versioned_keys else ""
+    for i in layers if layers is not None else range(cfg.num_layers):
+        for proj in projs or dims:
+            fin, fout = dims[proj]
+            pre = (f"base_model.model.model.layers.{i}."
+                   f"{_DIMS[proj]}.{proj}")
+            sd[f"{pre}.lora_A{mid}.weight"] = \
+                rng.standard_normal((rank, fin)).astype(np.float32)
+            sd[f"{pre}.lora_B{mid}.weight"] = \
+                rng.standard_normal((fout, rank)).astype(np.float32)
+    return sd
+
+
+@pytest.mark.parametrize("versioned_keys", [False, True],
+                         ids=["plain", "default-infix"])
+def test_peft_import_reproduces_hf_delta(cfg, versioned_keys):
+    rank = 4
+    sd = _peft_state_dict(cfg, rank, versioned_keys=versioned_keys)
+    ad = lora_from_peft(sd, {"r": rank, "lora_alpha": 16}, cfg)
+    assert ad.rank == rank and ad.alpha == 16.0
+    assert set(ad.targets) == {"wq", "wk", "wv", "wo", "w_gate", "w_up",
+                               "w_down"}
+    d = cfg.head_dim
+    permute = {"wq": cfg.num_attention_heads, "wk": cfg.kv_heads}
+    native_of = {"q_proj": "wq", "k_proj": "wk", "v_proj": "wv",
+                 "o_proj": "wo", "gate_proj": "w_gate", "up_proj": "w_up",
+                 "down_proj": "w_down"}
+    mid = ".default" if versioned_keys else ""
+    for i in range(cfg.num_layers):
+        for proj, t in native_of.items():
+            pre = (f"base_model.model.model.layers.{i}."
+                   f"{_DIMS[proj]}.{proj}")
+            dw_hf = (sd[f"{pre}.lora_B{mid}.weight"]
+                     @ sd[f"{pre}.lora_A{mid}.weight"])   # [out, in]
+            if t in permute:
+                dw_hf = hf_to_interleaved(dw_hf, permute[t], d)
+            got = np.asarray(ad.factors[t]["a"][i]
+                             @ ad.factors[t]["b"][i])     # [in, out]
+            np.testing.assert_allclose(got, dw_hf.T, atol=1e-5,
+                                       rtol=1e-5)
+
+
+def test_peft_import_feeds_the_registry(cfg):
+    """Imported adapter validates, registers, and installs — the full
+    PEFT → multi-tenant serving hand-off."""
+    from megatron_llm_tpu.serving import AdapterRegistry
+
+    sd = _peft_state_dict(cfg, 4, projs=("q_proj", "v_proj"))
+    ad = lora_from_peft(sd, {"r": 4, "lora_alpha": 8}, cfg)
+    assert set(ad.targets) == {"wq", "wv"}
+    reg = AdapterRegistry(cfg, n_slots=2, rank=4)
+    reg.register("peft", ad)
+    assert reg.acquire("peft") in (0, 1)
+    reg.release("peft")
+
+
+def test_peft_import_guards(cfg):
+    sd = _peft_state_dict(cfg, 4)
+    with pytest.raises(ValueError, match="rsLoRA"):
+        lora_from_peft(sd, {"r": 4, "lora_alpha": 8, "use_rslora": True},
+                       cfg)
+    with pytest.raises(ValueError, match="DoRA"):
+        lora_from_peft(sd, {"r": 4, "lora_alpha": 8, "use_dora": True},
+                       cfg)
+    with pytest.raises(ValueError, match="rank_pattern"):
+        lora_from_peft(sd, {"r": 4, "lora_alpha": 8,
+                            "rank_pattern": {"q_proj": 8}}, cfg)
+    with pytest.raises(ValueError, match="no recognized"):
+        lora_from_peft({"not.a.lora.key": np.zeros((2, 2))},
+                       {"r": 4, "lora_alpha": 8}, cfg)
+    # partial-layer adapters (layers_to_transform) are refused
+    partial = _peft_state_dict(cfg, 4, layers=[0])
+    with pytest.raises(ValueError, match="missing"):
+        lora_from_peft(partial, {"r": 4, "lora_alpha": 8}, cfg)
+    # shape mismatch against the declared rank
+    with pytest.raises(ValueError, match="rank"):
+        lora_from_peft(sd, {"r": 8, "lora_alpha": 8}, cfg)
