@@ -3,7 +3,7 @@
 Enabled by ``MEGATRON_SANITIZE=1`` in the environment or
 ``EngineConfig.sanitize=True``; all hooks are inert (plain stdlib
 primitives, zero extra work) when disabled, so the instrumentation
-stays in production code.  Three checkers:
+stays in production code.  Four checkers:
 
 * **recompilation guard** — :class:`CompileCounter` /
   :func:`no_recompiles` count actual backend compiles via jax's
@@ -21,6 +21,10 @@ stays in production code.  Three checkers:
   :class:`LedgerError` on the first divergence, naming the block and
   its last known owners; :meth:`LedgerSanitizer.leak_report` gives the
   shutdown/drain leak summary.
+* **delivery ledger** — :class:`DeliveryLedger` records every token a
+  client stream received and proves it bitwise-equal to the request's
+  final token list (exactly-once delivery across crashes, failovers,
+  shipments, and migrations); the cluster chaos tests are its consumer.
 
 This module imports jax lazily (only inside the compile counter) so the
 static-analysis side of the package stays importable on a bare host.
@@ -33,10 +37,13 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Set
 
 __all__ = [
     "CompileCounter",
+    "DeliveryError",
+    "DeliveryLedger",
     "LedgerError",
     "LedgerSanitizer",
     "LockOrderError",
@@ -45,6 +52,8 @@ __all__ = [
     "check_lock_order",
     "enable_lock_tracking",
     "env_enabled",
+    "install_compile_clock",
+    "last_backend_compile_s",
     "lock_order_violations",
     "make_condition",
     "make_lock",
@@ -69,6 +78,12 @@ _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _counters_mu = threading.Lock()
 _active_counters: List["CompileCounter"] = []
 _listener_installed = False
+# perf_counter of the last backend-compile completion, keyed by the
+# ident of the thread that ran the compile (compiles block the calling
+# thread, so the listener fires on it).  The cluster watchdog reads this
+# to tell "scheduler wedged" apart from "scheduler inside a legitimate
+# first-dispatch compile".
+_last_compile_end: Dict[int, float] = {}
 
 
 def _install_compile_listener() -> None:
@@ -83,10 +98,27 @@ def _install_compile_listener() -> None:
         if event != _COMPILE_EVENT:
             return
         with _counters_mu:
+            _last_compile_end[threading.get_ident()] = time.perf_counter()
             for c in _active_counters:
                 c.count += 1
 
     jax.monitoring.register_event_duration_secs_listener(_on_event)
+
+
+def install_compile_clock() -> None:
+    """Start recording backend-compile completions (idempotent); read
+    them back with :func:`last_backend_compile_s`."""
+    _install_compile_listener()
+
+
+def last_backend_compile_s(thread_ident: Optional[int] = None) -> float:
+    """perf_counter time of the most recent backend-compile completion —
+    on ``thread_ident`` if given, else across all threads; 0.0 if none
+    recorded.  Only meaningful after :func:`install_compile_clock`."""
+    with _counters_mu:
+        if thread_ident is not None:
+            return _last_compile_end.get(thread_ident, 0.0)
+        return max(_last_compile_end.values(), default=0.0)
 
 
 class CompileCounter:
@@ -121,6 +153,70 @@ def no_recompiles(allow: int = 0) -> Iterator[CompileCounter]:
             f"no_recompiles(allow={allow}) region — a hot-path executable "
             "retraced after warmup (new shape/dtype or a static argument "
             "taking a fresh value)")
+
+
+# ---------------------------------------------------------------------------
+# exactly-once delivery ledger
+# ---------------------------------------------------------------------------
+
+class DeliveryError(AssertionError):
+    """A client stream diverged from its request's final token list —
+    a duplicated, dropped, or reordered token crossed a failover."""
+
+
+class DeliveryLedger:
+    """Exactly-once stream checker for chaos/failover tests.
+
+    The cluster's contract is that the client-visible token stream of a
+    request is bitwise the stream an uninterrupted run would have
+    produced, no matter how many crashes, replays, shipments, or
+    migrations happened underneath.  The ledger records every streamed
+    token per client key (``on_token(key)`` returns the callback to put
+    in the request spec) and :meth:`check` compares the recording
+    against the final result's generated tokens:
+
+    * the common prefix must match token-for-token (a mismatch means a
+      duplicate or reordering leaked through replay suppression);
+    * with ``exact=True`` (normal completions) the lengths must match
+      too — every accepted token delivered exactly once.  Requests cut
+      short by quarantine/timeout pass ``exact=False``: their final
+      token list is whatever the last incarnation had generated, which
+      can legitimately trail or lead the delivered count.
+    """
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._streams: Dict[object, List[int]] = {}
+
+    def on_token(self, key):
+        with self._mu:
+            stream = self._streams.setdefault(key, [])
+
+        def _cb(tok: int) -> None:
+            stream.append(int(tok))
+
+        return _cb
+
+    def stream(self, key) -> List[int]:
+        with self._mu:
+            return list(self._streams.get(key, []))
+
+    def check(self, key, tokens, prompt_len: int, *,
+              exact: bool = True) -> None:
+        streamed = self.stream(key)
+        gen = list(tokens)[int(prompt_len):]
+        n = min(len(streamed), len(gen))
+        if streamed[:n] != gen[:n]:
+            raise DeliveryError(
+                f"stream {key!r} diverged from the final tokens: "
+                f"streamed {streamed[:n]} vs final {gen[:n]} — a "
+                "duplicate or reordered token crossed a failover")
+        if exact and len(streamed) != len(gen):
+            raise DeliveryError(
+                f"stream {key!r} delivered {len(streamed)} token(s) but "
+                f"the request finished with {len(gen)} — "
+                f"{'dropped' if len(streamed) < len(gen) else 'extra'} "
+                "deliveries across a failover")
 
 
 # ---------------------------------------------------------------------------

@@ -82,7 +82,10 @@ class GenerationService:
                  router: bool = False,
                  router_config=None,
                  disagg: str | None = None,
-                 role: str = "mixed"):
+                 role: str = "mixed",
+                 supervise: bool = False,
+                 hang_timeout_s: float = 10.0,
+                 supervisor_config=None):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -151,6 +154,14 @@ class GenerationService:
         # single-engine server's role in an externally assembled cluster
         self.disagg = self._parse_disagg(disagg)
         self.role = role
+        # cluster self-healing (serving/cluster/supervisor.py,
+        # docs/robustness.md): supervise=True attaches a
+        # ReplicaSupervisor that rebuilds dead replicas on their original
+        # submesh and kills wedged ones (iteration heartbeat stale for
+        # hang_timeout_s).  Only meaningful behind a router front-end.
+        self.supervise = supervise
+        self.hang_timeout_s = hang_timeout_s
+        self.supervisor_config = supervisor_config
         # the lock now guards only the legacy one-shot paths (beam search,
         # scoring, PLD); standard generation goes through the engine
         self.lock = make_lock("server.generate")
@@ -237,6 +248,14 @@ class GenerationService:
                 else:
                     self._engine = ServingEngine(self.cfg, self.params,
                                                  engine_config, **draft_kw)
+                if self.supervise and hasattr(self._engine, "replicas"):
+                    from ..serving import (ReplicaSupervisor,
+                                           SupervisorConfig)
+
+                    sc = self.supervisor_config or SupervisorConfig(
+                        hang_timeout_s=self.hang_timeout_s)
+                    # Router.shutdown stops the supervisor it carries
+                    ReplicaSupervisor(self._engine, sc).start()
             return self._engine
 
     def metrics_snapshot(self) -> dict:

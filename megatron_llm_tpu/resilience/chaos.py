@@ -7,11 +7,15 @@ test arms the process-global controller:
 
 - ``fail_io(site, times=n)``   — the next ``n`` I/O attempts at ``site``
   raise ``OSError`` (exercises the retry/backoff path);
-- ``crash_at(site)``           — raise ``SimulatedCrash`` at the point
+- ``crash_at(site, times=n)``  — raise ``SimulatedCrash`` at the point
   (a ``BaseException``: recovery code's ``except Exception`` cleanup
-  cannot swallow it, just like a real kill);
+  cannot swallow it, just like a real kill); ``times > 1`` re-arms the
+  site so a resubmitted poison request can crash its next host too;
 - ``kill_at(site)``            — ``os.kill(os.getpid(), SIGKILL)`` at the
   point, for subprocess tests that need a *real* untrappable death;
+- ``hang_at(site, seconds=s)`` — the next pass through the site blocks
+  for ``s`` seconds (a wedged device dispatch: the thread is alive but
+  the iteration heartbeat goes stale — exercises the cluster watchdog);
 - ``poison_batches(iters)``    — the training driver NaN-poisons the
   batches of those 1-based iterations (exercises skip/rollback).
 
@@ -24,6 +28,7 @@ from __future__ import annotations
 
 import os
 import signal
+import time
 from typing import Callable, Iterable, Optional
 
 import numpy as np
@@ -47,8 +52,9 @@ class Chaos:
     def __init__(self):
         self._lock = make_lock("chaos")
         self._io_failures: dict[str, list] = {}   # site -> [remaining, exc]
-        self._crashes: set[str] = set()
+        self._crashes: dict[str, int] = {}        # site -> remaining crashes
         self._kills: dict[str, int] = {}          # site -> signal number
+        self._hangs: dict[str, list] = {}         # site -> [remaining, secs]
         self._poisoned_iters: set[int] = set()
         self._kv_leaks: dict[str, int] = {}       # site -> refs to drop
         self.events: list[tuple[str, str]] = []   # (kind, site) fired log
@@ -60,6 +66,7 @@ class Chaos:
             self._io_failures.clear()
             self._crashes.clear()
             self._kills.clear()
+            self._hangs.clear()
             self._poisoned_iters.clear()
             self._kv_leaks.clear()
             self.events.clear()
@@ -73,13 +80,25 @@ class Chaos:
         with self._lock:
             self._io_failures[site] = [int(times), exc]
 
-    def crash_at(self, site: str) -> None:
+    def crash_at(self, site: str, times: int = 1) -> None:
+        """Raise ``SimulatedCrash`` at the next ``times`` passes through
+        ``site`` — multi-shot arming lets a poison request keyed to a
+        per-request site crash every replica it is resubmitted to."""
         with self._lock:
-            self._crashes.add(site)
+            self._crashes[site] = int(times)
 
     def kill_at(self, site: str, sig: int = signal.SIGKILL) -> None:
         with self._lock:
             self._kills[site] = int(sig)
+
+    def hang_at(self, site: str, seconds: float = 5.0,
+                times: int = 1) -> None:
+        """Make the next ``times`` passes through ``site`` block for
+        ``seconds`` — a live-but-wedged step (stuck device dispatch),
+        invisible to thread-liveness probes; only an iteration-heartbeat
+        watchdog catches it."""
+        with self._lock:
+            self._hangs[site] = [int(times), float(seconds)]
 
     def poison_batches(self, iterations: Iterable[int]) -> None:
         """NaN-poison the batches of these 1-based training iterations."""
@@ -99,9 +118,9 @@ class Chaos:
         """A named crash/kill site inside instrumented code."""
         with self._lock:
             sig = self._kills.pop(site, None)
-            crash = site in self._crashes
+            crash = self._crashes.get(site, 0) > 0
             if crash:
-                self._crashes.discard(site)
+                self._crashes[site] -= 1
             if sig is not None or crash:
                 self.events.append(("kill" if sig is not None else "crash",
                                     site))
@@ -109,6 +128,17 @@ class Chaos:
             os.kill(os.getpid(), sig)
         if crash:
             raise SimulatedCrash(site)
+
+    def maybe_hang(self, site: str) -> None:
+        """A named hang site; blocks while a hang is armed there."""
+        with self._lock:
+            armed = self._hangs.get(site)
+            if armed is None or armed[0] <= 0:
+                return
+            armed[0] -= 1
+            seconds = armed[1]
+            self.events.append(("hang", site))
+        time.sleep(seconds)
 
     def io_attempt(self, site: str) -> None:
         """An I/O attempt at ``site``; raises while a failure is armed."""

@@ -21,12 +21,16 @@
   prefill/decode — prefill-specialized replicas shipping paged KV
   blocks to decode replicas, with live decode migration
   (``build_disagg_cluster``); see docs/serving.md, 'Multi-chip serving'
-  and 'Disaggregated prefill/decode'.
+  and 'Disaggregated prefill/decode'.  ``cluster/supervisor.py`` adds
+  self-healing: dead or wedged replicas are rebuilt on their original
+  submesh and rejoined to rotation (docs/robustness.md, 'Cluster
+  self-healing').
 """
 
 from .adapters import AdapterRegistry
-from .cluster import Router, RouterConfig, RouterHandle, build_cluster, \
-    build_disagg_cluster, build_sharded_engine
+from .cluster import ReplicaSupervisor, Router, RouterConfig, \
+    RouterHandle, SupervisorConfig, build_cluster, build_disagg_cluster, \
+    build_sharded_engine
 from .engine import (
     EngineConfig,
     FinishedRequest,
@@ -42,9 +46,11 @@ from .slots import SlotAllocator
 __all__ = [
     "AdapterRegistry",
     "EngineConfig",
+    "ReplicaSupervisor",
     "Router",
     "RouterConfig",
     "RouterHandle",
+    "SupervisorConfig",
     "build_cluster",
     "build_disagg_cluster",
     "build_sharded_engine",

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import model as model_lib
+from ..resilience.chaos import chaos
 
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnums=())
@@ -240,6 +241,7 @@ class BlockPool:
         ``(k_dense, v_dense)`` with leaves ``[L, 1, kv, arity*bk(, d)]``.
         """
         assert len(bids) <= arity
+        chaos().io_attempt("ship-export")
         table = np.full((1, arity), self.TRASH, dtype=np.int32)
         table[0, :len(bids)] = np.asarray(bids, dtype=np.int32)
         return _export_gather(self.k_pool, self.v_pool, table)
@@ -257,6 +259,7 @@ class BlockPool:
         admission uses.  Block contents transfer bitwise — no dequantize
         round trip for int8 ``{"q", "scale"}`` leaves.
         """
+        chaos().io_attempt("ship-import")
         k_dense = jax.tree.map(
             lambda d, p: jax.device_put(d, p.sharding), k_dense, self.k_pool)
         v_dense = jax.tree.map(

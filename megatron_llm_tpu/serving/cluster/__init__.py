@@ -24,11 +24,14 @@ decodes with the same block-shipping primitive.
 from .router import Router, RouterConfig, RouterHandle
 from .sharded import (build_cluster, build_disagg_cluster,
                       build_sharded_engine)
+from .supervisor import ReplicaSupervisor, SupervisorConfig
 
 __all__ = [
+    "ReplicaSupervisor",
     "Router",
     "RouterConfig",
     "RouterHandle",
+    "SupervisorConfig",
     "build_cluster",
     "build_disagg_cluster",
     "build_sharded_engine",

@@ -94,6 +94,11 @@ class RouterConfig:
     drain_timeout_s: float = 30.0    # per-replica engine.drain bound
     trace: bool = True
     trace_capacity: int = 4096
+    # poison-request quarantine: a request in flight at this many replica
+    # crashes is finished with reason "quarantined" instead of being
+    # resubmitted to kill another replica.  0 disables (never quarantine;
+    # the resubmit budget alone bounds the blast radius).
+    quarantine_after: int = 2
 
 
 class Replica:
@@ -106,6 +111,12 @@ class Replica:
         self.dead = False
         self.dispatched = 0
         self.completed = 0
+        # incarnation counter: bumped by ReplicaSupervisor on every
+        # rebuild.  Streams and shipments are fenced against the previous
+        # incarnation by identity (per-attempt on_token wrappers, the
+        # engine object captured in the ship handler); the generation is
+        # the observable — per-replica gauge, rejoin events, probes.
+        self.generation = 0
 
     @property
     def role(self) -> str:
@@ -141,6 +152,8 @@ class Replica:
             "alive": self.alive(),
             "healthy": self.healthy(max_burn),
             "draining": self.draining,
+            "generation": self.generation,
+            "heartbeat_age_s": time.perf_counter() - e.heartbeat,
             "queue_depth": len(e.queue),
             "slots_active": (e.slots.active_slots
                              if e.slots is not None else 0),
@@ -166,7 +179,7 @@ class _Routed:
 
     __slots__ = ("spec", "user_on_token", "sticky_key", "handle",
                  "replica", "delivered", "skip", "resubmits", "final",
-                 "done_event", "failed")
+                 "done_event", "failed", "attempt", "crashes", "deadline")
 
     def __init__(self, spec: dict, user_on_token, sticky_key,
                  handle: RequestHandle, replica: Replica):
@@ -178,6 +191,11 @@ class _Routed:
         self.delivered = 0                # tokens the client has seen
         self.skip = 0                     # replayed tokens to suppress
         self.resubmits = 0
+        self.attempt = 0                  # fences stale-incarnation streams
+        self.crashes = 0                  # replica crashes seen in flight
+        self.deadline: Optional[float] = None  # ORIGINAL absolute deadline
+        #                                  (perf_counter); resubmits get the
+        #                                  REMAINING budget, not a fresh one
         self.final: Optional[FinishedRequest] = None
         self.failed: Optional[str] = None
         self.done_event = threading.Event()
@@ -258,15 +276,30 @@ class Router:
         self.migrations_total = 0     # live decode rebalances
         self.ship_bytes_total = 0     # dense KV payload moved (both kinds)
         self.rolling_swaps_total = 0  # completed rolling_swap deploys
+        self.quarantined_total = 0    # poison requests quarantined
         self._shipments: dict[str, dict] = {}  # ship_id -> in-flight entry
+        # self-healing (serving/cluster/supervisor.py): attached by
+        # ReplicaSupervisor so snapshots/metrics can report rebuild state
+        self.supervisor = None
         # disaggregation: prefill-role engines hand each finished prefill's
         # KV blocks to the router for placement on a decode replica
         for r in self.replicas:
-            if r.role == "prefill":
-                r.engine.set_ship_handler(
-                    lambda ship, _src=r: self._dispatch_shipment(ship, _src))
+            self._wire_ship_handler(r)
         self.metrics = _RouterMetrics(self)
         REGISTRY.register_collector("cluster", self.metrics.collect)
+
+    def _wire_ship_handler(self, r: Replica) -> None:
+        """Install the ship handler on a prefill-role replica's CURRENT
+        engine.  The handler captures that engine by identity: a zombie
+        incarnation (hung thread waking after a watchdog kill + rebuild)
+        shipping through a stale handler is rejected and keeps its
+        request local — its tokens are fenced separately per attempt."""
+        if r.role != "prefill":
+            return
+        eng = r.engine
+        r.engine.set_ship_handler(
+            lambda ship, _src=r, _eng=eng: self._dispatch_shipment(
+                ship, _src, _eng))
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -282,6 +315,9 @@ class Router:
         return self
 
     def shutdown(self, timeout: float = 10.0) -> None:
+        sup, self.supervisor = self.supervisor, None
+        if sup is not None:  # stop rebuilds before killing engines
+            sup.shutdown(timeout)
         self._stop.set()
         with self._lock:
             t, self._probe_thread = self._probe_thread, None
@@ -316,7 +352,10 @@ class Router:
         all-or-nothing from the caller's view."""
         self.start()
         if self._draining:
-            raise QueueFull("router is draining; not accepting requests")
+            EVENT_LOG.emit("router", "router_queue_full",
+                           reason="draining", pending=len(self._pending))
+            raise QueueFull("router is draining; not accepting requests",
+                            retry_after_s=self._retry_after_s())
         handles: List[RouterHandle] = []
         try:
             for spec in specs:
@@ -338,11 +377,21 @@ class Router:
         with self._lock:
             replica = self._pick(sticky_key, spec.get("adapter_id"))
             if replica is None:
-                raise QueueFull("no usable replica (all draining/dead)")
+                # backpressure, not an error: surfaces as HTTP 503 +
+                # Retry-After at the server, same contract as an
+                # engine-level full queue
+                EVENT_LOG.emit("router", "router_queue_full",
+                               reason="no_usable_replica",
+                               replicas=len(self.replicas))
+                raise QueueFull("no usable replica (all draining/dead)",
+                                retry_after_s=self._retry_after_s())
             rr = _Routed(spec, user_on_token, sticky_key, None, replica)
-            espec = dict(spec, on_token=_stream(rr))
+            espec = dict(spec, on_token=_stream(rr, 0))
             [handle] = replica.engine.submit_many([espec])
             rr.handle = handle
+            # the engine applied default_deadline_s; freeze the ABSOLUTE
+            # deadline so failover resubmits get the remaining budget
+            rr.deadline = handle._req.deadline
             self._pending[id(rr)] = rr
             replica.dispatched += 1
             self.routed_total += 1
@@ -415,7 +464,8 @@ class Router:
             if res is not None and res.finish_reason != "error":
                 self._complete(rr, res)
                 return
-            self._failover(rr, f"scheduler error on {rr.replica.id}")
+            self._failover(rr, f"scheduler error on {rr.replica.id}",
+                           crashed=True)
 
     def _complete(self, rr: _Routed, res: FinishedRequest) -> None:
         rr.final = res
@@ -429,15 +479,70 @@ class Router:
         self._pending.pop(id(rr), None)
         rr.done_event.set()
 
-    def _failover(self, rr: _Routed, why: str) -> None:
-        """Resubmit ``rr`` to another replica (router lock held)."""
+    def _quarantine(self, rr: _Routed, why: str) -> None:
+        """Poison-request quarantine (router lock held): a request that
+        was in flight at ``quarantine_after`` replica crashes is the
+        prime suspect for *causing* them — finish it with reason
+        "quarantined" (tokens delivered so far included) instead of
+        resubmitting it to take down another replica."""
+        req = rr.handle._req
+        rr.attempt += 1  # fence any late tokens from the dead attempt
+        rr.final = FinishedRequest(
+            tokens=list(req.prompt) + list(req.generated),
+            prompt_len=len(req.prompt), finish_reason="quarantined")
+        self.quarantined_total += 1
+        self._pending.pop(id(rr), None)
+        rr.done_event.set()
+        self.trace.add("quarantine", time.perf_counter(),
+                       time.perf_counter(), request_id=rr.handle.rid,
+                       args={"crashes": rr.crashes, "why": why})
+        EVENT_LOG.emit("router", "request_quarantined",
+                       request_id=rr.handle.rid, crashes=rr.crashes,
+                       resubmits=rr.resubmits, reason=why)
+
+    def _retry_after_s(self) -> float:
+        """Retry-After hint for router-level backpressure: the largest
+        engine-level hint behind this router (a healing cluster usually
+        recovers a replica within one engine backoff window)."""
+        return max(r.engine.config.retry_after_s for r in self.replicas)
+
+    def _failover(self, rr: _Routed, why: str, *,
+                  crashed: bool = False) -> None:
+        """Resubmit ``rr`` to another replica (router lock held).
+
+        ``crashed=True`` marks failovers caused by a replica *crash*
+        (scheduler error, dead thread, watchdog kill) as opposed to an
+        orderly drain/swap: crash-correlated requests count toward the
+        poison-quarantine threshold, drained ones never do."""
         if rr.done_event.is_set():
             return
         old_rid = rr.handle.rid
         old_replica = rr.replica.id
+        if crashed:
+            rr.crashes += 1
+            qa = self.config.quarantine_after
+            if qa > 0 and rr.crashes >= qa:
+                self._quarantine(rr, why)
+                return
         if rr.resubmits >= self.config.max_resubmits:
             self._fail(rr, f"{why}; resubmit budget exhausted")
             return
+        # deadline-aware resubmit: the original wall-clock budget keeps
+        # running across failovers — a request whose budget already
+        # expired times out NOW instead of burning a slot on a
+        # dead-on-arrival retry
+        remaining = None
+        if rr.deadline is not None:
+            remaining = rr.deadline - time.perf_counter()
+            if remaining <= 0:
+                req = rr.handle._req
+                self._complete(rr, FinishedRequest(
+                    tokens=list(req.prompt) + list(req.generated),
+                    prompt_len=len(req.prompt), finish_reason="timeout"))
+                EVENT_LOG.emit("router", "failover_expired",
+                               request_id=old_rid, replica=old_replica,
+                               delivered_tokens=rr.delivered)
+                return
         target = self._pick(None, rr.spec.get("adapter_id"))
         if target is None or target.id == old_replica:
             target = next((r for r in self.replicas
@@ -447,12 +552,15 @@ class Router:
             self._fail(rr, f"{why}; no usable replica left")
             return
         rr.resubmits += 1
+        rr.attempt += 1  # fences any late tokens from the old attempt
         self.failovers_total += 1
         # replay suppression: tokens the client already received stream
         # again (same seed → same trajectory) and are dropped by count
         rr.skip = rr.delivered
         t0 = time.perf_counter()
-        espec = dict(rr.spec, on_token=_stream(rr))
+        espec = dict(rr.spec, on_token=_stream(rr, rr.attempt))
+        if remaining is not None:
+            espec["deadline_s"] = remaining
         try:
             [handle] = target.engine.submit_many([espec])
         except Exception as e:  # noqa: BLE001 — target refused (full/
@@ -530,7 +638,7 @@ class Router:
             orphans = [rr for rr in self._pending.values()
                        if rr.replica is r and not rr.done_event.is_set()]
             for rr in orphans:
-                self._failover(rr, f"{r.id} killed")
+                self._failover(rr, f"{r.id} killed", crashed=True)
         return len(orphans)
 
     def _replica(self, replica_id: str) -> Replica:
@@ -639,7 +747,8 @@ class Router:
 
     # -- KV-block shipping: prefill handoff + live migration ---------------
 
-    def _dispatch_shipment(self, ship: KVShipment, src: Replica) -> None:
+    def _dispatch_shipment(self, ship: KVShipment, src: Replica,
+                           src_engine=None) -> None:
         """Ship handler for prefill-role replicas.  Runs ON the source
         engine's scheduler thread right after a prefill committed its
         first token: picks a decode-capable replica, installs the
@@ -650,6 +759,15 @@ class Router:
         falls back to reinstalling on the source, which cannot fail: the
         slot and block capacity were just freed there and the shipment's
         refs still pin the original blocks."""
+        if src_engine is not None and (src.dead
+                                       or src.engine is not src_engine):
+            # previous-incarnation fence: this handler belongs to an
+            # engine the supervisor already replaced (or a dead one).
+            # Refuse the ship — the zombie's _maybe_handoff reinstalls
+            # it into its own doomed pool, which is torn down with it.
+            raise RuntimeError(
+                f"stale shipment {ship.ship_id} from a previous "
+                f"incarnation of {src.id} (generation {src.generation})")
         t0 = time.perf_counter()
         req = ship.meta["req"]
         with self._lock:
@@ -712,7 +830,11 @@ class Router:
         False when the request is not in a migratable state (queued,
         mid-prefill, finished, or finishing during the extract) or no
         destination is usable; the request keeps decoding at home in
-        every False case."""
+        every False case — except the double-fault corner (install
+        failed at the destination AND the freed home slot was stolen by
+        a queued admission before the reinstall), where it is failed
+        over through the normal resubmit path instead, with the same
+        bitwise-stream guarantee."""
         self.start()
         rr = self._resolve(request)
         if rr is None or rr.done_event.is_set():
@@ -728,8 +850,15 @@ class Router:
             return False
         req = rr.handle._req
         t0 = time.perf_counter()
-        ship = src.engine.call_in_scheduler(
-            lambda: src.engine.extract_request(req), timeout)
+        try:
+            ship = src.engine.call_in_scheduler(
+                lambda: src.engine.extract_request(req), timeout)
+        except OSError as e:  # export I/O failed before any ledger
+            # mutation: the request keeps decoding at home
+            EVENT_LOG.emit("router", "migrate_failed",
+                           request_id=rr.handle.rid, from_replica=src.id,
+                           to_replica=dst.id, error=repr(e))
+            return False
         if ship is None:
             return False
         with self._lock:
@@ -740,10 +869,28 @@ class Router:
         try:
             dst.engine.call_in_scheduler(
                 lambda: dst.engine.install_shipment(ship), timeout)
-        except Exception as e:  # noqa: BLE001 — reinstall at home (the
-            # capacity was just freed there, so this cannot fail)
-            src.engine.call_in_scheduler(
-                lambda: src.engine.install_shipment(ship), timeout)
+        except Exception as e:  # noqa: BLE001 — reinstall at home first
+            try:
+                src.engine.call_in_scheduler(
+                    lambda: src.engine.install_shipment(ship), timeout)
+            except Exception as e2:  # noqa: BLE001 — the slot freed by
+                # the extract was re-occupied by a queued admission
+                # before the reinstall could claim it back: release the
+                # exported blocks and fall back to the ordinary failover
+                # path — seed replay + delivered-token suppression keep
+                # the client stream bitwise
+                src.engine.call_in_scheduler(
+                    lambda: src.engine.slots.pool.end_ship(ship.ship_id),
+                    timeout)
+                with self._lock:
+                    self._shipments.pop(ship.ship_id, None)
+                    self._failover(
+                        rr, f"migration reinstall failed: {e2!r}")
+                EVENT_LOG.emit("router", "migrate_failed",
+                               request_id=ship.request_id,
+                               from_replica=src.id, to_replica=dst.id,
+                               error=repr(e2), resubmitted=True)
+                return False
             src.engine.call_in_scheduler(
                 lambda: src.engine.slots.pool.end_ship(ship.ship_id),
                 timeout)
@@ -808,7 +955,7 @@ class Router:
                 and res.finish_reason != "error":
             self._complete(rr, res)
         else:
-            self._failover(rr, f"{rr.replica.id} dead")
+            self._failover(rr, f"{rr.replica.id} dead", crashed=True)
 
     # -- introspection (any thread; GET /cluster) --------------------------
 
@@ -817,6 +964,7 @@ class Router:
         roles: dict[str, int] = {}
         for r in self.replicas:
             roles[r.role] = roles.get(r.role, 0) + 1
+        sup = self.supervisor
         return {
             "router": {
                 "replicas": len(self.replicas),
@@ -832,6 +980,11 @@ class Router:
                 "migrations_total": self.migrations_total,
                 "ship_bytes_total": self.ship_bytes_total,
                 "rolling_swaps_total": self.rolling_swaps_total,
+                "quarantined_total": self.quarantined_total,
+                "replicas_rebuilt_total":
+                    0 if sup is None else sup.rebuilt_total,
+                "watchdog_trips_total":
+                    0 if sup is None else sup.watchdog_trips_total,
                 "pending": len(self._pending),
                 "sticky_keys": len(self._sticky),
             },
@@ -843,11 +996,19 @@ class Router:
         return {r.id: r.engine.kv_snapshot() for r in self.replicas}
 
 
-def _stream(rr: _Routed) -> Callable[[int], None]:
+def _stream(rr: _Routed, attempt: int) -> Callable[[int], None]:
     """Per-attempt on_token wrapper: drops the replayed prefix after a
-    failover, forwards the rest to the client callback."""
+    failover, forwards the rest to the client callback.
+
+    The wrapper is fenced by attempt number: a zombie incarnation (a
+    scheduler wedged in a device dispatch that wakes up after the
+    watchdog killed its replica and the request failed over) still holds
+    the OLD attempt's callback — its late tokens are dropped here, so
+    the client stream never sees a duplicate."""
 
     def on_token(tok: int) -> None:
+        if rr.attempt != attempt:  # stale incarnation: fence it off
+            return
         if rr.skip > 0:
             rr.skip -= 1
             return
@@ -912,7 +1073,23 @@ class _RouterMetrics:
             MetricFamily("cluster_shipments_in_flight", "gauge",
                          "KV shipments currently owned by neither replica"
                          ).add(len(r._shipments)),
+            MetricFamily("cluster_quarantined_requests_total", "counter",
+                         "poison requests quarantined after repeated "
+                         "crash correlation").add(r.quarantined_total),
+            MetricFamily("cluster_replicas_rebuilt_total", "counter",
+                         "replica incarnations rebuilt by the supervisor"
+                         ).add(0 if r.supervisor is None
+                               else r.supervisor.rebuilt_total),
+            MetricFamily("cluster_watchdog_trips_total", "counter",
+                         "hung-step watchdog kills"
+                         ).add(0 if r.supervisor is None
+                               else r.supervisor.watchdog_trips_total),
         ]
+        gen = MetricFamily("cluster_replica_generation", "gauge",
+                           "per-replica incarnation counter")
+        for rep in r.replicas:
+            gen.add(rep.generation, labels={"replica": rep.id})
+        fams.append(gen)
         qd = MetricFamily("cluster_replica_queue_depth", "gauge",
                           "per-replica queue depth")
         for rep in r.replicas:

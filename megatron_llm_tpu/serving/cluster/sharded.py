@@ -72,11 +72,22 @@ def build_sharded_engine(cfg: ModelConfig, params,
     from ...parallel import mesh as mesh_lib
 
     parallel = parallel or ParallelConfig()
+    # Rebuild recipe for the cluster supervisor: everything needed to
+    # re-run this builder on the ORIGINAL submesh after a crash.  Holds
+    # the host param tree by reference (it is alive in the caller
+    # anyway); ``adapters`` is overridden by the cluster builders to the
+    # shared source registry so a rebuilt replica re-clones the *live*
+    # adapter store, including adapters registered after build.
+    spec = dict(cfg=cfg, params=params, engine_config=engine_config,
+                parallel=parallel, devices=devices, draft_cfg=draft_cfg,
+                draft_params=draft_params, adapters=adapters)
     tp_eff = parallel.pipeline_parallel * parallel.tensor_parallel
     if tp_eff == 1 and devices is None:
-        return ServingEngine(cfg, params, engine_config, metrics=metrics,
-                             draft_cfg=draft_cfg,
-                             draft_params=draft_params, adapters=adapters)
+        eng = ServingEngine(cfg, params, engine_config, metrics=metrics,
+                            draft_cfg=draft_cfg,
+                            draft_params=draft_params, adapters=adapters)
+        eng.rebuild_spec = spec
+        return eng
     assert cfg.num_attention_heads % tp_eff == 0, (
         f"serving re-layout shards heads over pp·tp = {tp_eff}, which "
         f"must divide num_attention_heads = {cfg.num_attention_heads}")
@@ -90,9 +101,11 @@ def build_sharded_engine(cfg: ModelConfig, params,
     sharded_draft = (None if draft_params is None else
                      _shard_for_serving(draft_cfg, draft_params, parallel,
                                         mesh))
-    return ServingEngine(cfg, sharded, engine_config, metrics=metrics,
-                         mesh=mesh, draft_cfg=draft_cfg,
-                         draft_params=sharded_draft, adapters=adapters)
+    eng = ServingEngine(cfg, sharded, engine_config, metrics=metrics,
+                        mesh=mesh, draft_cfg=draft_cfg,
+                        draft_params=sharded_draft, adapters=adapters)
+    eng.rebuild_spec = spec
+    return eng
 
 
 def build_cluster(cfg: ModelConfig, params,
@@ -127,12 +140,17 @@ def build_cluster(cfg: ModelConfig, params,
         devices = jax.devices()
     engines = []
     if replicas == 1 and tp_eff == 1:
-        engines.append(ServingEngine(
+        eng = ServingEngine(
             cfg, params, engine_config,
             metrics=ServingMetrics(engine_config.max_batch_size,
                                    register=False),
             draft_cfg=draft_cfg, draft_params=draft_params,
-            adapters=adapters))
+            adapters=adapters)
+        eng.rebuild_spec = dict(
+            cfg=cfg, params=params, engine_config=engine_config,
+            parallel=parallel, devices=None, draft_cfg=draft_cfg,
+            draft_params=draft_params, adapters=adapters)
+        engines.append(eng)
     else:
         meshes = mesh_lib.replica_submeshes(parallel, replicas,
                                             devices=devices)
@@ -144,6 +162,10 @@ def build_cluster(cfg: ModelConfig, params,
                                        register=False),
                 draft_cfg=draft_cfg, draft_params=draft_params,
                 adapters=None if adapters is None else adapters.clone()))
+        for eng in engines:
+            # rebuilds re-clone from the SHARED store, not the dead
+            # incarnation's clone (see build_sharded_engine)
+            eng.rebuild_spec["adapters"] = adapters
     return Router(engines, router_config or RouterConfig())
 
 
@@ -215,4 +237,6 @@ def build_disagg_cluster(cfg: ModelConfig, params,
             metrics=ServingMetrics(ec.max_batch_size, register=False),
             draft_cfg=draft_cfg, draft_params=draft_params,
             adapters=None if adapters is None else adapters.clone()))
+    for eng in engines:
+        eng.rebuild_spec["adapters"] = adapters
     return Router(engines, router_config or RouterConfig())

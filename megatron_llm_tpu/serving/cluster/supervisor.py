@@ -1,0 +1,247 @@
+"""Replica supervision: crash rebuild + hung-step watchdog.
+
+The router's failover machinery (``cluster/router.py``) keeps *requests*
+alive when a replica dies, but until now the replica itself stayed dead
+— the cluster served on at N−1 capacity forever.  The
+:class:`ReplicaSupervisor` closes that loop: it watches every replica
+and, when one dies (scheduler crash detected by the same liveness check
+the router's probe uses) or *wedges* (scheduler thread alive but its
+per-iteration ``engine.heartbeat`` stale for ``hang_timeout_s`` — a
+stuck device dispatch, invisible to thread-liveness probes), it
+
+1. hard-kills the replica through ``Router.kill_replica`` — every
+   unfinished request fails over (or is quarantined) immediately, and
+   the scheduler thread is joined so no callbacks race the rebuild;
+2. stashes the dead incarnation's ``sanitizer_report`` into
+   ``incarnation_reports`` — the per-incarnation ledger audit is
+   forensic evidence, not garbage;
+3. rebuilds a fresh engine **on the original submesh** from the
+   ``engine.rebuild_spec`` recipe the cluster builders attached:
+   params re-shard from the host tree, the adapter registry re-clones
+   from the *shared* store (so adapters registered after the crash are
+   present), and the draft model rides along;
+4. re-warms the new engine's executables by running ``warm_specs``
+   through it **before** it rejoins rotation, so the serving window
+   never pays a compile;
+5. swaps the engine into the replica slot under the router lock, bumps
+   the replica ``generation``, and re-wires the ship handler — the old
+   incarnation's handler and ``on_token`` callbacks are fenced by
+   identity/attempt, so a zombie thread waking up later is inert.
+
+Rebuilds are serial (one monitor thread): a compound fault that kills
+two replicas rebuilds them one at a time while the survivors serve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import List, Optional
+
+from ...analysis import sanitizers
+from ...obs.logging import EVENT_LOG
+from ..engine import EngineConfig
+from ..metrics import ServingMetrics
+
+
+@dataclasses.dataclass
+class SupervisorConfig:
+    interval_s: float = 0.05        # monitor cadence
+    # heartbeat staleness (seconds) before a live-but-wedged scheduler is
+    # declared hung and killed; 0 disables the watchdog (crash rebuild
+    # still runs)
+    hang_timeout_s: float = 10.0
+    kill_timeout_s: float = 10.0    # scheduler join bound on kill
+    warm_timeout_s: float = 120.0   # per-warm-request compile bound
+    rebuild_backoff_s: float = 0.0  # min seconds between rebuilds of one
+    #                                 replica (crash-loop damping)
+    max_rebuilds: Optional[int] = None  # per-replica cap; None = forever
+    # specs run through a rebuilt engine before it rejoins rotation.
+    # Shape them like production traffic (same buckets / sampling /
+    # speculation / adapters) and the rebuilt replica serves with zero
+    # post-warmup recompiles.  None warms one tiny greedy request —
+    # enough to populate the compile cache for that bucket only.
+    warm_specs: Optional[List[dict]] = None
+
+
+class ReplicaSupervisor:
+    """Self-healing monitor over a :class:`~.router.Router`'s replicas."""
+
+    def __init__(self, router, config: Optional[SupervisorConfig] = None):
+        self.router = router
+        self.config = config or SupervisorConfig()
+        self.rebuilt_total = 0
+        self.watchdog_trips_total = 0
+        # replica id -> sanitizer_report of each dead incarnation, in
+        # death order (forensics for the soak's ledger assertions)
+        self.incarnation_reports: dict[str, List[list]] = {}
+        self._rebuilds: dict[str, int] = {}       # replica id -> count
+        self._last_rebuild: dict[str, float] = {}
+        self._last_swap = 0.0   # post-rebuild watchdog grace (see _check)
+        self._gave_up: set[str] = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        router.supervisor = self
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReplicaSupervisor":
+        if self._thread is None:
+            # compile amnesty for the watchdog (see _check) needs the
+            # backend-compile clock recording before traffic flows
+            sanitizers.install_compile_clock()
+            self._thread = threading.Thread(
+                target=self._loop, name="replica-supervisor", daemon=True)
+            self._thread.start()
+        return self
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout)
+
+    # -- monitor -----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            for r in self.router.replicas:
+                if self._stop.is_set():
+                    return
+                try:
+                    self._check(r)
+                except Exception as e:  # noqa: BLE001 — a failed rebuild
+                    # must not kill the monitor; back off and retry
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "rebuild of %s failed: %s", r.id, e)
+                    self._last_rebuild[r.id] = time.perf_counter()
+
+    def _check(self, r) -> None:
+        if r.id in self._gave_up:
+            return
+        wedged = False
+        if (self.config.hang_timeout_s > 0 and not r.dead
+                and not r.draining and r.alive()
+                and r.engine._started.is_set()):
+            # the scheduler refreshes engine.heartbeat every iteration,
+            # idle or not (the idle wait is bounded by idle_wait_s), so a
+            # stale heartbeat under a live thread means one *iteration*
+            # is stuck — a wedged device dispatch.  Two amnesties keep
+            # that judgement honest:
+            #
+            # * **compile amnesty** — a first-dispatch backend compile
+            #   (anywhere in the process: this scheduler, a sibling
+            #   replica, a rebuild warming off-rotation) blocks or
+            #   starves iterations for seconds, legitimately.  Count
+            #   progress from the last compile completion too, so only
+            #   a window with neither a finished iteration nor a
+            #   finished compile trips the watchdog.  (A single compile
+            #   longer than hang_timeout_s can still trip it; size the
+            #   timeout above the worst single-executable compile, or
+            #   warm up before arming the supervisor.)
+            # * **post-rebuild grace** — a rebuild's re-warm just
+            #   starved every co-located scheduler; give them one full
+            #   hang_timeout_s window to refresh before judging, or a
+            #   single kill cascades into serial rebuilds of healthy
+            #   replicas.
+            hb = max(r.engine.heartbeat,
+                     sanitizers.last_backend_compile_s(),
+                     self._last_swap)
+            age = time.perf_counter() - hb
+            if age > self.config.hang_timeout_s:
+                wedged = True
+                self.watchdog_trips_total += 1
+                EVENT_LOG.emit("supervisor", "watchdog_trip",
+                               replica=r.id, heartbeat_age_s=age)
+        if not wedged and (r.alive() or r.draining):
+            return  # healthy, or an orderly drain/swap in progress
+        n = self._rebuilds.get(r.id, 0)
+        if (self.config.max_rebuilds is not None
+                and n >= self.config.max_rebuilds):
+            self._gave_up.add(r.id)
+            EVENT_LOG.emit("supervisor", "replica_abandoned",
+                           replica=r.id, rebuilds=n)
+            return
+        last = self._last_rebuild.get(r.id)
+        if (last is not None and time.perf_counter() - last
+                < self.config.rebuild_backoff_s):
+            return
+        self._rebuild(r)
+
+    # -- rebuild -----------------------------------------------------------
+
+    def _rebuild(self, r) -> None:
+        from .sharded import build_sharded_engine
+
+        router = self.router
+        old = r.engine
+        spec = old.rebuild_spec
+        if spec is None:
+            self._gave_up.add(r.id)
+            EVENT_LOG.emit("supervisor", "replica_abandoned",
+                           replica=r.id, reason="no rebuild_spec")
+            return
+        gen = r.generation + 1
+        t0 = time.perf_counter()
+        EVENT_LOG.emit("supervisor", "replica_rebuilding", replica=r.id,
+                       generation=gen,
+                       rebuilds=self._rebuilds.get(r.id, 0))
+        # kill first: fails over / quarantines every unfinished request
+        # and joins the scheduler, so nothing races the rebuild.  The
+        # zombie case (hung dispatch that outlives the join timeout) is
+        # fenced by attempt/identity, not by waiting for it.
+        router.kill_replica(r.id, timeout=self.config.kill_timeout_s)
+        self.incarnation_reports.setdefault(r.id, []).append(
+            list(old.sanitizer_report))
+        kw = dict(spec)
+        adapters = kw.pop("adapters")
+        ec = kw.get("engine_config") or EngineConfig()
+        eng = build_sharded_engine(
+            **kw,
+            metrics=ServingMetrics(ec.max_batch_size, register=False),
+            adapters=None if adapters is None else adapters.clone())
+        # next incarnation must re-clone from the live store too
+        eng.rebuild_spec["adapters"] = adapters
+        eng.start()
+        self._warm(eng)
+        with router._lock:
+            r.engine = eng
+            r.dead = False
+            r.draining = False
+            r.generation = gen
+            router._wire_ship_handler(r)
+            self.rebuilt_total += 1
+            self._rebuilds[r.id] = self._rebuilds.get(r.id, 0) + 1
+            self._last_rebuild[r.id] = time.perf_counter()
+            self._last_swap = time.perf_counter()
+        router.trace.add("rebuild", t0, time.perf_counter(),
+                         args={"replica": r.id, "generation": gen})
+        EVENT_LOG.emit("supervisor", "replica_rejoined", replica=r.id,
+                       generation=gen,
+                       rebuild_s=round(time.perf_counter() - t0, 3))
+
+    def _warm(self, eng) -> None:
+        """Run the warm set through the fresh engine before it rejoins
+        rotation: compiles happen here, outside the serving window."""
+        specs = self.config.warm_specs
+        if specs is None:
+            specs = [{"prompt": [0, 1, 2, 3], "max_new_tokens": 2}]
+        handles = eng.submit_many([dict(s) for s in specs])
+        for h in handles:
+            h.result(timeout=self.config.warm_timeout_s)
+        # the speculative verify executable only compiles once the
+        # drafter actually engages, and the n-gram drafter can't engage
+        # on a non-repetitive warm request (the trailing n-gram always
+        # ends in a freshly *generated* token, so no prompt shape can
+        # guarantee a match).  Probe with ``spec_force`` — draft even
+        # without a match; verify is lossless so the junk draft is just
+        # rejected — so the multi-second verify compile cannot land
+        # mid-serve and read as a wedged iteration to the watchdog.
+        if getattr(eng.config, "spec_draft_len", 0) > 0:
+            probe = {"prompt": [3, 4, 5, 6], "max_new_tokens": 4,
+                     "use_eos_stop": False, "spec_force": True}
+            for h in eng.submit_many([probe]):
+                h.result(timeout=self.config.warm_timeout_s)

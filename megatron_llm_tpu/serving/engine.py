@@ -133,6 +133,7 @@ from ..models import model as model_lib
 from ..obs.logging import EVENT_LOG
 from ..obs.trace import TraceRecorder, device_annotation
 from ..ops.lora import arena_sr, slot_mask
+from ..resilience.chaos import chaos
 from .adapters.registry import AdapterRegistry
 from .block_pool import BlockPool
 from .metrics import ServingMetrics
@@ -271,7 +272,9 @@ class FinishedRequest:
     tokens: List[int]             # prompt + generated (EOS included)
     prompt_len: int
     finish_reason: str            # "eos" | "length" | "cancelled" |
-    #                               "timeout" | "error"
+    #                               "timeout" | "error" | "quarantined"
+    #                               (router: crash-correlated across >= 2
+    #                               replica incarnations, not resubmitted)
     logprobs: Optional[List[float]] = None  # [len-1] incl. prompt positions
 
 
@@ -314,7 +317,8 @@ class _Request:
                  use_eos_stop: bool = True, return_logprobs: bool = False,
                  on_token: Optional[Callable[[int], None]] = None,
                  deadline_s: Optional[float] = None,
-                 adapter_id: Optional[str] = None):
+                 adapter_id: Optional[str] = None,
+                 spec_force: bool = False):
         self.id = next(self._ids)
         self.rid = f"req-{self.id}"  # correlation id: every log line and
         #                              trace span of this request carries it
@@ -334,6 +338,12 @@ class _Request:
         # multi-tenant LoRA: which registered adapter decorates the base
         # model for this request; None = the base model alone
         self.adapter_id = adapter_id
+        # warm-probe knob: propose a draft even without an n-gram match
+        # (verify is lossless — a wrong draft is simply rejected), so a
+        # rebuilt engine can compile the verify executable outside the
+        # serving window instead of on the first organically repetitive
+        # request mid-serve
+        self.spec_force = bool(spec_force)
 
         self.generated: List[int] = []
         self.logprobs: List[float] = []
@@ -946,6 +956,16 @@ class ServingEngine:
             _prefill_chunk_plain if jax.default_backend() == "cpu"
             else _prefill_chunk_donated)
         self._thread: Optional[threading.Thread] = None
+        # per-iteration scheduler heartbeat (perf_counter).  A live thread
+        # wedged inside a device dispatch stops refreshing it — the
+        # cluster supervisor's watchdog compares its age against
+        # hang_timeout_s, which thread-liveness probes cannot see.
+        self.heartbeat: float = time.perf_counter()
+        # cluster rebuild recipe (cfg/params/devices/...) attached by the
+        # sharded.py builders; ReplicaSupervisor uses it to rebuild this
+        # replica on its original submesh after a crash.  None for engines
+        # built outside a cluster.
+        self.rebuild_spec: Optional[dict] = None
         self._admitting: Optional[_Request] = None  # popped, not yet slotted
         self._held: Optional[_Request] = None  # popped but parked: the pool
         #                               could not reserve its worst-case
@@ -1295,6 +1315,8 @@ class ServingEngine:
     def _loop_body(self) -> None:
         try:
             while not self._stop.is_set():
+                self.heartbeat = time.perf_counter()
+                chaos().point("serve-step")
                 # Control ops (shipment installs / migration extractions)
                 # and cancellations/deadline expiry run even while paused:
                 # a paused engine must not hold expired requests — or the
@@ -1361,6 +1383,14 @@ class ServingEngine:
                 box["done"].set()
             self._stop.set()
             self._notify_drain()
+        except BaseException as e:  # noqa: BLE001 — a hard crash
+            # (chaos SimulatedCrash &c.) tears through cleanup the way
+            # SIGKILL would: record it so probes/crash-correlation see the
+            # cause, then die WITHOUT failing requests — they stay
+            # unfinished exactly like after a real kill, and the router's
+            # probe thread fails them over (or quarantines them).
+            self._scheduler_error = e
+            self._stop.set()
 
     def _drain_cancellations(self) -> None:
         for slot in [s for s, st in self._active.items()
@@ -1461,6 +1491,10 @@ class ServingEngine:
             return req
         req = self.queue.pop()
         if req is not None:
+            # keyed on the resolved seed, which — unlike the rid — is
+            # stable across failover resubmits: a poison request armed
+            # here crashes every incarnation that admits it
+            chaos().point(f"serve-admit:{req.seed}")
             self._note_dequeued(req)
             self.metrics.set_gauges(queue_depth=len(self.queue))
         return req
@@ -1781,6 +1815,7 @@ class ServingEngine:
         it0 = time.perf_counter()
         t = self.metrics.timers("serving-decode", 2)
         t.start()
+        chaos().maybe_hang("serve-dispatch")
         inflight = self._dispatch_decode()
         prev, self._inflight = self._inflight, inflight
         wait_s = 0.0
@@ -1834,13 +1869,14 @@ class ServingEngine:
         for st in self._active.values():
             if not st.req.greedy or st.count > st.req.max_new_tokens - 2:
                 continue
-            if self._spec_budget(st) < 1:
+            if not st.req.spec_force and self._spec_budget(st) < 1:
                 st.spec_stall += 1
                 continue
-            if self._draft_enabled:
+            if self._draft_enabled or st.req.spec_force:
                 # a resident draft model always has something to propose
                 # (no n-gram match required), so a budgeted greedy slot
-                # is enough to pay for the flush
+                # is enough to pay for the flush; a spec_force warm
+                # probe likewise always drafts (``_build_drafts``)
                 want = True
             elif _ngram_draft_host(st.req.prompt + st.req.generated,
                                    self.config.spec_ngram, 1):
@@ -1858,12 +1894,21 @@ class ServingEngine:
             if not st.req.greedy:
                 continue
             rem = st.req.max_new_tokens - len(st.req.generated)
-            k_cap = min(self.config.spec_draft_len, self._spec_budget(st),
-                        rem - 1)
+            budget = (self.config.spec_draft_len if st.req.spec_force
+                      else self._spec_budget(st))
+            k_cap = min(self.config.spec_draft_len, budget, rem - 1)
             if k_cap < 1:
                 continue
             d = _ngram_draft_host(st.req.prompt + st.req.generated,
                                   self.config.spec_ngram, k_cap)
+            if not d and st.req.spec_force:
+                # no organic match — repeat the last committed token.
+                # The draft is almost surely rejected, but verify commits
+                # the correct base token anyway (speculation is
+                # lossless), and the verify executable gets compiled,
+                # which is the whole point of the probe.
+                ctx = st.req.prompt + st.req.generated
+                d = [int(ctx[-1])] * k_cap
             if d:
                 drafts[slot] = d
                 st.spec_stall = 0
@@ -2646,7 +2691,19 @@ class ServingEngine:
             return
         if self._active.get(slot) is None:  # retired on its first token
             return
-        ship = self._extract_slot(slot)
+        try:
+            ship = self._extract_slot(slot)
+        except OSError as e:  # export I/O failed BEFORE any ledger
+            # mutation (_extract_slot exports first): the slot is intact,
+            # the request simply keeps decoding here
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "KV export failed; decoding slot %d locally: %r", slot, e)
+            self.metrics.inc("ship_failures_total")
+            EVENT_LOG.emit("engine", "ship_export_failed", slot=slot,
+                           error=repr(e))
+            return
         try:
             self._ship_handler(ship)
         except Exception:  # noqa: BLE001 — last resort: decode locally
@@ -2654,6 +2711,7 @@ class ServingEngine:
 
             logging.getLogger(__name__).exception(
                 "ship handler failed; decoding %s locally", ship.request_id)
+            self.metrics.inc("ship_failures_total")
             self.install_shipment(ship)
             self.slots.pool.end_ship(ship.ship_id)
 
@@ -2680,7 +2738,7 @@ class ServingEngine:
         retiring — so shared prefix blocks stay pinned only by the cache
         itself (the shipment carries a verbatim copy of their rows)."""
         self._flush_inflight()
-        st = self._active.pop(slot)
+        st = self._active[slot]
         req = st.req
         pool = self.slots.pool
         row = self.slots.tables[slot]
@@ -2689,7 +2747,11 @@ class ServingEngine:
             if int(b) == BlockPool.TRASH:
                 break
             bids.append(int(b))
+        # export BEFORE any ledger mutation: an export I/O failure
+        # (chaos "ship-export") propagates with the slot untouched, so
+        # the caller can simply keep decoding here
         k_dense, v_dense = pool.export_blocks(bids, self.slots.table_blocks)
+        self._active.pop(slot)
         nbytes = sum(int(x.nbytes)
                      for x in jax.tree.leaves((k_dense, v_dense)))
         ship_id = f"ship-{next(_SHIP_IDS)}"
@@ -2728,6 +2790,11 @@ class ServingEngine:
         batch composition, or which engine runs the step."""
         req: _Request = ship.meta["req"]
         pool = self.slots.pool
+        if req.adapter_id is not None:
+            # chaos site BEFORE any allocation: an injected adapter-
+            # install failure propagates with this engine's ledger
+            # untouched, same contract as a real registry refusal below
+            chaos().io_attempt("adapter-install")
         slot = self.slots.alloc()
         if slot is None:
             raise RuntimeError("no free slot for shipment install")
@@ -2769,7 +2836,18 @@ class ServingEngine:
         self.slots.tables[slot] = table
         # pad columns of the dense payload carry the source's trash
         # garbage; scattering them into our trash block is a no-op
-        pool.import_blocks(ship.k_dense, ship.v_dense, table)
+        try:
+            pool.import_blocks(ship.k_dense, ship.v_dense, table)
+        except Exception:
+            # import I/O failed (chaos "ship-import" on the device_put
+            # path): unwind — release drops the freshly alloc'd blocks
+            # and the unused reservation, leaving this ledger balanced;
+            # the shipment's own refs still pin the source blocks, so
+            # the router's reinstall-at-source fallback stays safe
+            self._release_adapter(req)
+            self.slots.release(slot)
+            self._update_pool_gauges()
+            raise
         st = _SlotState(req, fill=ship.meta["fill"],
                         pending=ship.meta["pending"])
         st.count = ship.meta["count"]

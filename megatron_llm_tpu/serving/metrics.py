@@ -151,7 +151,11 @@ _COUNTERS = (
     # tracking prefills is the disaggregation working; a persistent gap
     # between a cluster's summed ships_out and ships_in means shipments
     # are falling back to local decode (check router ship_failed events).
-    "ships_out_total", "ships_in_total",
+    # ship_failures_total counts this engine's own fallbacks: KV exports
+    # that failed before moving anything plus handoffs the router could
+    # not place (both decode locally — availability cost, never a
+    # correctness one).
+    "ships_out_total", "ships_in_total", "ship_failures_total",
 )
 
 # (attribute, prometheus family name, help) for the latency reservoirs

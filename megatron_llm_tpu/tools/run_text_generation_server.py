@@ -241,6 +241,20 @@ def main(argv=None) -> int:
                          "an externally assembled disaggregated cluster "
                          "(reported by GET /cluster); --disagg sets "
                          "roles per replica itself")
+    ap.add_argument("--supervise", action="store_true",
+                    help="cluster self-healing (docs/robustness.md "
+                         "'Cluster self-healing'): a ReplicaSupervisor "
+                         "rebuilds crashed replicas on their original "
+                         "submesh, re-warms them off-rotation, and "
+                         "rejoins them at a bumped generation; requires "
+                         "a router front-end (--router / --replicas / "
+                         "--disagg)")
+    ap.add_argument("--hang_timeout_s", type=float, default=10.0,
+                    help="hung-step watchdog: a replica whose scheduler "
+                         "iteration heartbeat is staler than this while "
+                         "its thread is alive is declared wedged, "
+                         "killed, and rebuilt (0 disables; only with "
+                         "--supervise)")
     args = ap.parse_args(argv)
 
     from ..checkpointing import load_params_for_inference
@@ -309,6 +323,9 @@ def main(argv=None) -> int:
                      "--allow_random_draft for smoke tests")
 
     cluster = args.replicas > 1 or args.router or args.disagg is not None
+    if args.supervise and not cluster:
+        ap.error("--supervise needs a router front-end; add --router, "
+                 "--replicas N, or --disagg N:M")
     mesh_ctx = None
     if args.disagg is not None:
         print(f"disaggregated cluster: {args.disagg} prefill:decode "
@@ -371,7 +388,13 @@ def main(argv=None) -> int:
         replicas=args.replicas,
         router=args.router,
         disagg=args.disagg,
-        role=args.role)
+        role=args.role,
+        supervise=args.supervise,
+        hang_timeout_s=args.hang_timeout_s)
+    if args.supervise:
+        print(f"self-healing: replica supervisor armed "
+              f"(hang_timeout_s={args.hang_timeout_s}; "
+              "docs/robustness.md 'Cluster self-healing')")
     if prefix_blocks:
         block_tokens = args.prefill_chunk or max(1, args.prefill_bucket)
         print(f"prefix cache: {prefix_blocks} blocks x {block_tokens} "
