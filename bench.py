@@ -755,6 +755,32 @@ def _serving_lora_point():
         gen_len=gen_len, slots=8, n_adapters=8, cache_slots=4, rank=8)
 
 
+def _serving_tiered_point():
+    """Tiered-KV serving point (serving/block_pool.py:HostKVTier,
+    docs/serving.md "Tiered KV"): mixed-QoS traffic — low-priority batch
+    decodes whose worst-case reservation covers the whole (deliberately
+    small) device pool, plus high-priority interactive arrivals — with a
+    host-RAM tier vs the queue-head-parking baseline at identical
+    geometry.  Gates: ``serving_tiered_qps_ratio`` — interactive-class
+    sustained QPS, tiered over parking (acceptance ≥ 1.5x: preemption
+    serves the interactive class immediately instead of wedging it
+    behind a batch decode) — and the interactive ITL p50 pair feeding
+    tiered_overhead_check (swap pumping may cost ≤ 5% ITL p50)."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.serving.bench import run_tiered_serving_bench
+
+    batch_prompt_len, batch_gen_len = 64, 128
+    cfg = _bench_model(batch_prompt_len + batch_gen_len, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return run_tiered_serving_bench(
+        cfg, params, num_interactive=10, num_batch=2,
+        interactive_prompt_len=32, interactive_gen_len=16,
+        batch_prompt_len=batch_prompt_len, batch_gen_len=batch_gen_len,
+        kv_block_size=32, slots=4)
+
+
 def _transient_error_types():
     """The error classes worth retrying: the axon-tunneled compile service
     occasionally throws a transient remote-compile XlaRuntimeError.
@@ -829,7 +855,12 @@ _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
                      # admission stopped reusing residency); the ITL
                      # overhead gate rides separately in
                      # lora_overhead_check because smaller is better there
-                     "serving_lora.serving_lora_cache_hit_rate")
+                     "serving_lora.serving_lora_cache_hit_rate",
+                     # tiered KV: interactive-class QPS with host-RAM
+                     # preemption over the queue-head-parking baseline
+                     # (≥ 1.5x acceptance); the swap-overhead ITL gate
+                     # rides separately in tiered_overhead_check
+                     "serving_tiered.serving_tiered_qps_ratio")
 _REGRESSION_TOLERANCE = 0.10
 # Tracing must stay effectively free on the serving hot path: the mixed
 # point's ITL p50 with the span recorder on may exceed the untraced rerun
@@ -839,6 +870,10 @@ _TRACE_OVERHEAD_TOLERANCE = 0.10
 # registry is attached; serving_lora's resident-adapter ITL p50 may
 # exceed the adapter-less engine's by at most this fraction.
 _LORA_OVERHEAD_TOLERANCE = 0.10
+# Demote copies pump through the scheduler host phase; serving_tiered's
+# interactive ITL p50 with the host tier on may exceed the parking
+# baseline's by at most this fraction.
+_TIERED_OVERHEAD_TOLERANCE = 0.05
 
 # Bumped when the record's shape changes (new points / renamed keys) so
 # --compare across old records is interpretable.
@@ -854,7 +889,10 @@ _LORA_OVERHEAD_TOLERANCE = 0.10
 # v8: + serving_lora point (multi-tenant LoRA: resident-adapter ITL vs
 #     adapter-less base engine + LRU arena hit rate under tenant
 #     rotation)
-_BENCH_SCHEMA_VERSION = 8
+# v9: + serving_tiered point (tiered KV: interactive-class QPS with
+#     host-RAM preemption vs queue-head parking + the swap-overhead ITL
+#     pair)
+_BENCH_SCHEMA_VERSION = 9
 
 
 def _run_metadata(platform: str, device_count: int) -> dict:
@@ -958,6 +996,29 @@ def lora_overhead_check(record: dict):
     return line, ok
 
 
+def tiered_overhead_check(record: dict):
+    """→ (line, ok): the tiered-KV swap-overhead gate.  The
+    serving_tiered point records interactive ITL p50 with the host tier
+    on against the parking baseline at identical geometry; keeping the
+    tier on is only acceptable while pumping demote copies through the
+    scheduler host phase costs at most _TIERED_OVERHEAD_TOLERANCE of
+    interactive ITL p50 (``--host_kv_blocks 0`` — which removes the
+    tier and the pump entirely — is the escape hatch if this trips)."""
+    st = record.get("serving_tiered") or {}
+    tiered = st.get("serving_tiered_itl_ms_p50")
+    base = st.get("serving_tiered_parked_itl_ms_p50")
+    if not tiered or not base:
+        return ("# tiered-overhead gate: skipped "
+                "(no tiered/parked ITL pair in record)"), True
+    overhead = tiered / base - 1.0
+    ok = tiered <= (1.0 + _TIERED_OVERHEAD_TOLERANCE) * base
+    line = (f"# tiered-overhead gate: serving_tiered_itl_ms_p50 {tiered:g} "
+            f"with host tier vs {base:g} parked ({overhead:+.1%}, limit "
+            f"+{_TIERED_OVERHEAD_TOLERANCE:.0%})"
+            + ("" if ok else "  << REGRESSION"))
+    return line, ok
+
+
 def compare_records(prev: dict, cur: dict):
     """Per-metric deltas between two BENCH records → (lines, regressed).
 
@@ -1018,13 +1079,18 @@ def _run_compare(prev_path: str, cur_record: dict) -> int:
     print(trace_line, flush=True)
     lora_line, lora_ok = lora_overhead_check(cur_record)
     print(lora_line, flush=True)
-    if regressed or not trace_ok or not lora_ok:
+    tiered_line, tiered_ok = tiered_overhead_check(cur_record)
+    print(tiered_line, flush=True)
+    if regressed or not trace_ok or not lora_ok or not tiered_ok:
         if regressed:
             print(f"# REGRESSED: {', '.join(regressed)}", flush=True)
         if not trace_ok:
             print("# REGRESSED: tracing overhead over limit", flush=True)
         if not lora_ok:
             print("# REGRESSED: LoRA epilogue overhead over limit",
+                  flush=True)
+        if not tiered_ok:
+            print("# REGRESSED: tiered-KV swap overhead over limit",
                   flush=True)
         return 1
     print("# no headline regression", flush=True)
@@ -1071,6 +1137,8 @@ def _child_main(spec_json: str) -> None:
         out = _retry(_serving_paged_point)
     elif kind == "serving_lora":
         out = _retry(_serving_lora_point)
+    elif kind == "serving_tiered":
+        out = _retry(_serving_tiered_point)
     elif kind == "serving_spec":
         out = _retry(_serving_spec_point)
     elif kind == "serving_spec_tree":
@@ -1277,6 +1345,10 @@ def main() -> None:
                           {"kind": "serving_lora",
                            "platform": platform},
                           timeout_s=1800)
+    serving_tiered = _point("serving/tiered",
+                            {"kind": "serving_tiered",
+                             "platform": platform},
+                            timeout_s=1800)
     # headline quoted at 7B width (decode_7b geometry) so the
     # beat-the-PLD-ceiling claim holds at deployment matmul shapes; on
     # CPU the wide model would blow the point timeout, so the simulated
@@ -1369,6 +1441,8 @@ def main() -> None:
         record["serving_spec"] = serving_spec
     if serving_lora is not None:
         record["serving_lora"] = serving_lora
+    if serving_tiered is not None:
+        record["serving_tiered"] = serving_tiered
     if serving_spec_tree is not None:
         record["serving_spec_tree"] = serving_spec_tree
     if serving_cluster is not None:

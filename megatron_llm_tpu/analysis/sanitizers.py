@@ -439,6 +439,57 @@ class LedgerSanitizer:
                     owners.setdefault(int(bid), []).append(label)
         return owners
 
+    def _expected_host(self, engine) -> Dict[int, str]:
+        """Host-tier block id -> owner label (tiered KV).
+
+        Host-resident blocks are first-class owners: every arena row the
+        tier has handed out must be accounted to either a suspended
+        (preempted) request or a spilled prefix-cache node — including
+        rows whose D2H copy is still in flight."""
+        owners: Dict[int, str] = {}
+        for sus in getattr(engine, "_suspended", {}).values():
+            for hid in sus.hids:
+                owners[int(hid)] = sus.req.rid
+        cache = getattr(engine, "prefix_cache", None)
+        if cache is not None:
+            stack = list(cache._root.children.values())
+            while stack:
+                node = stack.pop()
+                if getattr(node, "hid", None) is not None:
+                    owners[int(node.hid)] = "prefix-cache"
+                stack.extend(node.children.values())
+        return owners
+
+    def _check_host_tier(self, engine, fail) -> None:
+        tier = getattr(engine, "host_tier", None)
+        if tier is None:
+            return
+        free = [int(h) for h in tier._free]
+        if len(free) != len(set(free)):
+            dup = sorted(h for h in set(free) if free.count(h) > 1)
+            fail(f"host free list contains duplicates: {dup} "
+                 "(double host free)")
+        used = set(tier._owner)
+        if used & set(free):
+            fail(f"host blocks both owned and free: "
+                 f"{sorted(used & set(free))}")
+        if len(free) + len(used) != tier.n_host_blocks:
+            fail(f"host conservation broken: {len(free)} free + "
+                 f"{len(used)} owned != {tier.n_host_blocks} host blocks")
+        stray = tier._inflight_hids - used
+        if stray:
+            fail(f"host blocks in flight but unowned: {sorted(stray)}")
+        expected = self._expected_host(engine)
+        for hid in sorted(used | set(expected)):
+            have = tier._owner.get(hid)
+            want = expected.get(hid)
+            if have is None:
+                fail(f"host block {hid} accounted to {want!r} but the "
+                     "tier does not own it — use-after-free hazard")
+            elif want is None:
+                fail(f"host block {hid} owned by {have!r} but no engine "
+                     "state accounts for it — leaked host block")
+
     # -- the per-iteration check ---------------------------------------
     def check_engine(self, engine) -> None:
         slots = engine.slots
@@ -492,6 +543,7 @@ class LedgerSanitizer:
             fail(f"{len(shipments)} shipments in flight exceeds "
                  f"{slots.num_slots} slots — shipments are not being "
                  "reconciled (end_ship missing)")
+        self._check_host_tier(engine, fail)
         self.owners = owners
         self.checks += 1
 
@@ -515,4 +567,15 @@ class LedgerSanitizer:
                     "accounted": want,
                     "last_owners": list(self.owners.get(bid, [])),
                 })
+        tier = getattr(engine, "host_tier", None)
+        if tier is not None:
+            expected = self._expected_host(engine)
+            for hid, label in sorted(tier._owner.items()):
+                if hid not in expected:
+                    report.append({
+                        "block": f"host:{hid}",
+                        "ref": 1,
+                        "accounted": 0,
+                        "last_owners": [label],
+                    })
         return report

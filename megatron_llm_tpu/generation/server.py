@@ -70,6 +70,8 @@ class GenerationService:
                  prefix_cache_blocks: int | None = None,
                  kv_block_size: int | None = None,
                  kv_pool_blocks: int | None = None,
+                 host_kv_blocks: int = 0,
+                 default_priority: int = 0,
                  spec_draft_len: int = 0,
                  spec_ngram: int = 3,
                  spec_reprobe_interval: int | None = None,
@@ -121,6 +123,13 @@ class GenerationService:
         # (docs/serving.md, 'Paged KV cache')
         self.kv_block_size = kv_block_size
         self.kv_pool_blocks = kv_pool_blocks
+        # tiered KV (docs/serving.md, 'Tiered KV'): host-RAM arena in
+        # blocks backing prefix spill, decode preemption, and
+        # oversubscribed admission; 0 disables the tier
+        self.host_kv_blocks = host_kv_blocks
+        # QoS class for requests that don't send a "priority" JSON field
+        # (higher preempts lower when the tier is enabled)
+        self.default_priority = default_priority
         # engine-side speculative decoding (serving/engine.py): per-slot
         # n-gram drafts checked by a batched verify step; 0 disables.
         # Distinct from the one-shot PLD path behind ``speculative="pld"``
@@ -200,6 +209,8 @@ class GenerationService:
                     extra["kv_block_size"] = self.kv_block_size
                 if self.kv_pool_blocks is not None:
                     extra["kv_pool_blocks"] = self.kv_pool_blocks
+                if self.host_kv_blocks:
+                    extra["host_kv_blocks"] = self.host_kv_blocks
                 if self.spec_reprobe_interval is not None:
                     extra["spec_reprobe_interval"] = \
                         self.spec_reprobe_interval
@@ -414,6 +425,11 @@ class GenerationService:
         if not isinstance(no_early_term, bool):
             return 400, "no_early_termination must be a boolean value"
 
+        priority = body.get("priority", self.default_priority)
+        if not isinstance(priority, int) or isinstance(priority, bool):
+            return 400, "priority must be an integer (higher = sooner; " \
+                        "may preempt lower classes under tiered KV)"
+
         beam_width = body.get("beam_width", None)
         if beam_width is not None:
             if not isinstance(beam_width, int) or beam_width < 1:
@@ -451,11 +467,12 @@ class GenerationService:
         return self._handle_generate(
             prompts, tokens_to_generate, logprobs=logprobs, top_k=top_k,
             top_p=top_p, temperature=temperature, add_BOS=add_BOS,
-            use_eos_stop=not no_early_term, random_seed=random_seed)
+            use_eos_stop=not no_early_term, random_seed=random_seed,
+            priority=priority)
 
     def _handle_generate(self, prompts, tokens_to_generate, *, logprobs,
                          top_k, top_p, temperature, add_BOS, use_eos_stop,
-                         random_seed):
+                         random_seed, priority=0):
         """Standard generation through the continuous-batching engine.
 
         Keeps the legacy batch contract: the shared buffer is
@@ -529,7 +546,8 @@ class GenerationService:
                 eos_id=self.tokenizer.eod,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 seed=(None if random_seed < 0 else random_seed + i),
-                use_eos_stop=use_eos_stop, return_logprobs=logprobs))
+                use_eos_stop=use_eos_stop, return_logprobs=logprobs,
+                priority=priority))
         try:
             handles = self.engine.submit_many(specs)
         except QueueFull as e:

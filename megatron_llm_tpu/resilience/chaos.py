@@ -22,6 +22,12 @@ test arms the process-global controller:
 Every armed controller lives in one process; tests reset it between
 cases (``tests/resilience/conftest.py``).  The hooks cost two dict
 lookups when disarmed, so the instrumentation stays in production code.
+
+Serving I/O sites of note: ``ship-export`` / ``ship-import`` (KV
+shipments, PR 12) and the tiered-KV pair ``host-swap-out`` /
+``host-swap-in`` — a demote faults BEFORE any state mutates (the device
+copy is never lost), a promote faults before the device import (the host
+copy stays resident for the re-fetch).
 """
 
 from __future__ import annotations

@@ -581,6 +581,151 @@ def run_paged_serving_bench(cfg, params, *, num_requests: int = 12,
     }
 
 
+def run_tiered_serving_bench(cfg, params, *, num_interactive: int = 10,
+                             num_batch: int = 2,
+                             interactive_prompt_len: int = 32,
+                             interactive_gen_len: int = 16,
+                             batch_prompt_len: int = 64,
+                             batch_gen_len: int = 128,
+                             kv_block_size: int = 32, slots: int = 4,
+                             seed: int = 0) -> dict:
+    """Tiered-KV point: mixed-QoS traffic on a deliberately SMALL device
+    pool, host tier on vs off (docs/serving.md "Tiered KV").
+
+    Geometry: one low-priority batch request's worst-case reservation
+    covers the ENTIRE usable pool, so a high-priority interactive
+    arrival can never reserve alongside it.  Without a host tier the
+    interactive request parks at the queue head — and, FIFO being
+    FIFO, wedges every arrival behind it until the batch decode retires
+    (the pre-tier behavior).  With ``host_kv_blocks`` the arrival
+    preempts the batch decode to host RAM, the interactive class runs
+    batched, and the victim resumes bitwise when the pool drains.
+
+    Both runs use identical engine geometry and the identical request
+    stream; only ``host_kv_blocks`` differs.  Headlines:
+    ``serving_tiered_qps_ratio`` — sustained interactive-class QPS
+    (completions / wall-clock from first interactive submit to last
+    interactive finish), tiered over parking; acceptance ≥ 1.5x — and
+    the interactive ITL p50 pair for the swap-overhead gate
+    (tiered_overhead_check in --compare: pumping demote copies through
+    the scheduler host phase may cost at most 5% of interactive ITL
+    p50).
+    """
+    import threading
+
+    import numpy as np
+
+    from .engine import EngineConfig, ServingEngine
+    from .metrics import ServingMetrics
+
+    rng = np.random.default_rng(seed)
+    bk = int(kv_block_size)
+    max_seq = batch_prompt_len + batch_gen_len
+    assert max_seq % bk == 0
+    pool_blocks = 1 + max_seq // bk      # + trash: batch req == whole pool
+    host_blocks = 2 * (max_seq // bk)    # tier holds two suspended victims
+    batch_prompts = [rng.integers(1, cfg.vocab_size,
+                                  batch_prompt_len).tolist()
+                     for _ in range(num_batch)]
+    inter_prompts = [rng.integers(1, cfg.vocab_size,
+                                  interactive_prompt_len).tolist()
+                     for _ in range(num_interactive)]
+
+    def one_run(host: int) -> dict:
+        engine = ServingEngine(cfg, params, EngineConfig(
+            max_batch_size=slots,
+            max_seq_len=max_seq,
+            max_queue_size=num_interactive + num_batch + 2,
+            prefill_bucket=min(64, bk),
+            prefill_chunk=min(64, bk),
+            kv_block_size=bk,
+            kv_pool_blocks=pool_blocks,
+            host_kv_blocks=host,
+            prefix_cache_blocks=0,     # isolate the tier from cache hits
+        )).start()
+        itl, make_stream = _itl_recorder()
+        try:
+            # warmup compiles both prompt-length buckets AND (tiered run)
+            # the preempt/resume export+import executables, by replaying
+            # the measured pattern once: low-pri batch decode, then a
+            # high-pri arrival that must preempt it
+            started = threading.Event()
+            # full batch_gen_len so the warm victim reserves the WHOLE
+            # pool — the warm interactive then actually preempts (and
+            # later resumes) it, compiling export + import off the clock
+            wb = engine.submit(batch_prompts[0],
+                               max_new_tokens=batch_gen_len,
+                               use_eos_stop=False, priority=0,
+                               on_token=lambda _t: started.set())
+            started.wait(timeout=600)
+            engine.submit(inter_prompts[0], max_new_tokens=4,
+                          use_eos_stop=False, priority=1).result(timeout=600)
+            wb.result(timeout=600)
+            engine.metrics = ServingMetrics(slots)
+
+            decoding = threading.Event()
+            batch_handles = [
+                engine.submit(p, max_new_tokens=batch_gen_len,
+                              use_eos_stop=False, priority=0,
+                              on_token=(lambda _t: decoding.set()) if i == 0
+                              else None)
+                for i, p in enumerate(batch_prompts)]
+            decoding.wait(timeout=600)  # batch class owns the pool
+            t0 = time.perf_counter()
+            inter_handles = [
+                engine.submit(p, max_new_tokens=interactive_gen_len,
+                              use_eos_stop=False, priority=1,
+                              on_token=make_stream())
+                for p in inter_prompts]
+            inter_results = [h.result(timeout=600) for h in inter_handles]
+            t_inter = time.perf_counter() - t0
+            batch_results = [h.result(timeout=600) for h in batch_handles]
+            t_all = time.perf_counter() - t0
+        finally:
+            engine.shutdown()
+        n_tokens = sum(len(r.tokens) - r.prompt_len
+                       for r in inter_results + batch_results)
+        snap = engine.metrics.snapshot()
+        return {
+            "interactive_qps": round(num_interactive / t_inter, 3),
+            "itl_ms_p50": round(itl.percentile(50) * 1e3, 3),
+            "itl_ms_p99": round(itl.percentile(99) * 1e3, 3),
+            "tokens_per_sec": round(n_tokens / t_all, 1),
+            "preemptions": snap["preemptions_total"],
+            "resumes": snap["resumes_total"],
+            "swap_out_blocks": snap["swap_out_blocks_total"],
+            "swap_in_blocks": snap["swap_in_blocks_total"],
+            "swap_gb": round(snap["swap_bytes_total"] / 1e9, 4),
+        }
+
+    tiered = one_run(host_blocks)
+    parked = one_run(0)   # pre-tier behavior: queue-head parking
+    return {
+        "serving_tiered_qps": tiered["interactive_qps"],
+        "serving_tiered_parked_qps": parked["interactive_qps"],
+        "serving_tiered_qps_ratio": round(
+            tiered["interactive_qps"]
+            / max(1e-9, parked["interactive_qps"]), 3),
+        "serving_tiered_itl_ms_p50": tiered["itl_ms_p50"],
+        "serving_tiered_parked_itl_ms_p50": parked["itl_ms_p50"],
+        "serving_tiered_itl_ms_p99": tiered["itl_ms_p99"],
+        "serving_tiered_tokens_per_sec": tiered["tokens_per_sec"],
+        "serving_tiered_parked_tokens_per_sec": parked["tokens_per_sec"],
+        "serving_tiered_preemptions": tiered["preemptions"],
+        "serving_tiered_resumes": tiered["resumes"],
+        "serving_tiered_swap_out_blocks": tiered["swap_out_blocks"],
+        "serving_tiered_swap_in_blocks": tiered["swap_in_blocks"],
+        "serving_tiered_swap_gb": tiered["swap_gb"],
+        "serving_tiered_pool_blocks": pool_blocks,
+        "serving_tiered_host_blocks": host_blocks,
+        "serving_tiered_block_size": bk,
+        "serving_tiered_num_interactive": num_interactive,
+        "serving_tiered_num_batch": num_batch,
+        "serving_tiered_interactive_gen_len": interactive_gen_len,
+        "serving_tiered_batch_gen_len": batch_gen_len,
+    }
+
+
 def run_spec_serving_bench(cfg, params, *, num_requests: int = 12,
                            prompt_len: int = 96, gen_len: int = 64,
                            slots: int = 4, draft_len: int = 4,
@@ -1354,6 +1499,13 @@ def main() -> None:
                                        prompt_lens=(8, 32, 128),
                                        gen_len=8, kv_block_size=8,
                                        pool_seqs=2))
+    out.update(run_tiered_serving_bench(cfg, params, num_interactive=4,
+                                        num_batch=1,
+                                        interactive_prompt_len=8,
+                                        interactive_gen_len=6,
+                                        batch_prompt_len=16,
+                                        batch_gen_len=48,
+                                        kv_block_size=8, slots=3))
     out.update(run_spec_serving_bench(cfg, params, num_requests=6,
                                       prompt_len=32, gen_len=16,
                                       slots=2, draft_len=3))

@@ -315,3 +315,244 @@ class BlockPool:
         """Non-zero ref counts by block id (trash excluded)."""
         return {int(b): int(self._ref[b])
                 for b in np.nonzero(self._ref)[0] if b != self.TRASH}
+
+
+class _PendingSwap:
+    """One in-flight demote: dense device staging leaves draining to the
+    host arena.  The *source pool blocks* are already free — the staged
+    gather output owns the bytes — so the device side never waits on the
+    host copy."""
+
+    __slots__ = ("hids", "k_dense", "v_dense", "nbytes", "owner")
+
+    def __init__(self, hids, k_dense, v_dense, nbytes, owner):
+        self.hids = hids
+        self.k_dense = k_dense
+        self.v_dense = v_dense
+        self.nbytes = nbytes
+        self.owner = owner
+
+
+class HostKVTier:
+    """Host-RAM tier of KV blocks behind a device ``BlockPool``.
+
+    Pinned host numpy arenas mirror the pool's leaf pytree with the block
+    axis resized to ``n_host_blocks``; block *contents* move through the
+    same fixed-arity ``export_blocks`` / ``import_blocks`` primitives
+    disaggregated shipping uses (block-table-ordered dense slices, int8
+    ``{q, scale}`` leaves verbatim), so the tier adds ZERO new compiled
+    executables and transfers are bitwise both ways.
+
+    Demotes are asynchronous and double-buffered: ``begin_demote`` issues
+    the device gather and an async host copy, returning immediately with
+    the staged dense leaves owning the bytes — the caller may free the
+    source pool blocks at once, and ``pump`` (called from the scheduler's
+    host phase) drains completed copies into the arena without stalling
+    decode.  Promotes (``promote``) are synchronous: a hit needs the rows
+    now, and the import scatter is one device dispatch.
+
+    Chaos sites: ``host-swap-out`` fires *before* any state mutates, so a
+    fault mid-demote leaves the device copy untouched; ``host-swap-in``
+    fires before the import, so a fault mid-promote leaves the host copy
+    resident for a later re-fetch.
+
+    The tier keeps its own conservation ledger (free list + owner map,
+    audited by the ``LedgerSanitizer``) and measures sustained swap
+    bandwidth (EWMA over completed host copies) so oversubscribed
+    admission can bound itself by what the swap path actually delivers.
+    """
+
+    def __init__(self, pool: BlockPool, n_host_blocks: int, arity: int,
+                 metrics=None, max_backlog_s: float = 0.25):
+        assert n_host_blocks >= 1
+        self.pool = pool
+        self.n_host_blocks = int(n_host_blocks)
+        self.arity = int(arity)
+        self._metrics = metrics  # zero-arg callable or None (engine swaps
+        #                          its metrics object between warmup and
+        #                          measurement, same as PrefixCache)
+        self.max_backlog_s = float(max_backlog_s)
+        bk = pool.block_size
+
+        def arena(leaf):
+            shp = (leaf.shape[0], self.n_host_blocks) + tuple(leaf.shape[2:])
+            return np.zeros(shp, dtype=leaf.dtype)
+
+        self.k_arena = jax.tree.map(arena, pool.k_pool)
+        self.v_arena = jax.tree.map(arena, pool.v_pool)
+        self.block_nbytes = sum(
+            leaf[:, :1].nbytes
+            for leaf in (jax.tree.leaves(self.k_arena)
+                         + jax.tree.leaves(self.v_arena)))
+        self._free: List[int] = list(range(self.n_host_blocks - 1, -1, -1))
+        self._owner: dict = {}          # hid -> owner label
+        self._pending: List[_PendingSwap] = []
+        self._inflight_hids: set = set()
+        # EWMA of measured host-copy bandwidth; optimistic seed so the
+        # first oversubscribed admission is not starved before any
+        # measurement exists.
+        self.bw_bytes_per_s = float("inf")
+        self.swaps_out = 0
+        self.swaps_in = 0
+
+    # -- bookkeeping -------------------------------------------------------
+    @property
+    def host_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def host_used(self) -> int:
+        return self.n_host_blocks - len(self._free)
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._pending)
+
+    def can_store(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def _m(self):
+        m = self._metrics
+        return m() if callable(m) else m
+
+    def owners(self) -> dict:
+        """owner label -> host block count (snapshot / sanitizer)."""
+        out: dict = {}
+        for owner in self._owner.values():
+            out[owner] = out.get(owner, 0) + 1
+        return out
+
+    def free(self, hids: Sequence[int]) -> None:
+        for hid in hids:
+            hid = int(hid)
+            assert hid in self._owner, f"double free of host block {hid}"
+            assert hid not in self._inflight_hids, \
+                f"freeing host block {hid} mid-swap"
+            del self._owner[hid]
+            self._free.append(hid)
+
+    def swap_ok(self) -> bool:
+        """True while the demote backlog is within ``max_backlog_s`` of
+        measured bandwidth — the admission bound for oversubscription."""
+        backlog = sum(p.nbytes for p in self._pending)
+        if backlog == 0:
+            return True
+        if self.bw_bytes_per_s == float("inf"):
+            return len(self._pending) <= 2
+        return backlog / self.bw_bytes_per_s <= self.max_backlog_s
+
+    # -- demote (device -> host), async double-buffered --------------------
+    def begin_demote(self, bids: Sequence[int], owner: str) -> List[int]:
+        """Start swapping ``bids`` out.  Issues the fixed-arity export
+        gather plus an async host copy and returns the host block ids at
+        once; the staged dense leaves own the bytes, so the caller frees
+        the source pool blocks immediately.  Raises ``OSError`` if the
+        ``host-swap-out`` chaos site is armed — *before* any state
+        mutates, so the device copy is never lost."""
+        assert len(bids) >= 1 and len(bids) <= self.arity
+        assert self.can_store(len(bids)), "host tier exhausted"
+        chaos().io_attempt("host-swap-out")
+        k_dense, v_dense = self.pool.export_blocks(bids, self.arity)
+        for leaf in jax.tree.leaves(k_dense) + jax.tree.leaves(v_dense):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        hids = []
+        for _ in bids:
+            hid = self._free.pop()
+            self._owner[hid] = owner
+            self._inflight_hids.add(hid)
+            hids.append(hid)
+        nbytes = self.block_nbytes * len(bids)
+        self._pending.append(_PendingSwap(hids, k_dense, v_dense,
+                                          nbytes, owner))
+        m = self._m()
+        if m is not None:
+            m.inc("swap_out_blocks_total", by=len(bids))
+            m.inc("swap_bytes_total", by=nbytes)
+        self.swaps_out += len(bids)
+        return hids
+
+    def _finalize(self, swap: _PendingSwap) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        bk = self.pool.block_size
+
+        def land(dense, arena):
+            d = np.asarray(dense)  # completes the async copy
+            for i, hid in enumerate(swap.hids):
+                arena[:, hid] = d[:, 0, :, i * bk:(i + 1) * bk]
+
+        jax.tree.map(land, swap.k_dense, self.k_arena)
+        jax.tree.map(land, swap.v_dense, self.v_arena)
+        swap.k_dense = swap.v_dense = None
+        for hid in swap.hids:
+            self._inflight_hids.discard(hid)
+        dt = max(_time.perf_counter() - t0, 1e-9)
+        bw = swap.nbytes / dt
+        self.bw_bytes_per_s = (bw if self.bw_bytes_per_s == float("inf")
+                               else 0.8 * self.bw_bytes_per_s + 0.2 * bw)
+
+    def pump(self, max_swaps: Optional[int] = None) -> int:
+        """Drain completed demote copies into the arena (scheduler host
+        phase).  Returns the number of swaps finalized."""
+        done = 0
+        while self._pending and (max_swaps is None or done < max_swaps):
+            self._finalize(self._pending.pop(0))
+            done += 1
+        return done
+
+    def _ensure_resident(self, hids: Sequence[int]) -> None:
+        want = {int(h) for h in hids}
+        while want & self._inflight_hids:
+            self._finalize(self._pending.pop(0))
+
+    # -- promote (host -> device), synchronous ------------------------------
+    def promote(self, hids: Sequence[int], dest_bids: Sequence[int]) -> None:
+        """Swap host blocks back into freshly allocated pool blocks via
+        the fixed-arity import scatter.  Bitwise: the arena holds the
+        exact exported bytes (int8 ``{q, scale}`` included) and the
+        import path never dequantizes.  Raises ``OSError`` if the
+        ``host-swap-in`` chaos site is armed — the host copy stays
+        resident, so the caller unwinds its device allocations and a
+        later attempt re-fetches."""
+        assert len(hids) == len(dest_bids) and len(hids) <= self.arity
+        self._ensure_resident(hids)
+        chaos().io_attempt("host-swap-in")
+        bk = self.pool.block_size
+
+        def gather(arena):
+            L, _, kv = arena.shape[:3]
+            rest = arena.shape[3:]
+            shp = (L, 1, kv, self.arity * bk) + tuple(rest[1:])
+            dense = np.zeros(shp, dtype=arena.dtype)
+            for i, hid in enumerate(hids):
+                dense[:, 0, :, i * bk:(i + 1) * bk] = arena[:, int(hid)]
+            return dense
+
+        k_dense = jax.tree.map(gather, self.k_arena)
+        v_dense = jax.tree.map(gather, self.v_arena)
+        scatter = np.full(self.arity, BlockPool.TRASH, dtype=np.int32)
+        scatter[:len(dest_bids)] = np.asarray(dest_bids, dtype=np.int32)
+        self.pool.import_blocks(k_dense, v_dense, scatter)
+        nbytes = self.block_nbytes * len(hids)
+        m = self._m()
+        if m is not None:
+            m.inc("swap_in_blocks_total", by=len(hids))
+            m.inc("swap_bytes_total", by=nbytes)
+        self.swaps_in += len(hids)
+
+    # -- introspection ------------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "n_host_blocks": self.n_host_blocks,
+            "host_blocks_used": self.host_used,
+            "host_blocks_free": self.host_free,
+            "swaps_in_flight": self.in_flight,
+            "swap_bw_bytes_per_s": (
+                0.0 if self.bw_bytes_per_s == float("inf")
+                else self.bw_bytes_per_s),
+            "swap_out_blocks": self.swaps_out,
+            "swap_in_blocks": self.swaps_in,
+            "owners": self.owners(),
+        }

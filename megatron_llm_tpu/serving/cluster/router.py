@@ -558,6 +558,10 @@ class Router:
         # again (same seed → same trajectory) and are dropped by count
         rr.skip = rr.delivered
         t0 = time.perf_counter()
+        # rr.spec is the original resolved spec, so a resubmitted request
+        # keeps its QoS class: "priority" rides along verbatim and the
+        # target replica's queue/preemption logic sees the same class the
+        # client asked for (docs/serving.md, 'Tiered KV')
         espec = dict(rr.spec, on_token=_stream(rr, rr.attempt))
         if remaining is not None:
             espec["deadline_s"] = remaining
@@ -585,7 +589,8 @@ class Router:
                              "replayed": rr.skip})
         EVENT_LOG.emit("router", "resubmitted", request_id=handle.rid,
                        prev_request_id=old_rid, from_replica=old_replica,
-                       to_replica=target.id, replayed_tokens=rr.skip)
+                       to_replica=target.id, replayed_tokens=rr.skip,
+                       priority=int(rr.spec.get("priority", 0)))
 
     # -- replica-level operations -----------------------------------------
 
@@ -965,6 +970,8 @@ class Router:
         for r in self.replicas:
             roles[r.role] = roles.get(r.role, 0) + 1
         sup = self.supervisor
+        replica_metrics = [r.engine.metrics.snapshot()
+                           for r in self.replicas]
         return {
             "router": {
                 "replicas": len(self.replicas),
@@ -987,6 +994,11 @@ class Router:
                     0 if sup is None else sup.watchdog_trips_total,
                 "pending": len(self._pending),
                 "sticky_keys": len(self._sticky),
+                # tiered-KV totals summed over replicas (all zero when
+                # no replica runs with host_kv_blocks)
+                **{k: sum(int(s.get(k, 0)) for s in replica_metrics)
+                   for k in ("preemptions_total", "swap_out_blocks_total",
+                             "swap_in_blocks_total", "swap_bytes_total")},
             },
             "shipments_in_flight": list(self._shipments.values()),
             "replicas": [r.probe(burn) for r in self.replicas],

@@ -135,7 +135,7 @@ from ..obs.trace import TraceRecorder, device_annotation
 from ..ops.lora import arena_sr, slot_mask
 from ..resilience.chaos import chaos
 from .adapters.registry import AdapterRegistry
-from .block_pool import BlockPool
+from .block_pool import BlockPool, HostKVTier
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .queue import QueueFull, RequestQueue  # noqa: F401  (re-exported)
@@ -255,6 +255,21 @@ class EngineConfig:
     #                               if any, sizes itself).  Must match
     #                               the registry's n_slots when both are
     #                               set.
+    host_kv_blocks: int = 0       # tiered KV (block_pool.py:HostKVTier):
+    #                               host-RAM KV blocks backing the device
+    #                               pool.  Enables prefix-cache spill
+    #                               (evicted trie leaves demote to host
+    #                               and re-promote on hit), priority
+    #                               preemption (low-priority decodes swap
+    #                               out bitwise and resume later), and
+    #                               oversubscribed admission (admit
+    #                               beyond worst-case HBM reservations
+    #                               against host capacity, bounded by
+    #                               measured swap bandwidth, instead of
+    #                               parking at the queue head).  0 = off.
+    #                               Size it so host_kv_blocks * block
+    #                               bytes fits comfortably in RAM; see
+    #                               docs/serving.md "Tiered KV".
     role: str = "mixed"           # disaggregated prefill/decode
     #                               (docs/serving.md): "prefill" runs a
     #                               request's prefill + first token, then
@@ -318,7 +333,8 @@ class _Request:
                  on_token: Optional[Callable[[int], None]] = None,
                  deadline_s: Optional[float] = None,
                  adapter_id: Optional[str] = None,
-                 spec_force: bool = False):
+                 spec_force: bool = False,
+                 priority: int = 0):
         self.id = next(self._ids)
         self.rid = f"req-{self.id}"  # correlation id: every log line and
         #                              trace span of this request carries it
@@ -344,6 +360,10 @@ class _Request:
         # serving window instead of on the first organically repetitive
         # request mid-serve
         self.spec_force = bool(spec_force)
+        # QoS class (tiered KV): higher wins at the queue, and a pool-
+        # exhausted admission may suspend a STRICTLY lower-priority
+        # decode to the host tier instead of parking.  Default 0.
+        self.priority = int(priority)
 
         self.generated: List[int] = []
         self.logprobs: List[float] = []
@@ -822,6 +842,26 @@ class _SlotState:
         #                           no draft model is resident)
 
 
+class _Suspended:
+    """A decode preempted to the host tier (tiered KV).
+
+    Carries exactly the state ``install_shipment`` carries for a
+    migration — the live ``_Request`` plus fill / RNG-fold count /
+    pending token / speculation EWMA — so a resume rebuilds the slot
+    bitwise: the per-request RNG folds on (seed, count), never on slot
+    or batch identity, and the KV rows round-trip the host arena
+    verbatim (int8 ``{q, scale}`` included)."""
+
+    __slots__ = ("req", "hids", "n_live", "meta", "t_suspend")
+
+    def __init__(self, req, hids, n_live, meta, t_suspend):
+        self.req = req
+        self.hids = hids          # host-tier block ids, table order
+        self.n_live = n_live
+        self.meta = meta          # fill/count/pending/spec state
+        self.t_suspend = t_suspend
+
+
 class _Inflight:
     """A dispatched-but-unprocessed decode step (pipelined mode).
 
@@ -966,6 +1006,11 @@ class ServingEngine:
         # replica on its original submesh after a crash.  None for engines
         # built outside a cluster.
         self.rebuild_spec: Optional[dict] = None
+        # tiered KV (block_pool.py:HostKVTier): built at start() when
+        # host_kv_blocks > 0.  ``_suspended`` maps req.id -> _Suspended
+        # for decodes preempted to the host tier, in suspension order.
+        self.host_tier = None
+        self._suspended: dict[int, _Suspended] = {}
         self._admitting: Optional[_Request] = None  # popped, not yet slotted
         self._held: Optional[_Request] = None  # popped but parked: the pool
         #                               could not reserve its worst-case
@@ -1038,12 +1083,18 @@ class ServingEngine:
                 self.slots = SlotAllocator(self.cfg,
                                            cfg_e.max_batch_size,
                                            cfg_e.max_seq_len, pool)
+                if cfg_e.host_kv_blocks:
+                    self.host_tier = HostKVTier(
+                        pool, cfg_e.host_kv_blocks,
+                        arity=self.slots.table_blocks,
+                        metrics=lambda: self.metrics)
                 if cfg_e.prefix_cache_blocks:
                     self.prefix_cache = PrefixCache(
                         self.cfg, pool=pool,
                         max_blocks=cfg_e.prefix_cache_blocks,
                         max_seq_len=cfg_e.max_seq_len,
-                        metrics=lambda: self.metrics)
+                        metrics=lambda: self.metrics,
+                        host_tier=self.host_tier)
                 from ..ops.quant import precision_route
                 self._precision_route = precision_route(self.params)
                 from ..kernels.decode_step import fused_paged_decode_eligible
@@ -1159,7 +1210,8 @@ class ServingEngine:
     def _is_idle(self) -> bool:
         return (not self._active and self._admitting is None
                 and self._prefilling is None and self._inflight is None
-                and self._held is None and len(self.queue) == 0)
+                and self._held is None and not self._suspended
+                and len(self.queue) == 0)
 
     def _notify_drain(self) -> None:
         with self._drain_cond:
@@ -1173,13 +1225,14 @@ class ServingEngine:
                use_eos_stop: bool = True, return_logprobs: bool = False,
                on_token: Optional[Callable[[int], None]] = None,
                deadline_s: Optional[float] = None,
-               adapter_id: Optional[str] = None) -> RequestHandle:
+               adapter_id: Optional[str] = None,
+               priority: int = 0) -> RequestHandle:
         return self.submit_many([dict(
             prompt=prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
             temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
             use_eos_stop=use_eos_stop, return_logprobs=return_logprobs,
             on_token=on_token, deadline_s=deadline_s,
-            adapter_id=adapter_id)])[0]
+            adapter_id=adapter_id, priority=priority)])[0]
 
     def submit_many(self, specs: Sequence[dict]) -> List[RequestHandle]:
         """Validate + enqueue a batch of requests all-or-nothing.
@@ -1340,6 +1393,11 @@ class ServingEngine:
                     # tokens are all speculative — discard without syncing
                     self._flush_inflight()
                 elif self._prefilling is None:
+                    if (self.host_tier is not None
+                            and self.host_tier.in_flight):
+                        # nothing to decode: drain the swap backlog now
+                        self.host_tier.pump()
+                        continue  # re-check admission (resume/oversubscribe)
                     # idle: queue.notify (submit / drain / shutdown) wakes
                     # this immediately; no sleep-polling
                     self._last_dispatch_t = self._last_ready_t = None
@@ -1370,6 +1428,11 @@ class ServingEngine:
             for slot in list(self._active):
                 st = self._active.pop(slot)
                 self._finish(st.req, "error")
+            for key in list(self._suspended):  # preempted to host tier
+                sus = self._suspended.pop(key)
+                if self.host_tier is not None:
+                    self.host_tier.free(sus.hids)
+                self._finish(sus.req, "error")
             while True:
                 req = self.queue.pop()
                 if req is None:
@@ -1402,6 +1465,9 @@ class ServingEngine:
         if self._held is not None and self._held.cancel_flag.is_set():
             req, self._held = self._held, None
             self._finish(req, "cancelled")
+        for key in [k for k, s in self._suspended.items()
+                    if s.req.cancel_flag.is_set()]:
+            self._discard_suspended(key, "cancelled")
 
     def _abort_prefill(self, reason: str) -> None:
         ps, self._prefilling = self._prefilling, None
@@ -1430,6 +1496,9 @@ class ServingEngine:
         if self._held is not None and expired(self._held):
             req, self._held = self._held, None
             self._finish(req, "timeout")
+        for key in [k for k, s in self._suspended.items()
+                    if expired(s.req)]:
+            self._discard_suspended(key, "timeout")
         for req in self.queue.remove_if(expired):
             self._finish(req, "timeout")
         self.metrics.set_gauges(queue_depth=len(self.queue))
@@ -1440,9 +1509,18 @@ class ServingEngine:
                        request_id=req.rid, tid=req.id,
                        args={"prompt_len": len(req.prompt)})
 
-    def _try_reserve(self, need: int) -> bool:
-        """Reserve ``need`` pool blocks for an admission, squeezing the
-        prefix cache's unpinned blocks first if the pool is tight."""
+    def _try_reserve(self, need: int,
+                     req: Optional[_Request] = None) -> bool:
+        """Reserve ``need`` pool blocks for an admission.
+
+        Escalation order under pool pressure: (1) squeeze the prefix
+        cache's unpinned blocks (which *spill to the host tier* instead
+        of dropping when one is configured); (2) tiered-KV oversubscribed
+        admission — suspend STRICTLY lower-priority active decodes to the
+        host tier, bounded by host capacity and measured swap bandwidth,
+        so the admitted set can exceed worst-case HBM reservations.
+        Queue-head parking is the caller's last resort, not the first
+        response to exhaustion."""
         pool = self.slots.pool
         if pool.reserve(need):
             return True
@@ -1454,7 +1532,36 @@ class ServingEngine:
                     prefix_blocks=self.prefix_cache.blocks)
             if pool.reserve(need):
                 return True
+        if req is not None and self.host_tier is not None:
+            while not pool.can_reserve(need):
+                if not self.host_tier.swap_ok():
+                    break  # swap backlog past the bandwidth bound
+                victim = self._pick_preemption_victim(req.priority)
+                if victim is None:
+                    break
+                before = len(self._active)
+                if (not self._preempt_slot(victim)
+                        and len(self._active) == before):
+                    break  # no progress (demote fault / tier full)
+            if pool.reserve(need):
+                return True
         return False
+
+    def _pick_preemption_victim(self, priority: int) -> Optional[int]:
+        """The active decode to suspend for an admission of ``priority``:
+        lowest priority STRICTLY below it, oldest submit within a class,
+        and its live blocks must fit in the host tier's free space."""
+        best_key, best_slot = None, None
+        for slot, st in self._active.items():
+            if st.req.priority >= priority:
+                continue
+            if not self.host_tier.can_store(
+                    len(self.slots.live_bids(slot))):
+                continue
+            key = (st.req.priority, st.req.submit_time)
+            if best_key is None or key < best_key:
+                best_key, best_slot = key, slot
+        return best_slot
 
     def _acquire_adapter(self, req: _Request) -> Optional[int]:
         """Pin the request's adapter in the device arena.  Returns the
@@ -1501,6 +1608,8 @@ class ServingEngine:
 
     def _admit(self) -> None:
         assert self.slots is not None
+        if self.host_tier is not None:
+            self._maybe_resume()
         if self.config.prefill_chunk:
             self._admit_chunked()
             return
@@ -1572,7 +1681,7 @@ class ServingEngine:
         bk = self.slots.pool.block_size
         n_shared = len(lease.bids) if lease is not None else 0
         need = -(-(plen + req.max_new_tokens) // bk) - n_shared
-        if not self._try_reserve(need):
+        if not self._try_reserve(need, req):
             # pool pressure: park the request (FIFO head) and retry once
             # retirements free blocks; nothing was allocated yet
             if self.prefix_cache is not None:
@@ -1700,7 +1809,7 @@ class ServingEngine:
         bk = self.slots.pool.block_size
         n_shared = len(lease.bids) if lease is not None else 0
         need = -(-(plen + req.max_new_tokens) // bk) - n_shared
-        if not self._try_reserve(need):
+        if not self._try_reserve(need, req):
             if self.prefix_cache is not None:
                 self.prefix_cache.release(lease)
             self._release_adapter(req)
@@ -1818,6 +1927,12 @@ class ServingEngine:
         chaos().maybe_hang("serve-dispatch")
         inflight = self._dispatch_decode()
         prev, self._inflight = self._inflight, inflight
+        if self.host_tier is not None and self.host_tier.in_flight:
+            # host phase of the pipelined step: finalize at most one
+            # queued demote while the device chews on the dispatch — the
+            # D2H copy was issued async at begin_demote, so this is
+            # (usually) just landing already-arrived bytes in the arena
+            self.host_tier.pump(max_swaps=1)
         wait_s = 0.0
         if prev is not None:
             wait_s += self._process_step_results(prev)
@@ -2643,17 +2758,32 @@ class ServingEngine:
         self.metrics.set_gauges(blocks_free=s["blocks_free"],
                                 blocks_used=s["blocks_used"],
                                 kv_cache_util=s["kv_cache_util"])
+        if self.host_tier is not None:
+            self.metrics.set_gauges(
+                host_blocks_used=self.host_tier.host_used,
+                host_blocks_free=self.host_tier.host_free)
 
     def kv_snapshot(self) -> dict:
         """Debug view of the paged KV state (GET /kv,
         tools/dump_kv_pool.py): pool stats, per-slot block tables + fills,
-        ref counts, and fragmentation (live tokens / allocated tokens
-        slack).  Best-effort under concurrent scheduling — served from
-        any thread without locking, like /metrics and /trace."""
+        ref counts, fragmentation (live tokens / allocated tokens slack),
+        and — when a host tier is configured — host arena occupancy plus
+        per-request swapped-out block counts, so the snapshot reports ALL
+        resident KV, not just the HBM share.  Best-effort under
+        concurrent scheduling — served from any thread without locking,
+        like /metrics and /trace."""
         if self.slots is None:
             return {"pool": None, "slots": {}}
         fills = {s: st.fill for s, st in dict(self._active).items()}
-        return self.slots.snapshot(fills)
+        snap = self.slots.snapshot(fills)
+        if self.host_tier is not None:
+            snap["host_tier"] = self.host_tier.stats()
+            snap["host_tier"]["suspended"] = {
+                sus.req.rid: {"blocks": sus.n_live,
+                              "priority": sus.req.priority,
+                              "generated": len(sus.req.generated)}
+                for sus in list(self._suspended.values())}
+        return snap
 
     def _finish(self, req: _Request, reason: str) -> None:
         req.result = FinishedRequest(
@@ -2869,6 +2999,174 @@ class ServingEngine:
         with self._wake:  # a paused/idle loop should start decoding it
             self._wake.notify_all()
         self.queue.notify()
+        return slot
+
+    # -- tiered KV: decode preemption to the host tier ---------------------
+
+    def _preempt_slot(self, slot: int) -> bool:
+        """Suspend an active decode to the host tier.
+
+        Mirrors ``_extract_slot`` with the host arena as the
+        destination: the fixed-arity export (inside
+        ``HostKVTier.begin_demote``) runs FIRST, so a ``host-swap-out``
+        chaos fault returns False with the slot — and the device copy —
+        fully intact.  On success the staged dense leaves own the bytes,
+        the slot's device blocks free immediately, and the scheduling
+        state (fill, RNG fold count, pending token, speculation EWMA)
+        moves into ``_suspended`` for a bitwise resume."""
+        self._flush_inflight()  # may retire the victim (EOS/budget)
+        st = self._active.get(slot)
+        if st is None:
+            return False
+        req = st.req
+        bids = self.slots.live_bids(slot)
+        if not bids or not self.host_tier.can_store(len(bids)):
+            return False
+        t0 = time.perf_counter()
+        try:
+            hids = self.host_tier.begin_demote(bids, owner=req.rid)
+        except OSError as e:  # armed chaos / real I/O failure BEFORE any
+            # state mutated: the request simply keeps decoding here
+            EVENT_LOG.emit("engine", "swap_out_failed", request_id=req.rid,
+                           slot=slot, error=repr(e))
+            return False
+        self._active.pop(slot)
+        if self.prefix_cache is not None:
+            # unpin without offering: the request is suspended, not
+            # retiring (its blocks are leaving the device anyway)
+            self.prefix_cache.release(st.lease)
+        self._release_adapter(req)
+        self.slots.release(slot)
+        self._suspended[req.id] = _Suspended(
+            req, hids, len(bids),
+            meta={"fill": st.fill, "count": st.count,
+                  "pending": st.pending, "spec_ewma": st.spec_ewma,
+                  "spec_stall": st.spec_stall,
+                  "draft_fill": st.draft_fill},
+            t_suspend=t0)
+        nbytes = self.host_tier.block_nbytes * len(bids)
+        self.metrics.inc("preemptions_total")
+        self._update_pool_gauges()
+        self.metrics.set_gauges(slots_active=self.slots.active_slots)
+        EVENT_LOG.emit("engine", "swapped", request_id=req.rid,
+                       direction="out", blocks=len(bids), bytes=nbytes)
+        EVENT_LOG.emit("engine", "preempted", request_id=req.rid,
+                       slot=slot, priority=req.priority,
+                       blocks=len(bids), generated=len(req.generated))
+        self.trace.add("preempt", t0, time.perf_counter(),
+                       request_id=req.rid, tid=req.id,
+                       args={"slot": slot, "blocks": len(bids),
+                             "priority": req.priority})
+        return True
+
+    def _maybe_resume(self) -> None:
+        """Admission-side hook: bring suspended decodes back on device
+        when a slot and a full reservation are available — highest
+        priority first, FIFO within a class, never leapfrogging a
+        strictly higher-priority parked admission."""
+        if not self._suspended:
+            return
+        pool = self.slots.pool
+        bk = pool.block_size
+        for sus in sorted(self._suspended.values(),
+                          key=lambda s: (-s.req.priority, s.t_suspend)):
+            req = sus.req
+            if not self.slots.free_slots:
+                break
+            if (self._held is not None
+                    and self._held.priority > req.priority):
+                break
+            total = -(-(len(req.prompt) + req.max_new_tokens) // bk)
+            if not pool.can_reserve(max(total, sus.n_live)):
+                continue  # a smaller suspended request may still fit
+            try:
+                self._resume_suspended(sus)
+            except OSError:
+                # host-swap-in fault (chaos) or adapter pressure: the
+                # host copy stays resident, re-fetched next iteration
+                break
+
+    def _discard_suspended(self, key: int, reason: str) -> None:
+        sus = self._suspended.pop(key)
+        self.host_tier.free(sus.hids)
+        self._finish(sus.req, reason)
+        self._update_pool_gauges()
+
+    def _resume_suspended(self, sus: _Suspended) -> int:
+        """Swap a suspended decode back in and rebuild its slot state.
+
+        Bitwise: block contents round-trip the host arena verbatim and
+        the sampling RNG folds on the request's own (seed, count) — the
+        resumed trajectory is the one an uninterrupted run produces.
+        Raises ``OSError`` with the host copy intact (and this ledger
+        balanced) when the swap-in faults or the adapter arena is
+        pinned shut."""
+        req = sus.req
+        pool = self.slots.pool
+        t0 = time.perf_counter()
+        slot = self.slots.alloc()
+        assert slot is not None
+        aslot = self._acquire_adapter(req)
+        if aslot is None:
+            self.slots.release(slot)
+            raise OSError("adapter arena fully pinned; resume deferred")
+        bk = pool.block_size
+        total = -(-(len(req.prompt) + req.max_new_tokens) // bk)
+        need = max(total, sus.n_live)
+        if not pool.reserve(need):
+            self._release_adapter(req)
+            self.slots.release(slot)
+            raise OSError("pool cannot reserve for resume")
+        self.slots.set_reservation(slot, need)
+        table = np.full(self.slots.table_blocks, BlockPool.TRASH, np.int32)
+        for i in range(sus.n_live):
+            table[i] = pool.alloc_reserved()
+            # tpulint: allow[lock-discipline] scheduler thread only —
+            # same single-writer discipline as install_shipment
+            self.slots.reserved[slot] -= 1
+        # tpulint: allow[lock-discipline] scheduler thread only, as above
+        self.slots.tables[slot] = table
+        try:
+            self.host_tier.promote(sus.hids, table[:sus.n_live])
+        except OSError:
+            # swap-in fault: unwind — release drops the fresh blocks and
+            # the unused reservation; the host copy stays resident for a
+            # later re-fetch
+            self.slots.release(slot)
+            self._release_adapter(req)
+            self._update_pool_gauges()
+            raise
+        self.host_tier.free(sus.hids)
+        del self._suspended[req.id]
+        st = _SlotState(req, fill=sus.meta["fill"],
+                        pending=sus.meta["pending"])
+        st.count = sus.meta["count"]
+        st.spec_ewma = sus.meta["spec_ewma"]
+        st.spec_stall = sus.meta["spec_stall"]
+        st.adapter_slot = aslot
+        st.fresh = True  # next dispatch feeds the host-known pending token
+        self._active[slot] = st
+        if self._draft_enabled and self.config.role != "prefill":
+            # the draft shadow pool does not survive suspension (derived
+            # state, cheap to rebuild) — re-prefill the context
+            self._draft_prefill(slot, st)
+        dt = time.perf_counter() - t0
+        suspended_s = t0 - sus.t_suspend
+        nbytes = self.host_tier.block_nbytes * sus.n_live
+        self.metrics.inc("resumes_total")
+        self.metrics.observe_resume(dt)
+        self._update_pool_gauges()
+        self.metrics.set_gauges(slots_active=self.slots.active_slots)
+        EVENT_LOG.emit("engine", "swapped", request_id=req.rid,
+                       direction="in", blocks=sus.n_live, bytes=nbytes)
+        EVENT_LOG.emit("engine", "resumed", request_id=req.rid, slot=slot,
+                       priority=req.priority,
+                       suspended_s=round(suspended_s, 6),
+                       resume_s=round(dt, 6))
+        self.trace.add("resume", t0, time.perf_counter(),
+                       request_id=req.rid, tid=req.id,
+                       args={"slot": slot, "blocks": sus.n_live,
+                             "suspended_s": round(suspended_s, 6)})
         return slot
 
     # -- live weight swap (zero-downtime deploys) --------------------------

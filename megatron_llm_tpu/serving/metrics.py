@@ -156,6 +156,16 @@ _COUNTERS = (
     # not place (both decode locally — availability cost, never a
     # correctness one).
     "ships_out_total", "ships_in_total", "ship_failures_total",
+    # tiered KV (serving/block_pool.py:HostKVTier): blocks swapped between
+    # the device pool and the host-RAM tier, total bytes moved both ways,
+    # low-priority decodes suspended to host (preemptions) and resumed,
+    # and prefix-cache trie entries promoted back from host on a hit.
+    # swap_out climbing with swap_in flat means the host tier is filling
+    # without paying off (demoted prefixes never re-hit — shrink
+    # host_kv_blocks); preemptions without resumes means starvation
+    # (check priority spread vs pool size).
+    "swap_out_blocks_total", "swap_in_blocks_total", "swap_bytes_total",
+    "preemptions_total", "resumes_total", "prefix_promotions_total",
 )
 
 # (attribute, prometheus family name, help) for the latency reservoirs
@@ -172,6 +182,8 @@ _PROM_SUMMARIES = (
      "tokens per admission served from the prefix cache"),
     ("accepted_per_step", "serving_accepted_tokens_per_step",
      "tokens committed per participating slot per speculative verify step"),
+    ("resume_latency", "serving_resume_latency_seconds",
+     "preempted-decode resume latency (host swap-in to decodable)"),
 )
 
 
@@ -221,6 +233,11 @@ class ServingMetrics:
         self.blocks_free = 0
         self.blocks_used = 0
         self.kv_cache_util = 0.0
+        # tiered KV: host-RAM tier occupancy gauges and the preempted-
+        # decode resume latency reservoir (engine._resume_suspended)
+        self.host_blocks_used = 0
+        self.host_blocks_free = 0
+        self.resume_latency = LatencyHistogram()
         # fused/fallback decode iterations keyed by the weight precision
         # route (ops/quant.py:precision_route: fp32/int8/int4/mixed) —
         # a per-precision regression to the composed path (e.g. an int4
@@ -262,7 +279,9 @@ class ServingMetrics:
                    kv_cache_util: Optional[float] = None,
                    num_slots: Optional[int] = None,
                    adapter_resident: Optional[int] = None,
-                   adapter_resident_bytes: Optional[int] = None) -> None:
+                   adapter_resident_bytes: Optional[int] = None,
+                   host_blocks_used: Optional[int] = None,
+                   host_blocks_free: Optional[int] = None) -> None:
         with self._lock:
             if num_slots is not None:
                 self.num_slots = num_slots
@@ -282,6 +301,10 @@ class ServingMetrics:
                 self.adapter_resident = adapter_resident
             if adapter_resident_bytes is not None:
                 self.adapter_resident_bytes = adapter_resident_bytes
+            if host_blocks_used is not None:
+                self.host_blocks_used = host_blocks_used
+            if host_blocks_free is not None:
+                self.host_blocks_free = host_blocks_free
 
     def observe_decode_iteration(self, batch: int, seconds: float) -> None:
         """One scheduler decode step over ``batch`` active slots."""
@@ -347,6 +370,12 @@ class ServingMetrics:
         with self._lock:
             self.e2e.observe(seconds)
 
+    def observe_resume(self, seconds: float) -> None:
+        """Preempted-decode resume latency: host swap-in start to the
+        request being decodable again (tiered KV)."""
+        with self._lock:
+            self.resume_latency.observe(seconds)
+
     def observe_finish(self, ok: bool) -> None:
         """Request retired; ``ok`` False on timeout/error (availability)."""
         self.slo.record_request(ok)
@@ -390,6 +419,10 @@ class ServingMetrics:
                 "blocks_free": self.blocks_free,
                 "blocks_used": self.blocks_used,
                 "kv_cache_util": self.kv_cache_util,
+                # tiered KV host-RAM tier
+                "host_blocks_used": self.host_blocks_used,
+                "host_blocks_free": self.host_blocks_free,
+                "resume_latency": self.resume_latency.snapshot(),
                 # speculative decoding (histogram samples are token
                 # counts per participating slot per verify step)
                 "spec_acceptance_rate": (
@@ -507,6 +540,10 @@ class ServingMetrics:
                     ("serving_kv_cache_util",
                      "allocated-token fraction of the KV pool",
                      self.kv_cache_util),
+                    ("serving_host_blocks_used",
+                     "host-RAM tier KV blocks in use", self.host_blocks_used),
+                    ("serving_host_blocks_free",
+                     "host-RAM tier KV blocks free", self.host_blocks_free),
                     ("serving_spec_acceptance_rate",
                      "speculative draft tokens accepted / proposed",
                      self.counters["spec_accepted"]

@@ -67,15 +67,19 @@ from .metrics import ServingMetrics
 
 class _Node:
     """One cached block: ``key`` is its block_tokens token ids, ``bid``
-    the pool block holding its K/V rows (the trie owns one pool ref)."""
+    the pool block holding its K/V rows (the trie owns one pool ref).
+    A *spilled* node instead holds ``hid`` — a host-tier block id — with
+    ``bid`` back at trash: the rows live in host RAM and re-promote into
+    a fresh pool block on the next match (tiered KV, docs/serving.md)."""
 
-    __slots__ = ("key", "parent", "children", "bid", "ref", "tick")
+    __slots__ = ("key", "parent", "children", "bid", "hid", "ref", "tick")
 
     def __init__(self, key: Tuple[int, ...], parent: "_Node"):
         self.key = key
         self.parent = parent
         self.children: dict = {}
         self.bid = BlockPool.TRASH
+        self.hid = None     # host-tier block id when spilled
         self.ref = 0        # live leases pinning this block
         self.tick = 0       # LRU clock at last touch
 
@@ -101,7 +105,8 @@ class PrefixCache:
 
     def __init__(self, cfg: ModelConfig, *, pool: BlockPool,
                  max_blocks: int, max_seq_len: int,
-                 metrics: Union[ServingMetrics, Callable, None] = None):
+                 metrics: Union[ServingMetrics, Callable, None] = None,
+                 host_tier=None):
         assert max_blocks >= 1
         self.cfg = cfg
         self.pool = pool
@@ -112,14 +117,23 @@ class PrefixCache:
         # measurement (serving/bench.py), so accept a zero-arg callable
         # resolved at use time rather than capturing one registry forever
         self._metrics = metrics
+        # optional HostKVTier: eviction victims demote to host RAM
+        # instead of being dropped, and re-promote on the next match
+        self.host_tier = host_tier
         self._root = _Node((), None)
         self._blocks = 0
+        self._host_blocks = 0
         self._tick = 0
 
     @property
     def blocks(self) -> int:
         """Blocks currently resident (tooling / budget introspection)."""
         return self._blocks
+
+    @property
+    def host_blocks(self) -> int:
+        """Spilled trie blocks resident in the host tier."""
+        return self._host_blocks
 
     def _m(self) -> Optional[ServingMetrics]:
         m = self._metrics
@@ -151,6 +165,11 @@ class PrefixCache:
         for key in self._keys(tokens, usable):
             child = cur.children.get(key)
             if child is None:
+                break
+            if child.hid is not None and not self._promote(child):
+                # spilled block that could not come back (pool full or a
+                # host-swap-in fault, host copy retained) — the match
+                # stops here and a later admission re-fetches
                 break
             nodes.append(child)
             cur = child
@@ -217,6 +236,70 @@ class PrefixCache:
             self._evict()
         return added
 
+    # -- host-tier spill / promote ----------------------------------------
+
+    def _promote(self, node: _Node) -> bool:
+        """Bring a spilled node's rows back from the host tier into a
+        fresh pool block.  False (node stays spilled, host copy intact)
+        when the pool has no block to give or the swap-in faults."""
+        if not self.pool.reserve(1):
+            return False
+        bid = self.pool.alloc_reserved()
+        try:
+            self.host_tier.promote([node.hid], [bid])
+        except OSError:
+            self.pool.decref(bid)
+            return False
+        self.host_tier.free([node.hid])
+        node.hid = None
+        node.bid = bid
+        self._host_blocks -= 1
+        self._blocks += 1
+        m = self._m()
+        if m is not None:
+            m.inc("prefix_promotions_total")
+        return True
+
+    def _spill(self, victim: _Node) -> bool:
+        """Demote an eviction victim's block to the host tier, keeping
+        the node in the trie as a spilled entry.  When the tier is full,
+        the LRU childless *spilled* node is dropped outright to make
+        room.  False -> caller falls back to a plain drop."""
+        tier = self.host_tier
+        if tier is None:
+            return False
+        if not tier.can_store(1):
+            self._drop_lru_spilled()
+        if not tier.can_store(1) or not tier.swap_ok():
+            return False
+        try:
+            hids = tier.begin_demote([victim.bid], owner="prefix-cache")
+        except OSError:
+            return False  # device copy untouched; plain drop is safe
+        self.pool.decref(victim.bid)
+        victim.bid = BlockPool.TRASH
+        victim.hid = hids[0]
+        self._blocks -= 1
+        self._host_blocks += 1
+        return True
+
+    def _drop_lru_spilled(self) -> None:
+        victim = None
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if (n.hid is not None and not n.children
+                    and (victim is None or n.tick < victim.tick)):
+                victim = n
+            stack.extend(n.children.values())
+        if victim is None:
+            return
+        del victim.parent.children[victim.key]
+        self.host_tier.free([victim.hid])
+        victim.hid = None
+        victim.parent = None
+        self._host_blocks -= 1
+
     # -- eviction ----------------------------------------------------------
 
     def evict_blocks(self, n: int) -> int:
@@ -232,15 +315,30 @@ class PrefixCache:
         evicted = 0
         while (self._blocks > self.max_blocks) or (evicted < want
                                                    and self._blocks > 0):
+            # victim = LRU unpinned resident node with no RESIDENT child.
+            # A spilled child does not protect its parent — spilling
+            # keeps the node in the trie, so whole chains can demote
+            # leaf-up instead of wedging after the first leaf.
             victim = None
             stack = list(self._root.children.values())
             while stack:
                 n = stack.pop()
-                if (n.ref == 0 and not n.children
+                if (n.ref == 0 and n.hid is None
+                        and all(c.hid is not None
+                                for c in n.children.values())
                         and (victim is None or n.tick < victim.tick)):
                     victim = n
                 stack.extend(n.children.values())
             if victim is None:
+                break
+            if self._spill(victim):
+                # demoted to the host tier: the pool block is freed (the
+                # eviction's goal) but the cached prefix survives spilled
+                evicted += 1
+                continue
+            if victim.children:
+                # can't spill and can't plain-drop a node with spilled
+                # children without orphaning them; stop here (soft)
                 break
             del victim.parent.children[victim.key]
             self.pool.decref(victim.bid)

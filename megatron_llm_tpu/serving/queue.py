@@ -82,11 +82,27 @@ class RequestQueue:
             self._cond.notify_all()
 
     def pop(self) -> Optional[object]:
-        """Next pending request, or None when the queue is empty."""
+        """Next pending request, or None when the queue is empty.
+
+        Priority-aware: the highest ``priority`` class pops first, FIFO
+        within a class (stable — the scan keeps the earliest submission
+        among equals).  Requests without a priority attribute, and the
+        common case where every queued request shares one class, degrade
+        to plain FIFO, so the pre-QoS behavior is unchanged."""
         with self._cond:
-            if self._q:
+            if not self._q:
+                return None
+            best_i, best_p = 0, getattr(self._q[0], "priority", 0)
+            for i in range(1, len(self._q)):
+                p = getattr(self._q[i], "priority", 0)
+                if p > best_p:
+                    best_i, best_p = i, p
+            if best_i == 0:
                 return self._q.popleft()
-            return None
+            self._q.rotate(-best_i)
+            req = self._q.popleft()
+            self._q.rotate(best_i)
+            return req
 
     def remove(self, req) -> bool:
         """Drop a still-queued request (cancellation before admission)."""

@@ -36,7 +36,7 @@ race-free without host synchronization.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -121,6 +121,18 @@ class SlotAllocator:
         slot (the engine already called ``pool.reserve(n)``)."""
         assert self.reserved[slot] == 0
         self.reserved[slot] = n
+
+    def live_bids(self, slot: int) -> List[int]:
+        """The slot's allocated block ids in table order.  Non-TRASH
+        entries always form a prefix of the row (blocks are granted in
+        fill order), which is what lets shipping and the tiered-KV
+        demote path move ``live_bids`` as one dense fixed-arity slice."""
+        bids: List[int] = []
+        for b in self.tables[slot]:
+            if int(b) == BlockPool.TRASH:
+                break
+            bids.append(int(b))
+        return bids
 
     # -- cache views ----------------------------------------------------
     @property

@@ -44,6 +44,24 @@ def summarize(snap: dict) -> str:
     shared = {b: r for b, r in snap.get("ref_counts", {}).items() if r > 1}
     if shared:
         lines.append(f"shared blocks (ref > 1): {shared}")
+    host = snap.get("host_tier")
+    if host:
+        bw = host.get("swap_bw_bytes_per_s", 0.0)
+        lines.append(
+            f"host tier: {host['n_host_blocks']} blocks "
+            f"({host['host_blocks_used']} used, "
+            f"{host['host_blocks_free']} free; "
+            f"{host['swaps_in_flight']} swap(s) in flight, "
+            f"bw {bw / 1e9:.2f} GB/s, "
+            f"out {host['swap_out_blocks']} / in {host['swap_in_blocks']} "
+            "blocks total)")
+        for label, n in sorted(host.get("owners", {}).items()):
+            lines.append(f"  host owner {label}: {n} block(s)")
+        for rid, info in sorted(host.get("suspended", {}).items()):
+            lines.append(
+                f"  suspended {rid}: {info['blocks']} block(s) swapped "
+                f"out, priority={info['priority']}, "
+                f"generated={info['generated']}")
     for sid, st in sorted(snap.get("slots", {}).items(), key=lambda x: int(x[0])):
         lines.append(f"slot {sid}: fill={st['fill']} "
                      f"blocks={st['blocks']} table={st['table']}")

@@ -41,12 +41,21 @@ def _start_metrics_logger(service, interval_s: float):
                 "prefix_misses": snap["prefix_misses"],
                 "prefix_hit_rate": round(snap["prefix_hit_rate"], 4),
                 "prefix_blocks": snap["prefix_blocks"],
+                "prefix_promotions": snap.get(
+                    "prefix_promotions_total", 0),
                 "spec_proposed": snap["spec_proposed"],
                 "spec_accepted": snap["spec_accepted"],
                 "spec_acceptance_rate": round(
                     snap["spec_acceptance_rate"], 4),
                 "accepted_tokens_per_step_mean": round(
                     snap["accepted_tokens_per_step"]["mean"], 3),
+                # tiered KV (all zero when --host_kv_blocks is unset)
+                "swap_out_blocks": snap.get("swap_out_blocks_total", 0),
+                "swap_in_blocks": snap.get("swap_in_blocks_total", 0),
+                "swap_bytes": snap.get("swap_bytes_total", 0),
+                "preemptions": snap.get("preemptions_total", 0),
+                "host_blocks_used": snap.get("host_blocks_used", 0),
+                "host_blocks_free": snap.get("host_blocks_free", 0),
             }}), flush=True)
 
     t = threading.Thread(target=loop, name="serving-metrics-log",
@@ -116,6 +125,20 @@ def main(argv=None) -> int:
                          "the total KV HBM budget independently of "
                          "--max_batch_size; default: engine default "
                          "(max_batch_size full-length sequences)")
+    ap.add_argument("--host_kv_blocks", type=int, default=0,
+                    help="tiered KV: host-RAM arena size in blocks of "
+                         "--kv_block_size tokens (docs/serving.md, "
+                         "'Tiered KV').  Enables prefix-cache spill to "
+                         "host, priority-based decode preemption, and "
+                         "oversubscribed admission against the host "
+                         "tier instead of queue-head parking; size it "
+                         "so steady demote traffic stays under the "
+                         "host<->device copy bandwidth.  0 = off")
+    ap.add_argument("--default_priority", type=int, default=0,
+                    help="QoS class for requests whose JSON body has no "
+                         "'priority' field (higher = admitted sooner; "
+                         "with --host_kv_blocks a higher class may "
+                         "preempt lower-class decodes to the host tier)")
     ap.add_argument("--metrics_interval_s", type=float, default=60.0,
                     help="periodically print a one-line JSON serving-"
                          "metrics summary (prefix-cache hit rate "
@@ -377,6 +400,8 @@ def main(argv=None) -> int:
         prefix_cache_blocks=prefix_blocks,
         kv_block_size=args.kv_block_size,
         kv_pool_blocks=args.kv_pool_blocks,
+        host_kv_blocks=args.host_kv_blocks,
+        default_priority=args.default_priority,
         spec_draft_len=0 if args.no_spec else args.draft_len,
         spec_ngram=args.spec_ngram,
         spec_reprobe_interval=args.spec_reprobe_interval,
