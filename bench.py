@@ -699,6 +699,33 @@ def _serving_cluster_point():
         max_prompt_len=max_prompt_len, replicas=2, tp=2)
 
 
+def _serving_pp_point():
+    """Pipeline-parallel serving point (docs/serving.md
+    "Pipeline-parallel decode"): pp=2 as a real serving axis vs tp=2 at
+    EQUAL device count.  Headlines ``serving_pp_param_bytes_ratio``
+    (≈ 2.0: the layer-sharded layout halves per-device resident param
+    bytes, so a 2x larger model fits the same per-chip HBM) in
+    --compare; the ITL-vs-tp pair and the bitwise flag ride along.  As
+    with serving_cluster, the CPU device-count simulation shares host
+    cores across "devices", so only the residency ratio is a hardware-
+    faithful claim in simulated runs."""
+    import jax
+
+    from megatron_llm_tpu.models import model as model_lib
+    from megatron_llm_tpu.serving.bench import run_pp_serving_bench
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"serving_pp_skipped":
+                f"needs >= 2 devices, have {n_dev}"}
+    gen_len, max_prompt_len = 32, 128
+    cfg = _bench_model(max_prompt_len + gen_len, "selective")
+    params = model_lib.init_params(jax.random.key(0), cfg)
+    return run_pp_serving_bench(
+        cfg, params, num_requests=16, gen_len=gen_len, slots=4,
+        max_prompt_len=max_prompt_len, pp=2)
+
+
 def _serving_disagg_point(platform: str):
     """Disaggregated prefill/decode point (serving/cluster/,
     docs/serving.md "Disaggregated prefill/decode"): long-prompt traffic
@@ -860,7 +887,11 @@ _HEADLINE_METRICS = ("mfu", "decode_tokens_per_sec",
                      # preemption over the queue-head-parking baseline
                      # (≥ 1.5x acceptance); the swap-overhead ITL gate
                      # rides separately in tiered_overhead_check
-                     "serving_tiered.serving_tiered_qps_ratio")
+                     "serving_tiered.serving_tiered_qps_ratio",
+                     # pipeline-parallel serving: the layer-sharded
+                     # layout's per-device param-bytes win at pp=2
+                     # (≈ 2.0; KV pool shards the same way)
+                     "serving_pp.serving_pp_param_bytes_ratio")
 _REGRESSION_TOLERANCE = 0.10
 # Tracing must stay effectively free on the serving hot path: the mixed
 # point's ITL p50 with the span recorder on may exceed the untraced rerun
@@ -892,7 +923,10 @@ _TIERED_OVERHEAD_TOLERANCE = 0.05
 # v9: + serving_tiered point (tiered KV: interactive-class QPS with
 #     host-RAM preemption vs queue-head parking + the swap-overhead ITL
 #     pair)
-_BENCH_SCHEMA_VERSION = 9
+# v10: + serving_pp point (pipeline-parallel decode: per-device param-
+#      bytes ratio at pp=2 / fsdp=2 vs single-mesh, ITL vs tp=2 at
+#      equal devices, bitwise flag)
+_BENCH_SCHEMA_VERSION = 10
 
 
 def _run_metadata(platform: str, device_count: int) -> dict:
@@ -1145,6 +1179,8 @@ def _child_main(spec_json: str) -> None:
         out = _retry(_serving_spec_tree_point, spec.get("wide_layers", 0))
     elif kind == "serving_cluster":
         out = _retry(_serving_cluster_point)
+    elif kind == "serving_pp":
+        out = _retry(_serving_pp_point)
     elif kind == "serving_disagg":
         out = _retry(_serving_disagg_point, platform)
     else:  # pragma: no cover - parent and child ship together
@@ -1372,6 +1408,9 @@ def main() -> None:
                              {"kind": "serving_cluster",
                               "platform": platform},
                              timeout_s=1800, env=cluster_env)
+    serving_pp = _point("serving/pp",
+                        {"kind": "serving_pp", "platform": platform},
+                        timeout_s=1800, env=cluster_env)
     serving_disagg = _point("serving/disagg",
                             {"kind": "serving_disagg",
                              "platform": platform},
@@ -1447,6 +1486,8 @@ def main() -> None:
         record["serving_spec_tree"] = serving_spec_tree
     if serving_cluster is not None:
         record["serving_cluster"] = serving_cluster
+    if serving_pp is not None:
+        record["serving_pp"] = serving_pp
     if serving_disagg is not None:
         record["serving_disagg"] = serving_disagg
     if headline is not None:

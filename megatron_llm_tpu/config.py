@@ -281,6 +281,14 @@ class ParallelConfig:
     data_parallel: int = 1
     pipeline_parallel: int = 1
     tensor_parallel: int = 1
+    # Serving weight-residency sharding (fsdp axis): weights are split
+    # 1/fsdp along their non-tp dimension (models/sharding.py:
+    # serving_param_specs, per the EasyDel/fjformer ("dp","fsdp","sp")
+    # partition-rule family), so per-device *resident* param bytes fall
+    # with the mesh without widening the head sharding.  GSPMD inserts
+    # the gather-before-use; decode stays compute-identical.  Unused by
+    # the training layout (ZeRO-1 covers optimizer state there).
+    fsdp: int = 1
     # Megatron-style sequence parallelism: shard activations along seq over
     # the tp axis in norm/dropout regions (reference spread across
     # core/tensor_parallel/layers.py:225-296 etc.).
@@ -322,6 +330,7 @@ class ParallelConfig:
     def world_size(self) -> int:
         return (
             self.data_parallel
+            * self.fsdp
             * self.pipeline_parallel
             * self.tensor_parallel
             * self.context_parallel
@@ -332,6 +341,7 @@ class ParallelConfig:
         # sequence_parallel with tp == 1 is a harmless no-op (the reference
         # force-disables it, arguments.py:332-333; here the spec degenerates
         # to the plain activation layout).
+        assert self.fsdp >= 1, f"fsdp must be >= 1, got {self.fsdp}"
         if self.pipeline_parallel > 1:
             assert self.num_microbatches >= 1
         assert self.context_parallel_layout in ("contiguous", "zigzag"), (

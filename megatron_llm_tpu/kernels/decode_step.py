@@ -945,22 +945,26 @@ def fused_decode_eligible(cfg, params, k_cache, s: int,
 
 
 def _mesh_shards_stack(mesh) -> bool:
-    """True when ``mesh`` carries a >1 head-sharding factor (pp·tp).
+    """True when ``mesh`` shards the layer stack's weights or KV anywhere
+    (pp on the layer axis, tp on heads, fsdp on weight residency).
 
     The whole-stack fused kernels are single-device programs: the
     residual stream crosses every layer inside one dispatch, so a
-    head-sharded stack would need in-kernel collectives after wo/w_down.
-    The shard-aware dispatch therefore declines whole-stack fusion on a
-    sharded engine and keeps the composed stack, whose per-op paged
-    attention runs the kernel per-shard under shard_map
+    head-sharded (tp) stack would need in-kernel collectives after
+    wo/w_down, a layer-sharded (pp) stack would need cross-stage
+    transfers mid-loop, and an fsdp-split weight would need an
+    all-gather before each matmul.  The shard-aware dispatch therefore
+    declines whole-stack fusion whenever any of these factors exceeds 1
+    and keeps the composed stack, whose per-op paged attention runs the
+    kernel per-shard under shard_map
     (ops/attention.py:_sharded_paged_flash_decode) with replicated int32
     tables and the int8 {q, scale} pool leaves moving verbatim."""
     if mesh is None:
         return False
-    from ..parallel.mesh import PIPELINE_AXIS, TENSOR_AXIS
+    from ..parallel.mesh import FSDP_AXIS, PIPELINE_AXIS, TENSOR_AXIS
 
     factor = 1
-    for a in (PIPELINE_AXIS, TENSOR_AXIS):
+    for a in (PIPELINE_AXIS, TENSOR_AXIS, FSDP_AXIS):
         if a in mesh.axis_names:
             factor *= mesh.shape[a]
     return factor > 1
@@ -976,8 +980,9 @@ def fused_paged_decode_eligible(cfg, params, k_pool, n_slots: int,
     block size must be a legal (>= 128, lane-aligned) Mosaic tile and one
     block per (batch-row, layer) must fit the VMEM estimate.  ``mesh``
     (the sharded serving engine's submesh, engine.start()) makes the
-    dispatch shard-aware: a head-sharding mesh keeps the composed stack
-    (see ``_mesh_shards_stack``); tp=1 meshes change nothing."""
+    dispatch shard-aware: a sharded mesh (tp heads, pp layers, or fsdp
+    weight residency) keeps the composed stack (see
+    ``_mesh_shards_stack``); all-size-1 meshes change nothing."""
     from ..ops.kv_quant import is_quantized_cache
 
     if n_slots < 1 or table_blocks < 1:

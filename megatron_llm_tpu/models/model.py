@@ -575,7 +575,12 @@ def init_kv_pool(cfg: ModelConfig, n_blocks: int, block_size: int,
     The paged serving engine (serving/block_pool.py) owns one pool and
     hands out blocks by integer id; block 0 is reserved as the trash
     block so fixed-arity gathers/scatters can point unused table entries
-    somewhere harmless."""
+    somewhere harmless.
+
+    On a pp>1 serving submesh the leading [L] axis is sharded over the
+    pp stages (models/sharding.py:kv_pool_specs): each stage holds its
+    own layers' slice of every block, while the ids and the host ledger
+    stay global — the pool is layer-sharded, never id-partitioned."""
     return init_kv_cache(cfg, n_blocks, block_size, dtype)
 
 

@@ -36,6 +36,8 @@ PP = "pp"
 DP = "dp"
 CP = "cp"
 EP = "ep"
+FSDP = "fsdp"  # serving weight-residency axis (parallel/mesh.py:FSDP_AXIS)
+SP = "sp"      # named-but-size-1 sequence axis (parallel/mesh.py:SEQ_AXIS)
 
 
 def kv_shard_axes(cfg: ModelConfig, tp_size: int, tp_axes=TP):
@@ -55,20 +57,27 @@ def norm_specs(cfg: ModelConfig, layer_axis: Optional[str] = None) -> Params:
 
 
 def _layer_specs(cfg: ModelConfig, layer_axis: Optional[str],
-                 tp_size: int, tp_axes=TP) -> Params:
+                 tp_size: int, tp_axes=TP, fsdp_axes=None) -> Params:
     """Specs for one (stacked) layer pytree; leading dim = layer axis.
 
     ``tp_axes`` is the mesh axis (or axis tuple) carrying the tensor
-    sharding — 'tp' for training, ('pp', 'tp') for the serving re-layout
-    (serving_param_specs)."""
+    sharding — 'tp' everywhere now that the serving re-layout shards
+    layers over 'pp' instead of joining pp into tp.  ``fsdp_axes``
+    (serving re-layout with ParallelConfig.fsdp > 1) additionally splits
+    each weight's NON-tp dimension — the ("dp","fsdp","sp")-family
+    partition rules: q/k/v ('fsdp' on the input dim, tp on heads),
+    o_proj/down_proj ('fsdp' on the output dim) — so resident bytes fall
+    1/(tp·fsdp) per device while the matmul sharding GSPMD derives stays
+    the familiar column/row-parallel pattern plus a gather."""
     L = layer_axis  # None (scan only) or 'pp'
     TP = tp_axes  # noqa: N806 — shadows the module constant on purpose
+    F = fsdp_axes  # None (no residency split) or 'fsdp'
     kv_tp = kv_shard_axes(cfg, tp_size, tp_axes)
     attn = {
-        "wq": P(L, None, TP),
-        "wk": P(L, None, kv_tp),
-        "wv": P(L, None, kv_tp),
-        "wo": P(L, TP, None),
+        "wq": P(L, F, TP),
+        "wk": P(L, F, kv_tp),
+        "wv": P(L, F, kv_tp),
+        "wo": P(L, TP, F),
     }
     if cfg.use_bias or cfg.qkv_bias:
         attn["bq"] = P(L, TP)
@@ -83,15 +92,15 @@ def _layer_specs(cfg: ModelConfig, layer_axis: Optional[str],
         # (models/moe.py).  Router stays replicated (tiny, fp32).
         mlp = {"router": P(L, None, None)}
         if cfg.is_glu:
-            mlp["w_gate"] = P(L, EP, None, TP)
-        mlp["w_up"] = P(L, EP, None, TP)
-        mlp["w_down"] = P(L, EP, TP, None)
+            mlp["w_gate"] = P(L, EP, F, TP)
+        mlp["w_up"] = P(L, EP, F, TP)
+        mlp["w_down"] = P(L, EP, TP, F)
     else:
         mlp = {}
         if cfg.is_glu:
-            mlp["w_gate"] = P(L, None, TP)
-        mlp["w_up"] = P(L, None, TP)
-        mlp["w_down"] = P(L, TP, None)
+            mlp["w_gate"] = P(L, F, TP)
+        mlp["w_up"] = P(L, F, TP)
+        mlp["w_down"] = P(L, TP, F)
         if cfg.use_bias:
             if cfg.is_glu:
                 mlp["b_gate"] = P(L, TP)
@@ -134,29 +143,48 @@ def param_specs(cfg: ModelConfig, parallel: ParallelConfig) -> Params:
 
 def serving_param_specs(cfg: ModelConfig,
                         parallel: ParallelConfig) -> Params:
-    """Inference re-layout: the pp axis JOINS tp instead of sharding layers.
+    """Inference re-layout: 'pp' shards LAYERS, 'fsdp' shards residency.
 
-    Sharding the flat layer stack over 'pp' (the training layout) is wrong
-    for the jitted decode loop: every token step would move *weights*
-    between stages (each scan step reads a layer resident on one stage) —
-    a bandwidth disaster at bs=1.  For serving, pp devices are just more
-    tensor parallelism: every weight is sharded 1/(pp·tp) over the
-    combined ('pp', 'tp') axes, stays resident, and activations do the
-    usual tp collectives.  Memory per device matches the training layout;
-    the reference instead runs its pipelined ForwardStep per token
-    (megatron/text_generation/forward_step.py:44-213), paying a p2p
-    round-trip per token per stage boundary.
+    Earlier revisions folded pp into wider head sharding (tp_eff = pp·tp)
+    on the argument that a layer-sharded scan moves weights per token
+    step.  That fold capped the layout at head divisibility (a model
+    whose heads don't divide pp·tp refused to shard at all) and kept
+    per-device *param and KV-pool bytes* flat in pp — the opposite of
+    what a 70B-on-a-pod geometry needs.  This layout reverses the
+    decision:
 
-    Requires head/vocab divisibility by pp·tp, same as tp alone.
+    - **pp** places each pipeline stage's contiguous layer slab (the
+      stacked layer axis of params AND of the paged KV pool,
+      kv_pool_specs) on its own mesh slice, so residency scales with
+      pipeline depth.  The engine fills the per-stage bubbles by
+      splitting the slot batch into pp microbatches and keeping pp
+      group dispatches in flight (serving/engine.py:_dispatch_decode);
+      GSPMD inserts the stage-boundary movement the reference hand-codes
+      as p2p in its ForwardStep
+      (megatron/text_generation/forward_step.py:44-213).
+    - **tp** stays the only head-sharding axis (serving_head_axes), so
+      head divisibility constrains tp alone: heads % tp, layers % pp —
+      independent, per-axis constraints.
+    - **fsdp** (ParallelConfig.fsdp) splits each weight's non-tp dim and
+      the word embedding's vocab dim along ('tp', 'fsdp') — the
+      EasyDel/fjformer ("dp","fsdp","sp") partition-rule family — so a
+      deployment can halve resident bytes again without touching head
+      or layer divisibility.
+
+    At pp == fsdp == 1 this is exactly the training ``param_specs``
+    layout, and the single-mesh engine's executable is untouched.
     """
     pp = parallel.pipeline_parallel
-    if pp == 1:
+    fsdp = getattr(parallel, "fsdp", 1)
+    if pp == 1 and fsdp == 1:
         return param_specs(cfg, parallel)
-    axes = (PP, TP)
-    tp_eff = pp * parallel.tensor_parallel
+    layer_axis = PP if pp > 1 else None
+    f = FSDP if fsdp > 1 else None
+    embed_axes = (TP, FSDP) if fsdp > 1 else TP
     specs: Params = {
-        "embedding": {"word": P(axes, None)},
-        "layers": _layer_specs(cfg, None, tp_eff, tp_axes=axes),
+        "embedding": {"word": P(embed_axes, None)},
+        "layers": _layer_specs(cfg, layer_axis, parallel.tensor_parallel,
+                               fsdp_axes=f),
         "final_norm": {"scale": P(None)},
     }
     if cfg.norm_type == "layernorm":
@@ -166,8 +194,40 @@ def serving_param_specs(cfg: ModelConfig,
     if cfg.tokentype_size:
         specs["embedding"]["tokentype"] = P(None, None)
     if not cfg.tie_embed_logits:
-        specs["lm_head"] = P(None, axes)
+        specs["lm_head"] = P(f, TP)
     return specs
+
+
+def assert_serving_geometry(cfg: ModelConfig, parallel: ParallelConfig,
+                            what: str = "model") -> None:
+    """Per-axis divisibility guards for the serving re-layout.
+
+    pp no longer folds into tp, so the old single "heads % pp·tp" guard
+    splits into independent per-axis constraints with per-axis messages:
+    heads divide tp, layers divide pp, hidden/vocab divide the fsdp
+    residency split."""
+    tp = parallel.tensor_parallel
+    pp = parallel.pipeline_parallel
+    fsdp = getattr(parallel, "fsdp", 1)
+    assert cfg.num_attention_heads % max(tp, 1) == 0, (
+        f"serving re-layout shards {what} attention heads over tp = {tp}, "
+        f"which must divide num_attention_heads = "
+        f"{cfg.num_attention_heads} (pp shards layers now, not heads — "
+        f"pick tp that divides the head count and put the rest of the "
+        f"submesh on pp/fsdp)")
+    if pp > 1:
+        assert cfg.num_layers % pp == 0, (
+            f"serving re-layout shards the {what} layer stack over pp = "
+            f"{pp}, which must divide num_layers = {cfg.num_layers} "
+            f"(each pipeline stage owns a contiguous slab of layers)")
+    if fsdp > 1:
+        assert cfg.hidden_size % fsdp == 0, (
+            f"fsdp = {fsdp} splits each {what} weight's non-tp dim and "
+            f"must divide hidden_size = {cfg.hidden_size}")
+        assert cfg.padded_vocab_size(tp) % (tp * fsdp) == 0, (
+            f"fsdp = {fsdp} splits the {what} word embedding along "
+            f"('tp', 'fsdp') and tp·fsdp = {tp * fsdp} must divide the "
+            f"padded vocab {cfg.padded_vocab_size(tp)}")
 
 
 def shard_for_serving(params: Params, cfg: ModelConfig,
@@ -178,10 +238,7 @@ def shard_for_serving(params: Params, cfg: ModelConfig,
     logic lives in one place."""
     from ..parallel import mesh as mesh_lib
 
-    tp_eff = parallel.pipeline_parallel * parallel.tensor_parallel
-    assert cfg.num_attention_heads % tp_eff == 0, (
-        f"serving re-layout shards heads over pp·tp = {tp_eff}, which must "
-        f"divide num_attention_heads = {cfg.num_attention_heads}")
+    assert_serving_geometry(cfg, parallel)
     mesh = mesh_lib.build_mesh(parallel)
     specs = serving_param_specs(cfg, parallel)
     # quantized trees have {"q", "scale"} subtrees where the spec tree
@@ -200,41 +257,45 @@ def shard_for_serving(params: Params, cfg: ModelConfig,
 
 def serving_head_axes(cfg: ModelConfig, mesh: Mesh):
     """Mesh axes carrying the kv-head sharding under the serving
-    re-layout, or None when the pool must stay replicated.
+    re-layout, or None when the pool's head dim must stay replicated.
 
-    Serving meshes join pp into tp (``serving_param_specs``), so the
-    head-sharding factor is the product of both axes' sizes.  MQA/GQA
-    pools whose kv-head count does not divide that factor replicate —
-    the same rule as ``kv_shard_axes`` for the K/V projections, derived
-    from the mesh instead of a ParallelConfig so the serving engine can
-    resolve it from the mesh it was handed."""
-    axes = tuple(a for a in (PP, TP)
-                 if a in mesh.axis_names and mesh.shape[a] > 1)
-    if not axes:
-        return None
-    factor = 1
-    for a in axes:
-        factor *= mesh.shape[a]
-    if cfg.kv_heads % factor != 0:
-        return None
-    return axes
+    tp is the ONLY head-sharding axis now — pp shards the layer axis
+    (``serving_param_specs`` / ``kv_pool_specs``) and fsdp never touches
+    the pool (block ids must stay global integers).  MQA/GQA pools whose
+    kv-head count does not divide tp replicate their head dim — the same
+    rule as ``kv_shard_axes`` for the K/V projections, derived from the
+    mesh instead of a ParallelConfig so the serving engine can resolve it
+    from the mesh it was handed."""
+    if (TP in mesh.axis_names and mesh.shape[TP] > 1
+            and cfg.kv_heads % mesh.shape[TP] == 0):
+        return (TP,)
+    return None
 
 
 def kv_pool_specs(cfg: ModelConfig, mesh: Mesh) -> tuple:
     """(k_spec, v_spec) PartitionSpec pytrees for the paged KV block pool
     ``[L, n_blocks, kv_heads, block, d]`` (models/model.py:init_kv_pool).
 
-    Heads shard over the serving tp axes; the layer/block/row/depth dims
-    stay unsharded so block ids remain global integers — the slot block
-    tables are replicated host int32 and move verbatim.  For an int8
-    pool, the ``{"q", "scale"}`` leaves shard on the same kv-head axis
-    (scale is ``[L, n_blocks, kv_heads, block]``)."""
+    The LAYER axis shards over 'pp' (each pipeline stage holds its own
+    layer slab of the pool — KV residency scales with pipeline depth,
+    matching the layer-sharded params) and heads shard over 'tp'.  The
+    block/row/depth dims stay unsharded so block ids remain global
+    integers: every stage's shard holds the same block-id space for its
+    layer slice, the host-side ledger stays ONE ledger, and the
+    allocator / prefix cache / COW / tiered machinery stays
+    topology-blind — the slot block tables are replicated host int32 and
+    move verbatim.  A pool whose layer count doesn't divide pp (e.g. a
+    resident draft model's shallow stack) keeps its layer axis
+    replicated.  For an int8 pool, the ``{"q", "scale"}`` leaves shard
+    on the same axes (scale is ``[L, n_blocks, kv_heads, block]``)."""
     ax = serving_head_axes(cfg, mesh)
+    pp = mesh.shape[PP] if PP in mesh.axis_names else 1
+    L = PP if (pp > 1 and cfg.num_layers % pp == 0) else None
     if cfg.kv_cache_quant == "int8":
-        spec = {"q": P(None, None, ax, None, None),
-                "scale": P(None, None, ax, None)}
+        spec = {"q": P(L, None, ax, None, None),
+                "scale": P(L, None, ax, None)}
     else:
-        spec = P(None, None, ax, None, None)
+        spec = P(L, None, ax, None, None)
     return spec, spec
 
 

@@ -96,13 +96,12 @@ def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
     kv-head-sharded cache, so the kernel is wrapped in a ``shard_map``
     manual over the head-sharding axes only (batch/dp and the rest stay
     GSPMD-managed — the partial-manual pattern of
-    parallel/ring_attention.py).  The head axes are tp alone for the
-    training layout, or (pp, tp) combined under the serving re-layout
-    (models/sharding.py:serving_param_specs — decode only ever runs with
-    pp *joined into* tp, so a pp axis here always means the re-layout).
-    Returns None when the head counts don't divide the combined factor
-    (MQA keeps K/V replicated and the einsum path is already correct
-    there) — the caller falls back.
+    parallel/ring_attention.py).  The head axes are tp alone in BOTH
+    layouts now: the serving re-layout shards layers over pp and
+    residency over fsdp (models/sharding.py:serving_param_specs), so a
+    pp axis never carries heads.  Returns None when the head counts
+    don't divide tp (MQA keeps K/V replicated and the einsum path is
+    already correct there) — the caller falls back.
     """
     from jax.sharding import PartitionSpec as P
     from .kv_quant import is_quantized_cache
@@ -145,24 +144,20 @@ def _sharded_flash_decode(q, k_cache, v_cache, cache_len, softmax_scale,
 def _head_shard_axes(mesh, n_heads: int, kv_heads: int):
     """Mesh axes to shard decode heads over, or None.
 
-    Shared by the dense and paged sharded-kernel wrappers: prefer the
-    serving re-layout's combined (pp, tp) factor, fall back to tp alone
-    (training layout), give up when neither divides both head counts
-    (MQA keeps K/V replicated; the einsum path is already correct)."""
-    from ..parallel.mesh import PIPELINE_AXIS, TENSOR_AXIS
+    Shared by the dense and paged sharded-kernel wrappers.  tp is the
+    only head axis in both the training layout and the serving
+    re-layout (pp shards layers, fsdp shards residency —
+    models/sharding.py); give up when tp doesn't divide both head
+    counts (MQA keeps K/V replicated; the einsum path is already
+    correct)."""
+    from ..parallel.mesh import TENSOR_AXIS
 
-    combined = tuple(a for a in (PIPELINE_AXIS, TENSOR_AXIS)
-                     if a in mesh.axis_names
-                     and a not in getattr(mesh, "manual_axes", ())
-                     and mesh.shape[a] > 1)
-    for cand in (combined, (TENSOR_AXIS,)):
-        if not cand or any(a not in mesh.axis_names for a in cand):
-            continue
-        shards = 1
-        for a in cand:
-            shards *= mesh.shape[a]
-        if n_heads % shards == 0 and kv_heads % shards == 0:
-            return cand
+    if (TENSOR_AXIS in mesh.axis_names
+            and TENSOR_AXIS not in getattr(mesh, "manual_axes", ())
+            and mesh.shape[TENSOR_AXIS] > 1
+            and n_heads % mesh.shape[TENSOR_AXIS] == 0
+            and kv_heads % mesh.shape[TENSOR_AXIS] == 0):
+        return (TENSOR_AXIS,)
     return None
 
 

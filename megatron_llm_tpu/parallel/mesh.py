@@ -6,9 +6,11 @@ embedding parallelism from a flat world, with TP innermost and DP strided
 :217-481).  On TPU the whole topology is one ``jax.sharding.Mesh`` with named
 axes; collectives are expressed against axis names and placement against
 ``PartitionSpec``s, so the group-getter zoo becomes pure functions of the
-mesh.  Axis order is (dp, pp, cp, tp): tp fastest-varying so TP collectives
-ride ICI neighbors; dp outermost so multi-slice deployments put dp on DCN
-(reference rank-order parity: parallel_state.py docstring example).
+mesh.  Axis order is (dp, fsdp, pp, cp, ep, tp, sp): tp fastest-varying so
+TP collectives ride ICI neighbors; dp outermost so multi-slice deployments
+put dp on DCN (reference rank-order parity: parallel_state.py docstring
+example).  fsdp (serving weight residency) and sp (named-but-size-1
+sequence axis) exist for the serving re-layout's partition rules.
 """
 
 from __future__ import annotations
@@ -26,33 +28,45 @@ from ..config import ParallelConfig
 
 # Canonical axis names.
 DATA_AXIS = "dp"
+# Serving weight-residency axis (ParallelConfig.fsdp): weights split
+# 1/fsdp along their non-tp dim under the serving re-layout
+# (models/sharding.py:serving_param_specs).  Size 1 in training meshes.
+FSDP_AXIS = "fsdp"
 PIPELINE_AXIS = "pp"
 CONTEXT_AXIS = "cp"
 EXPERT_AXIS = "ep"
 TENSOR_AXIS = "tp"
-AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, EXPERT_AXIS,
-              TENSOR_AXIS)
+# Named sequence axis for the ("dp","fsdp","sp")-family partition rules
+# (SNIPPETS exemplars).  Always size 1 here: decode runs one token per
+# step and prefill activations already shard via cp/tp, so "sp" exists
+# purely so specs naming it resolve against every mesh.
+SEQ_AXIS = "sp"
+AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, PIPELINE_AXIS, CONTEXT_AXIS,
+              EXPERT_AXIS, TENSOR_AXIS, SEQ_AXIS)
 
 
 def build_mesh(
     parallel: ParallelConfig,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Create the (dp, pp, cp, tp) mesh.
+    """Create the (dp, fsdp, pp, cp, ep, tp, sp) mesh.
 
     Replaces ``mpu.initialize_model_parallel(tp, pp, vpp, split_rank)``
     (reference: megatron/core/parallel_state.py:51).  Uses
     ``mesh_utils.create_device_mesh`` when the requested shape covers all
-    devices so the assignment respects the physical ICI topology.
+    devices so the assignment respects the physical ICI topology.  The
+    trailing sp axis is always size 1 (see SEQ_AXIS).
     """
     if devices is None:
         devices = jax.devices()
     shape = (
         parallel.data_parallel,
+        getattr(parallel, "fsdp", 1),
         parallel.pipeline_parallel,
         parallel.context_parallel,
         parallel.expert_parallel,
         parallel.tensor_parallel,
+        1,
     )
     n = int(np.prod(shape))
     if n > len(devices):
@@ -74,7 +88,8 @@ def build_mesh(
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     if device is None:
         device = jax.devices()[0]
-    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1, 1), AXIS_ORDER)
+    return Mesh(np.asarray([device]).reshape((1,) * len(AXIS_ORDER)),
+                AXIS_ORDER)
 
 
 # ---------------------------------------------------------------------------
@@ -98,6 +113,10 @@ def data_parallel_size(mesh: Mesh) -> int:
     return axis_size(mesh, DATA_AXIS)
 
 
+def fsdp_size(mesh: Mesh) -> int:
+    return axis_size(mesh, FSDP_AXIS) if FSDP_AXIS in mesh.axis_names else 1
+
+
 def context_parallel_size(mesh: Mesh) -> int:
     return axis_size(mesh, CONTEXT_AXIS)
 
@@ -115,6 +134,18 @@ def pipeline_stage_layers(num_layers: int, pp: int, vpp: int = 1) -> list[int]:
         f"num_layers {num_layers} must divide pipeline stages {chunks}"
     )
     return [num_layers // chunks] * chunks
+
+
+def stage_layer_ranges(num_layers: int, pp: int) -> list[tuple[int, int]]:
+    """Per-stage ``[lo, hi)`` layer ranges of the contiguous stage split.
+
+    The serving layer-sharded layout (models/sharding.py:
+    serving_param_specs with pp > 1) places the stacked layer axis over
+    'pp', so stage ``s`` holds exactly ``[lo, hi)`` of the flat layer
+    stack — this is the introspection mirror used by the GET /kv
+    per-stage pool section (serving/engine.py:kv_snapshot)."""
+    per = pipeline_stage_layers(num_layers, pp)[0]
+    return [(s * per, (s + 1) * per) for s in range(pp)]
 
 
 def is_first_stage(stage: int) -> bool:
@@ -184,7 +215,8 @@ def replica_submeshes(parallel: ParallelConfig, replicas: int,
                       devices: Optional[Sequence[jax.Device]] = None,
                       ) -> list[Mesh]:
     """Partition the device list into ``replicas`` disjoint submeshes of
-    ``parallel``'s per-replica geometry (serving: pp·tp devices each).
+    ``parallel``'s per-replica geometry (serving: pp·tp·fsdp devices
+    each).
 
     The replicated-router serving topology is dp-at-the-front: instead of
     one mesh with a dp axis (which would make every dispatch a global
@@ -197,7 +229,7 @@ def replica_submeshes(parallel: ParallelConfig, replicas: int,
         devices = jax.devices()
     per = (parallel.pipeline_parallel * parallel.tensor_parallel
            * parallel.context_parallel * parallel.expert_parallel
-           * parallel.data_parallel)
+           * parallel.data_parallel * getattr(parallel, "fsdp", 1))
     if replicas < 1:
         raise ValueError(f"replicas must be >= 1, got {replicas}")
     if replicas * per > len(devices):
@@ -236,7 +268,9 @@ class MeshAxes:
     (e.g. 2D tp×ep) can be introduced without touching model code."""
 
     dp: str = DATA_AXIS
+    fsdp: str = FSDP_AXIS
     pp: str = PIPELINE_AXIS
     cp: str = CONTEXT_AXIS
     ep: str = EXPERT_AXIS
     tp: str = TENSOR_AXIS
+    sp: str = SEQ_AXIS

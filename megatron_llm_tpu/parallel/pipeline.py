@@ -1,5 +1,14 @@
 """Pipeline parallelism: circular shift-register 1F1B over the ``pp`` mesh axis.
 
+This module is the TRAINING schedule.  Serving uses the same ``pp``
+mesh axis differently: the serving re-layout shards the stacked layer
+axis of params and the paged KV pool over pp
+(models/sharding.py:serving_param_specs / kv_pool_specs, stage ranges
+from parallel/mesh.py:stage_layer_ranges) and the engine microbatch-
+interleaves decode steps across the stages
+(serving/engine.py:_dispatch_decode) — GSPMD derives the stage-to-stage
+transfers from the specs, so no explicit 1F1B schedule exists there.
+
 Reference mapping (megatron/schedules.py:18-722):
 
 - ``forward_backward_no_pipelining`` (schedules.py:213) → the plain

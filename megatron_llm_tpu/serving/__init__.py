@@ -15,7 +15,8 @@
   residency (LRU + ref pinning) so thousands of registered adapters
   share one base model, different adapters coexisting per-row in one
   decode batch.
-- ``cluster/`` — multi-chip serving: engines sharded over tp submeshes
+- ``cluster/`` — multi-chip serving: engines sharded over tp×pp(×fsdp)
+  submeshes
   (``cluster/sharded.py``) behind a replicated health-aware router with
   drain-based failover (``cluster/router.py``), plus disaggregated
   prefill/decode — prefill-specialized replicas shipping paged KV

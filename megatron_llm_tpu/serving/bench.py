@@ -1087,6 +1087,126 @@ def run_cluster_serving_bench(cfg, params, *, num_requests: int = 16,
     }
 
 
+def run_pp_serving_bench(cfg, params, *, num_requests: int = 12,
+                         gen_len: int = 32, slots: int = 4,
+                         max_prompt_len: int = 64, pp: int = 2,
+                         seed: int = 0) -> dict:
+    """Pipeline-parallel serving point (docs/serving.md
+    "Pipeline-parallel decode"): pp as a real serving axis, measured
+    against tp at EQUAL device count.
+
+    - **residency** — per-device resident param bytes at pp=``pp`` (and
+      at fsdp=``pp``) vs the single-mesh tree: the layer-sharded layout
+      splits every stacked [L, ...] leaf over the stages, so
+      ``serving_pp_param_bytes_ratio`` ≈ pp is the headline the
+      ``--compare`` gate watches (a pp-times larger model fits the same
+      per-chip HBM, with the KV pool sharding the same way).
+    - **ITL overhead bounded** — the microbatch-interleaved pp engine's
+      ITL p50 against a tp=``pp`` engine on the same devices.  NOTE:
+      under the CPU device-count simulation all "devices" share the
+      host's cores, so the pair records plumbing cost, not the
+      hardware bubble-fill claim.
+    - **bitwise** — the pp engine's tokens must equal the single-mesh
+      engine's exactly (also pinned by tests/serving/
+      test_pp_serving.py); ``serving_pp_bitwise`` records the check.
+    """
+    import jax
+    import numpy as np
+
+    from ..config import ParallelConfig
+    from .cluster.sharded import build_sharded_engine
+    from .engine import EngineConfig, ServingEngine
+
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(8, max_prompt_len + 1, num_requests)
+    prompts = [rng.integers(1, cfg.vocab_size, int(n)).tolist()
+               for n in lens]
+    ec = EngineConfig(
+        max_batch_size=slots,
+        max_seq_len=min(max_prompt_len + gen_len,
+                        cfg.max_position_embeddings),
+        max_queue_size=max(num_requests, slots),
+        prefill_bucket=max_prompt_len,
+    )
+
+    def one_run(parallel) -> tuple[dict, list]:
+        if parallel is None:
+            eng = ServingEngine(cfg, params, ec).start()
+        else:
+            n_dev = (parallel.pipeline_parallel * parallel.tensor_parallel
+                     * parallel.fsdp)
+            eng = build_sharded_engine(
+                cfg, params, ec, parallel=parallel,
+                devices=jax.devices()[:n_dev]).start()
+        itl, make_stream = _itl_recorder()
+        try:
+            _warmup_executables(eng, [(prompts[0], 2)])
+            t0 = time.perf_counter()
+            handles = eng.submit_many([
+                dict(prompt=p, max_new_tokens=gen_len, use_eos_stop=False,
+                     seed=i, on_token=make_stream())
+                for i, p in enumerate(prompts)])
+            results = [h.result(timeout=600) for h in handles]
+            dt = time.perf_counter() - t0
+        finally:
+            eng.shutdown()
+        tokens = [list(r.tokens) for r in results]
+        n_tok = sum(len(r.tokens) - r.prompt_len for r in results)
+        return {
+            "qps": round(num_requests / dt, 3),
+            "tokens_per_sec": round(n_tok / dt, 1),
+            "itl_ms_p50": round(itl.percentile(50) * 1e3, 3),
+        }, tokens
+
+    def per_device_param_bytes(parallel=None) -> int:
+        if parallel is None:
+            return sum(np.asarray(l).nbytes
+                       for l in jax.tree.leaves(params))
+        n_dev = (parallel.pipeline_parallel * parallel.tensor_parallel
+                 * parallel.fsdp)
+        eng = build_sharded_engine(
+            cfg, params,
+            EngineConfig(max_batch_size=slots, max_seq_len=ec.max_seq_len),
+            parallel=parallel, devices=jax.devices()[:n_dev])
+        return sum(leaf.addressable_shards[0].data.nbytes
+                   for leaf in jax.tree.leaves(eng.params))
+
+    single, ref_tokens = one_run(None)
+    pp_run, pp_tokens = one_run(ParallelConfig(pipeline_parallel=pp))
+    tp_run, tp_tokens = one_run(ParallelConfig(tensor_parallel=pp))
+    base_bytes = per_device_param_bytes()
+    pp_bytes = per_device_param_bytes(ParallelConfig(pipeline_parallel=pp))
+    fsdp_bytes = per_device_param_bytes(ParallelConfig(fsdp=pp))
+    return {
+        "serving_pp_qps_single": single["qps"],
+        f"serving_pp_qps_pp{pp}": pp_run["qps"],
+        f"serving_pp_qps_tp{pp}": tp_run["qps"],
+        "serving_pp_itl_ms_p50_single": single["itl_ms_p50"],
+        f"serving_pp_itl_ms_p50_pp{pp}": pp_run["itl_ms_p50"],
+        f"serving_pp_itl_ms_p50_tp{pp}": tp_run["itl_ms_p50"],
+        # pp ITL relative to tp at the same device count: the bubble-
+        # fill overhead the microbatch interleave is bounding
+        "serving_pp_itl_vs_tp_ratio": round(
+            pp_run["itl_ms_p50"] / max(1e-9, tp_run["itl_ms_p50"]), 3),
+        "serving_pp_tokens_per_sec_single": single["tokens_per_sec"],
+        f"serving_pp_tokens_per_sec_pp{pp}": pp_run["tokens_per_sec"],
+        "serving_pp_param_bytes_per_device_single": base_bytes,
+        f"serving_pp_param_bytes_per_device_pp{pp}": pp_bytes,
+        f"serving_pp_param_bytes_per_device_fsdp{pp}": fsdp_bytes,
+        "serving_pp_param_bytes_ratio": round(
+            base_bytes / max(1, pp_bytes), 3),
+        "serving_pp_fsdp_param_bytes_ratio": round(
+            base_bytes / max(1, fsdp_bytes), 3),
+        "serving_pp_bitwise": int(pp_tokens == ref_tokens
+                                  and tp_tokens == ref_tokens),
+        "serving_pp_pp": pp,
+        "serving_pp_num_requests": num_requests,
+        "serving_pp_slots": slots,
+        "serving_pp_max_prompt_len": max_prompt_len,
+        "serving_pp_gen_len": gen_len,
+    }
+
+
 def _fwd_flops_per_token(cfg, seq_len: int) -> float:
     """Forward-pass FLOPs/token (the repo ``bench.py`` training count
     without the 3x fwd+bwd factor) for prefill MFU normalization."""
@@ -1517,6 +1637,9 @@ def main() -> None:
                                              gen_len=8, slots=2,
                                              max_prompt_len=32,
                                              replicas=2, tp=2))
+        out.update(run_pp_serving_bench(cfg, params, num_requests=6,
+                                        gen_len=8, slots=2,
+                                        max_prompt_len=32, pp=2))
         out.update(run_disagg_serving_bench(cfg, params, num_requests=6,
                                             gen_len=8, slots=2,
                                             prompt_len=64,

@@ -103,6 +103,7 @@ class BlockPool:
         self.cfg = cfg
         self.n_blocks = int(n_blocks)
         self.block_size = int(block_size)
+        self.mesh = None  # serving submesh, recorded by place()
         self.k_pool, self.v_pool = model_lib.init_kv_pool(
             cfg, n_blocks, block_size)
         self._ref = np.zeros(n_blocks, dtype=np.int32)
@@ -126,14 +127,19 @@ class BlockPool:
         self.shipments: dict = {}
 
     def place(self, mesh) -> None:
-        """Re-place the pool arrays onto a serving submesh, kv heads
-        sharded over the tp axes (models/sharding.py:kv_pool_specs).
+        """Re-place the pool arrays onto a serving submesh: kv heads
+        sharded over tp and the stacked layer axis over pp, so each
+        pipeline stage holds only its own layer slice of every block
+        (models/sharding.py:kv_pool_specs).
 
         Called once by the sharded engine before any block is written:
         the host-side ledger (block ids, free list, refs) is sharding-
-        agnostic — block ids stay global integers on every shard."""
+        agnostic — block ids stay global integers on every shard and on
+        every stage, which is what keeps the allocator, prefix cache,
+        COW, and the host tier topology-blind."""
         from ..models import sharding as shard_lib
 
+        self.mesh = mesh
         self.k_pool, self.v_pool = shard_lib.shard_kv_pool(
             self.k_pool, self.v_pool, self.cfg, mesh)
 

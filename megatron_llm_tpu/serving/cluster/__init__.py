@@ -1,13 +1,15 @@
-"""Multi-chip serving: sharded engines over tp submeshes behind a
-replicated router.
+"""Multi-chip serving: sharded engines over tp×pp(×fsdp) submeshes
+behind a replicated router.
 
 Two independent layers (the sharded-worker / replicated-frontend split):
 
 * ``sharded.build_sharded_engine`` — one ``ServingEngine`` over a
-  pp·tp submesh: params in the serving re-layout
-  (models/sharding.py:serving_param_specs), the paged block pool
-  head-sharded (kv_pool_specs), block tables replicated, dispatches
-  under ``use_mesh`` on the scheduler thread.
+  tp×pp(×fsdp) submesh: params in the serving re-layout
+  (models/sharding.py:serving_param_specs — heads over tp, layer stack
+  over pp, residency over fsdp), the paged block pool sharded the same
+  way (kv_pool_specs: heads over tp, layers over pp), block tables
+  replicated, dispatches under ``use_mesh`` on the scheduler thread
+  (microbatch-interleaved across stages when pp > 1).
 * ``router.Router`` — least-loaded, health-aware dispatch over
   dp-replicated engines with sticky streams and drain/kill failover
   that resubmits not-yet-finished requests deterministically.

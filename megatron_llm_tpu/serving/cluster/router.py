@@ -692,7 +692,7 @@ class Router:
         ``new_params`` must match each replica's resident tree in
         structure/shapes/dtypes (``swap_params`` validates before
         touching anything; zero recompiles by construction).  For
-        tp-sharded replicas pass a tree laid out like the resident
+        sharded (tp/pp/fsdp) replicas pass a tree laid out like the resident
         params — jit re-lays a mismatched sharding at a one-time
         transfer cost, never a correctness cost.  Returns a per-replica
         report dict; an engine ``ValueError`` (tree mismatch)

@@ -1,22 +1,32 @@
-"""Sharded serving engines over tp(/pp) submeshes.
+"""Sharded serving engines over tp×pp(×fsdp) submeshes.
 
 One engine instance = one submesh.  The existing partition rules do all
-the layout work: params re-shard with ``serving_param_specs`` (pp joins
-tp, weights resident, int8 ``{"q", "scale"}`` subtrees via
-``quantize_specs``), the paged block pool shards its kv-head axis
-(``kv_pool_specs``), and the slot block tables stay replicated host
-int32 — block ids are global on every shard, so the engine's entire
+the layout work: params re-shard with ``serving_param_specs`` (heads
+over tp, the stacked LAYER axis over pp — true pipeline stages — and
+weight residency split 1/fsdp along the non-tp dim; int8
+``{"q", "scale"}`` subtrees via ``quantize_specs``), the paged block
+pool shards its kv-head axis over tp and its layer axis over pp
+(``kv_pool_specs`` — each stage holds its own layers' slice of every
+block), and the slot block tables stay replicated host int32 — block
+ids are global on every shard and every stage, so the engine's entire
 ledger (free list, refs, reservations, prefix trie) is untouched.
+
+On a pp>1 submesh the engine additionally microbatch-interleaves its
+decode steps (engine.py:_dispatch_decode): the slot batch splits into
+pp groups whose dispatches chain through the KV pool, filling the
+pipeline bubble while keeping tokens bitwise equal to the single-mesh
+path.
 
 A resident draft model (tree speculation, docs/serving.md) rides the
 same machinery: its params re-shard with ``serving_param_specs`` of the
-*draft* config onto the same submesh, so tp-sharded and disaggregated
+*draft* config onto the same submesh, so sharded and disaggregated
 decode replicas speculate exactly like the single-chip engine.  Draft
 KV never ships — each decode replica rebuilds it with one cheap dense
 prefill on install.
 
-At tp=1 this builds the plain single-chip engine — same executable,
-bitwise-identical tokens — so the cluster path has no single-chip tax.
+At tp=pp=fsdp=1 this builds the plain single-chip engine — same
+executable, bitwise-identical tokens — so the cluster path has no
+single-chip tax.
 """
 
 from __future__ import annotations
@@ -58,11 +68,12 @@ def build_sharded_engine(cfg: ModelConfig, params,
     """One engine over one submesh.
 
     ``devices`` is the submesh's device slice (defaults to the first
-    pp·tp of ``jax.devices()``); ``params`` are re-laid-out onto it with
-    the serving re-layout, and ``draft_params`` (resident draft model,
-    if any) follow with their own config's specs.  With pp·tp == 1 and
-    no explicit devices this returns the ordinary single-chip engine
-    (mesh=None) so the fused single-device kernels stay eligible.
+    pp·tp·fsdp of ``jax.devices()``); ``params`` are re-laid-out onto
+    it with the serving re-layout, and ``draft_params`` (resident draft
+    model, if any) follow with their own config's specs.  With
+    pp·tp·fsdp == 1 and no explicit devices this returns the ordinary
+    single-chip engine (mesh=None) so the fused single-device kernels
+    stay eligible.
 
     ``adapters`` (multi-tenant LoRA registry) is handed to the engine
     as-is; the arenas are tiny (rank · hidden per slot per target) and
@@ -81,21 +92,23 @@ def build_sharded_engine(cfg: ModelConfig, params,
     spec = dict(cfg=cfg, params=params, engine_config=engine_config,
                 parallel=parallel, devices=devices, draft_cfg=draft_cfg,
                 draft_params=draft_params, adapters=adapters)
-    tp_eff = parallel.pipeline_parallel * parallel.tensor_parallel
-    if tp_eff == 1 and devices is None:
+    from ...models import sharding as shard_lib
+
+    n_sub = (parallel.pipeline_parallel * parallel.tensor_parallel
+             * getattr(parallel, "fsdp", 1))
+    if n_sub == 1 and devices is None:
         eng = ServingEngine(cfg, params, engine_config, metrics=metrics,
                             draft_cfg=draft_cfg,
                             draft_params=draft_params, adapters=adapters)
         eng.rebuild_spec = spec
         return eng
-    assert cfg.num_attention_heads % tp_eff == 0, (
-        f"serving re-layout shards heads over pp·tp = {tp_eff}, which "
-        f"must divide num_attention_heads = {cfg.num_attention_heads}")
+    # Per-axis geometry guards (heads divide tp, layers divide pp, vocab
+    # and hidden divide fsdp) — each failure names its own axis, never a
+    # fused pp·tp product, because pp shards LAYERS in this layout.
+    shard_lib.assert_serving_geometry(cfg, parallel)
     if draft_cfg is not None:
-        assert draft_cfg.num_attention_heads % tp_eff == 0, (
-            f"draft model heads ({draft_cfg.num_attention_heads}) must "
-            f"divide pp·tp = {tp_eff} to reshard with the target; pick "
-            f"a wider draft or a narrower submesh")
+        shard_lib.assert_serving_geometry(draft_cfg, parallel,
+                                          what="draft model")
     mesh = mesh_lib.build_mesh(parallel, devices=devices)
     sharded = _shard_for_serving(cfg, params, parallel, mesh)
     sharded_draft = (None if draft_params is None else
@@ -135,11 +148,12 @@ def build_cluster(cfg: ModelConfig, params,
 
     parallel = parallel or ParallelConfig()
     engine_config = engine_config or EngineConfig()
-    tp_eff = parallel.pipeline_parallel * parallel.tensor_parallel
+    n_sub = (parallel.pipeline_parallel * parallel.tensor_parallel
+             * getattr(parallel, "fsdp", 1))
     if devices is None:
         devices = jax.devices()
     engines = []
-    if replicas == 1 and tp_eff == 1:
+    if replicas == 1 and n_sub == 1:
         eng = ServingEngine(
             cfg, params, engine_config,
             metrics=ServingMetrics(engine_config.max_batch_size,
@@ -174,6 +188,8 @@ def build_disagg_cluster(cfg: ModelConfig, params,
                          *, prefill_replicas: int = 1,
                          decode_replicas: int = 1,
                          parallel: Optional[ParallelConfig] = None,
+                         prefill_parallel: Optional[ParallelConfig] = None,
+                         decode_parallel: Optional[ParallelConfig] = None,
                          router_config=None,
                          devices: Optional[Sequence[jax.Device]] = None,
                          draft_cfg: Optional[ModelConfig] = None,
@@ -205,6 +221,16 @@ def build_disagg_cluster(cfg: ModelConfig, params,
     ``build_cluster``); a shipment carries only the request's
     ``adapter_id``, and the adopting decode replica re-pins the adapter
     out of its own clone at install.
+
+    ``prefill_parallel`` / ``decode_parallel`` give the two roles
+    independent submesh geometries (both default to ``parallel``): the
+    canonical split keeps prefill replicas on wide tp (prefill is
+    compute-bound and head-parallel) and decode replicas on deep pp +
+    fsdp (decode is residency-bound; layer sharding scales weight AND
+    KV bytes per device).  KV shipments re-shard in flight — the import
+    path's ``device_put`` into the destination pool's sharding splits
+    each shipped block's layer/head axes to the decode geometry, so no
+    extra transfer code is needed.
     """
     import dataclasses as _dc
 
@@ -215,11 +241,21 @@ def build_disagg_cluster(cfg: ModelConfig, params,
         "a disaggregated cluster needs at least one prefill and one "
         "decode replica (use build_cluster for colocated serving)")
     parallel = parallel or ParallelConfig()
+    prefill_parallel = prefill_parallel or parallel
+    decode_parallel = decode_parallel or parallel
     engine_config = engine_config or EngineConfig()
     if devices is None:
         devices = jax.devices()
-    total = prefill_replicas + decode_replicas
-    meshes = mesh_lib.replica_submeshes(parallel, total, devices=devices)
+    # disjoint contiguous device slices per role, prefill first (the
+    # roles may have different per-replica sizes, so the uniform
+    # replica_submeshes partition runs once per role)
+    n_prefill_devs = prefill_replicas * prefill_parallel.world_size
+    meshes = (mesh_lib.replica_submeshes(
+                  prefill_parallel, prefill_replicas,
+                  devices=devices[:n_prefill_devs])
+              + mesh_lib.replica_submeshes(
+                  decode_parallel, decode_replicas,
+                  devices=devices[n_prefill_devs:]))
     prefill_cfg = cfg
     if cfg.attention_impl == "flash":
         from ...kernels.flash_attention import prefill_block_sizes
@@ -232,7 +268,8 @@ def build_disagg_cluster(cfg: ModelConfig, params,
         ec = _dc.replace(engine_config,
                          role="prefill" if is_prefill else "decode")
         engines.append(build_sharded_engine(
-            prefill_cfg if is_prefill else cfg, params, ec, parallel,
+            prefill_cfg if is_prefill else cfg, params, ec,
+            prefill_parallel if is_prefill else decode_parallel,
             devices=mesh.devices.flatten().tolist(),
             metrics=ServingMetrics(ec.max_batch_size, register=False),
             draft_cfg=draft_cfg, draft_params=draft_params,

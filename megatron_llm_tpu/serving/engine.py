@@ -868,13 +868,20 @@ class _Inflight:
     ``slots`` snapshots slot -> _SlotState at dispatch; a state object is
     unique per admission, so an identity check at processing time masks
     every speculative token sampled for a slot that retired (EOS, budget,
-    cancel, deadline) while the step was in flight."""
+    cancel, deadline) while the step was in flight.
+
+    On a pp>1 mesh the step is microbatch-interleaved
+    (``ServingEngine._decode_groups``): ``tok``/``tok_lp`` are then LISTS
+    of per-group device arrays over contiguous slot ranges
+    ``[g*gs, (g+1)*gs)`` instead of one [S] array — the groups' dispatches
+    chain through the KV pool, so while group g's tokens stream back the
+    later groups keep the other pipeline stages busy (bubble fill)."""
 
     __slots__ = ("tok", "tok_lp", "slots", "t_dispatch")
 
     def __init__(self, tok, tok_lp, slots, t_dispatch):
-        self.tok = tok            # [S] device array of sampled tokens
-        self.tok_lp = tok_lp      # [S] device array of their logprobs
+        self.tok = tok            # [S] device array (or per-group list)
+        self.tok_lp = tok_lp      # [S] logprobs, same layout as ``tok``
         self.slots = slots
         self.t_dispatch = t_dispatch
 
@@ -932,7 +939,8 @@ class ServingEngine:
         self._draft_kv = None     # (k_pool, v_pool) shadow pool, start()
         # Serving submesh (serving/cluster/): params arrive pre-sharded
         # (models/sharding.py:shard_for_serving layout), the paged pool
-        # is placed head-sharded at start(), and the scheduler thread
+        # is placed at start() with heads over tp and the stacked layer
+        # axis over pp (stage-local KV slices), and the scheduler thread
         # runs its dispatches inside ``use_mesh(mesh)`` so sharding
         # constraints and the shard-aware kernel dispatch resolve.  None
         # = the unchanged single-chip engine.
@@ -1018,6 +1026,12 @@ class ServingEngine:
         #                               preserved) as retirements free blocks
         self._prefilling: Optional[_PrefillState] = None  # chunked prefill
         self._inflight: Optional[_Inflight] = None  # dispatched decode step
+        # decode microbatch groups (resolved at start()): pp on a pp>1
+        # mesh when the slot batch divides evenly, else 1.  Each
+        # scheduler iteration then splits the batch into this many
+        # interleaved dispatches so the pipeline stages overlap distinct
+        # microbatches instead of idling pp-1/pp of the mesh per step.
+        self._decode_groups = 1
         self._scheduler_error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._paused = threading.Event()
@@ -1080,6 +1094,18 @@ class ServingEngine:
                     on_cow=lambda: self.metrics.inc("cow_copies_total"))
                 if self.mesh is not None:
                     pool.place(self.mesh)
+                    from ..parallel import mesh as mesh_lib
+                    pp = mesh_lib.pipeline_parallel_size(self.mesh)
+                    # microbatch-interleaved decode: split the slot batch
+                    # into pp groups whose dispatches chain through the
+                    # KV pool, overlapping across the layer-sharded
+                    # stages.  Per-group shapes are identical ([S/pp]),
+                    # so all groups share ONE executable — zero extra
+                    # compiles — and tokens stay bitwise equal to the
+                    # single-dispatch path (per-row math, disjoint-row
+                    # pool scatters, RNG folded on (seed, count) only).
+                    if pp > 1 and cfg_e.max_batch_size % pp == 0:
+                        self._decode_groups = pp
                 self.slots = SlotAllocator(self.cfg,
                                            cfg_e.max_batch_size,
                                            cfg_e.max_seq_len, pool)
@@ -2607,17 +2633,6 @@ class ServingEngine:
             # K/V row exists before the tables snapshot (reservation-backed,
             # so this cannot fail mid-flight)
             self.slots.append_block_id(slot, st.fill)
-        tables = jnp.asarray(self.slots.tables)
-        if self._inflight is None:
-            # no device-resident tokens: every active slot's pending value
-            # is host-known (fresh admission, post-pause/post-sync commit)
-            pending = jnp.asarray(overrides)
-        elif override_mask.any():
-            pending = _merge_pending(self._inflight.tok,
-                                     jnp.asarray(override_mask),
-                                     jnp.asarray(overrides))
-        else:
-            pending = self._inflight.tok  # pure device->device handoff
 
         t0 = time.perf_counter()
         if self._last_dispatch_t is not None:
@@ -2633,27 +2648,63 @@ class ServingEngine:
         self._last_dispatch_t = t0
 
         self.metrics.inc_step(self._fused_decode, self._precision_route)
+        # Microbatch-interleaved dispatch: the slot batch is split into
+        # G contiguous groups (G = pp on a pp>1 mesh, else 1) whose
+        # decode calls chain through the donated KV pool — group g+1's
+        # dispatch depends on group g's pool output, so under async
+        # dispatch the stages of the layer-sharded pipeline overlap
+        # distinct groups instead of idling.  G identical [S/G] shapes
+        # share one executable, and per-row math + disjoint-row pool
+        # scatters keep the tokens bitwise equal to a single full-batch
+        # dispatch.  G == 1 degenerates to exactly the old behavior
+        # (one [S] dispatch, _Inflight.tok a plain array).
+        G = self._decode_groups
+        gs = S // G
+        k_pool, v_pool = self.slots.k_pool, self.slots.v_pool
+        toks, tok_lps = [], []
         with device_annotation("decode"):
-            tok, tok_lp, k_pool, v_pool = self._decode(
-                self.cfg, self.params, self.slots.k_pool,
-                self.slots.v_pool, tables,
-                pending, jnp.asarray(fills), jnp.asarray(seeds),
-                jnp.asarray(counters), jnp.asarray(greedy),
-                jnp.asarray(temps),
-                jnp.asarray(top_ks), jnp.asarray(top_ps),
-                use_fused=self._fused_decode,
-                **self._lora_args(aslots))
+            for g in range(G):
+                sl = slice(g * gs, (g + 1) * gs)
+                prev_tok = None
+                if self._inflight is not None:
+                    prev_tok = (self._inflight.tok[g] if G > 1
+                                else self._inflight.tok)
+                if prev_tok is None:
+                    # no device-resident tokens: every active slot's
+                    # pending value is host-known (fresh admission,
+                    # post-pause/post-sync commit)
+                    pending = jnp.asarray(overrides[sl])
+                elif override_mask[sl].any():
+                    pending = _merge_pending(prev_tok,
+                                             jnp.asarray(override_mask[sl]),
+                                             jnp.asarray(overrides[sl]))
+                else:
+                    pending = prev_tok  # pure device->device handoff
+                tok, tok_lp, k_pool, v_pool = self._decode(
+                    self.cfg, self.params, k_pool, v_pool,
+                    jnp.asarray(self.slots.tables[sl]),
+                    pending, jnp.asarray(fills[sl]),
+                    jnp.asarray(seeds[sl]), jnp.asarray(counters[sl]),
+                    jnp.asarray(greedy[sl]), jnp.asarray(temps[sl]),
+                    jnp.asarray(top_ks[sl]), jnp.asarray(top_ps[sl]),
+                    use_fused=self._fused_decode,
+                    **self._lora_args(aslots[sl]))
+                toks.append(tok)
+                tok_lps.append(tok_lp)
         self.slots.set_pools(k_pool, v_pool)
-        try:  # start the host copy now so it overlaps the next dispatch
-            tok.copy_to_host_async()
-            tok_lp.copy_to_host_async()
+        try:  # start the host copies now so they overlap the next dispatch
+            for tok, tok_lp in zip(toks, tok_lps):
+                tok.copy_to_host_async()
+                tok_lp.copy_to_host_async()
         except AttributeError:  # backend without async transfers
             pass
         snapshot = dict(self._active)
         for st in snapshot.values():
             st.fill += 1   # the fed token's K/V row lands this step
             st.count += 1  # one more token sampled (possibly speculative)
-        return _Inflight(tok, tok_lp, snapshot, t0)
+        if G == 1:
+            return _Inflight(toks[0], tok_lps[0], snapshot, t0)
+        return _Inflight(toks, tok_lps, snapshot, t0)
 
     # tpulint: hot-path
     def _process_step_results(self, step: _Inflight) -> float:
@@ -2663,9 +2714,18 @@ class ServingEngine:
         # tpulint: allow[host-sync] THE deliberate scheduling point: the
         # one place per iteration the host waits for sampled tokens (the
         # copy was started async at dispatch, so pipelined mode overlaps
-        # it with the next step's execution)
-        tok = np.asarray(step.tok)
-        tok_lp = np.asarray(step.tok_lp)  # tpulint: allow[host-sync] same fetch: arrives with tok, no extra sync
+        # it with the next step's execution).  Microbatch-interleaved
+        # steps carry per-group lists over contiguous slot ranges, so
+        # concatenation restores the slot-indexed [S] vector.
+        if isinstance(step.tok, list):
+            # tpulint: allow[host-sync] the deliberate fetch, group form
+            tok = np.concatenate([np.asarray(t) for t in step.tok])
+            # tpulint: allow[host-sync] same fetch: arrives with tok
+            tok_lp = np.concatenate([np.asarray(t) for t in step.tok_lp])
+        else:
+            # tpulint: allow[host-sync] the deliberate fetch (see above)
+            tok = np.asarray(step.tok)
+            tok_lp = np.asarray(step.tok_lp)  # tpulint: allow[host-sync] same fetch: arrives with tok, no extra sync
         t_ready = time.perf_counter()
         self._last_ready_t = t_ready
         device_s = t_ready - step.t_dispatch
@@ -2769,13 +2829,37 @@ class ServingEngine:
         ref counts, fragmentation (live tokens / allocated tokens slack),
         and — when a host tier is configured — host arena occupancy plus
         per-request swapped-out block counts, so the snapshot reports ALL
-        resident KV, not just the HBM share.  Best-effort under
-        concurrent scheduling — served from any thread without locking,
-        like /metrics and /trace."""
+        resident KV, not just the HBM share.  On a pp>1 mesh a
+        ``stages`` section breaks the pool down per pipeline stage: each
+        stage's layer range, device ids, and its stage-local ledger view
+        (the block ledger is host-global and block ids are identical on
+        every stage, so a healthy engine shows the SAME free/used counts
+        on all stages — an imbalance means a stage's pool diverged).
+        Best-effort under concurrent scheduling — served from any thread
+        without locking, like /metrics and /trace."""
         if self.slots is None:
             return {"pool": None, "slots": {}}
         fills = {s: st.fill for s, st in dict(self._active).items()}
         snap = self.slots.snapshot(fills)
+        if self.mesh is not None:
+            from ..parallel import mesh as mesh_lib
+            pp = mesh_lib.pipeline_parallel_size(self.mesh)
+            if pp > 1 and self.cfg.num_layers % pp == 0:
+                pool_stats = snap.get("pool") or {}
+                axis = list(self.mesh.axis_names).index(
+                    mesh_lib.PIPELINE_AXIS)
+                devs = np.asarray(self.mesh.devices)
+                snap["stages"] = [
+                    {"stage": s,
+                     "layers": [lo, hi],
+                     "devices": sorted(
+                         d.id for d in devs.take(s, axis=axis).ravel()),
+                     "blocks_free": pool_stats.get("blocks_free"),
+                     "blocks_used": pool_stats.get("blocks_used"),
+                     "fragmentation": snap.get("fragmentation")}
+                    for s, (lo, hi) in enumerate(
+                        mesh_lib.stage_layer_ranges(self.cfg.num_layers,
+                                                    pp))]
         if self.host_tier is not None:
             snap["host_tier"] = self.host_tier.stats()
             snap["host_tier"]["suspended"] = {

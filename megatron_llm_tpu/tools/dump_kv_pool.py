@@ -10,7 +10,11 @@ The GET /kv endpoint (generation/server.py) returns the engine's
 copy-on-write count), per-slot block tables with fill levels, ref counts
 (shared prefix blocks show ref > 1), and the fragmentation fraction
 (allocated-but-unfilled slack inside partially-filled boundary blocks).
-See docs/serving.md, "Paged KV cache".
+On a pipeline-parallel (pp > 1) engine the snapshot also carries a
+per-stage section — each stage's layer range, device ids, and its
+stage-local ledger view; healthy engines show identical counts on
+every stage (block ids are global, only layer slices are stage-local).
+See docs/serving.md, "Paged KV cache" and "Pipeline-parallel decode".
 """
 
 from __future__ import annotations
@@ -44,6 +48,17 @@ def summarize(snap: dict) -> str:
     shared = {b: r for b, r in snap.get("ref_counts", {}).items() if r > 1}
     if shared:
         lines.append(f"shared blocks (ref > 1): {shared}")
+    stages = snap.get("stages")
+    if stages:
+        lines.append(f"pipeline stages: {len(stages)} "
+                     "(layer-sharded pool; ledgers should match)")
+        for st in stages:
+            lo, hi = st["layers"]
+            lines.append(
+                f"  stage {st['stage']}: layers [{lo}, {hi}) "
+                f"devices={st['devices']} "
+                f"free={st['blocks_free']} used={st['blocks_used']} "
+                f"frag={st.get('fragmentation', 0.0):.1%}")
     host = snap.get("host_tier")
     if host:
         bw = host.get("swap_bw_bytes_per_s", 0.0)

@@ -231,10 +231,12 @@ def main(argv=None) -> int:
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel shards for serving")
     ap.add_argument("--pp", type=int, default=1,
-                    help="extra serving shards: for inference the pp axis "
-                         "JOINS tp (models/sharding.py:serving_param_specs) "
-                         "so a tp×pp training topology serves at tp·pp-way "
-                         "tensor parallelism with weights resident")
+                    help="pipeline-parallel serving stages: pp shards the "
+                         "LAYER stack — params and the paged KV pool alike "
+                         "(models/sharding.py:serving_param_specs / "
+                         "kv_pool_specs) — and the engine microbatch-"
+                         "interleaves decode steps across the stages "
+                         "(docs/serving.md 'Pipeline-parallel decode')")
     ap.add_argument("--replicas", type=int, default=1,
                     help="engine replicas on disjoint pp·tp device slices "
                          "behind the health-aware cluster router "
@@ -352,7 +354,7 @@ def main(argv=None) -> int:
     mesh_ctx = None
     if args.disagg is not None:
         print(f"disaggregated cluster: {args.disagg} prefill:decode "
-              f"replicas x {args.tp * args.pp}-way tensor sharding "
+              f"replicas x tp={args.tp} pp={args.pp} submeshes "
               "behind the phase-routing router (GET /cluster; "
               "docs/serving.md 'Disaggregated prefill/decode')")
     elif cluster:
@@ -360,7 +362,7 @@ def main(argv=None) -> int:
         # its submesh (serving/cluster/sharded.py) and runs under that
         # mesh on its scheduler thread — no ambient process-wide mesh
         print(f"cluster: {args.replicas} replica(s) x "
-              f"{args.tp * args.pp}-way tensor sharding behind the "
+              f"tp={args.tp} pp={args.pp} submeshes behind the "
               "router (GET /cluster; docs/serving.md 'Multi-chip "
               "serving')")
     elif args.tp > 1 or args.pp > 1:
@@ -373,7 +375,7 @@ def main(argv=None) -> int:
         params, mesh = shard_for_serving(params, lm.cfg, parallel)
         mesh_ctx = mesh_lib.use_mesh(mesh)
         print(f"serving layout: {dict(mesh.shape)} "
-              f"({args.tp * args.pp}-way tensor sharding)")
+              f"(tp={args.tp} heads, pp={args.pp} layer stages)")
 
     from ..generation.server import MegatronServer
 

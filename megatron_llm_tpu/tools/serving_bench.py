@@ -1,9 +1,9 @@
 """Decode-throughput benchmark on an arbitrary serving mesh.
 
 Measures KV-cached greedy decode tokens/sec for a model preset under the
-serving re-layout (models/sharding.py:serving_param_specs — the pp axis
-joins tp so weights stay resident; see that docstring for why sharding
-layers over pp is wrong for decode).  The reference publishes no decode
+serving re-layout (models/sharding.py:serving_param_specs — heads shard
+over tp, the stacked layer axis over pp; see docs/serving.md
+"Pipeline-parallel decode").  The reference publishes no decode
 benchmark; its serving path is the pipelined per-token ForwardStep
 (megatron/text_generation/forward_step.py:44-213).
 
@@ -54,7 +54,7 @@ def run(model: str, size: str, tp: int, pp: int, batch: int,
 
     parallel = ParallelConfig(pipeline_parallel=pp, tensor_parallel=tp)
     params = model_lib.init_params(jax.random.key(0), cfg,
-                                   tp=max(tp * pp, 1))
+                                   tp=max(tp, 1))
     if quantize:
         from ..ops.quant import quantize_params, resolve_policy
 

@@ -53,9 +53,10 @@ def test_tp_sharded_greedy_matches_unsharded():
 
 
 def test_pp_serving_relayout_greedy_matches_unsharded():
-    """Serving under pp (BASELINE config 3/5 serving regime): the pp axis
-    joins tp (models/sharding.py:serving_param_specs) so decode weights
-    stay resident — greedy decode must be identical to unsharded."""
+    """Serving under pp (BASELINE config 3/5 serving regime): the serving
+    re-layout shards heads over tp and the stacked layer axis over pp
+    (models/sharding.py:serving_param_specs) — greedy decode must be
+    identical to unsharded."""
     pp, tp = 2, 2
     cfg = tiny_config(
         num_layers=4, hidden_size=64, num_attention_heads=8, num_kv_heads=8,

@@ -123,9 +123,11 @@ def test_mqa_under_mesh_falls_back_to_einsum(monkeypatch):
 
 
 def test_kernel_path_under_pp_tp_serving_mesh(monkeypatch):
-    """Serving re-layout (pp joins tp): the kernel shard_map goes manual
-    over BOTH axes so the cache stays resident per shard; parity vs the
-    einsum path."""
+    """Heads manually sharded over BOTH pp and tp axes: the kernel
+    shard_map goes manual over the combined axes so the cache stays
+    resident per shard; parity vs the einsum path.  (The serving
+    re-layout itself now shards layers over pp — this pins the
+    dispatcher's combined-axis capability regardless.)"""
     pp, tp = 2, 2
     rng = np.random.default_rng(3)
     q, k, v = _rand_qkv(rng, 2, 8, 4, 256, 128)
@@ -159,8 +161,8 @@ def test_kernel_path_under_pp_tp_serving_mesh(monkeypatch):
 def test_kv_heads_not_divisible_by_pp_tp_falls_back(monkeypatch):
     """kv=2 under pp·tp=4 can't shard the cache over the combined axes;
     the dispatcher drops to the tp-only kernel layout (kv=2 divides
-    tp=2) and numerics stay exact — the training-layout path is never
-    regressed by the serving-relayout preference."""
+    tp=2) and numerics stay exact — the tp-only path is never regressed
+    by the combined-axis preference."""
     rng = np.random.default_rng(4)
     q, k, v = _rand_qkv(rng, 2, 8, 2, 256, 128)
     want = decode_attention(q, k, v, jnp.int32(60))

@@ -130,7 +130,7 @@ def test_moe_ep_sharded_matches_unsharded(devices):
         np.random.default_rng(4).integers(0, 64, (2, 32)), jnp.int32)
     want = model_lib.forward(cfg, params, tokens)
 
-    devs = np.asarray(devices).reshape(2, 1, 1, 4, 1)  # dp2 × ep4
+    devs = np.asarray(devices).reshape(2, 1, 1, 1, 4, 1, 1)  # dp2 × ep4
     mesh = Mesh(devs, mesh_lib.AXIS_ORDER)
     parallel = ParallelConfig(data_parallel=2, expert_parallel=4)
     specs = shard_lib.param_specs(cfg, parallel)

@@ -1,0 +1,71 @@
+"""Unit tests for parallel/mesh.py topology helpers.
+
+``pipeline_stage_layers`` / ``stage_layer_ranges`` are the single
+source of truth for which layers live on which pipeline stage — both
+the training 1F1B schedule and the serving layer-sharded layout
+(models/sharding.py:serving_param_specs, engine.kv_snapshot's stage
+section) derive from them, so their edge cases get pinned here.
+"""
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import ParallelConfig
+from megatron_llm_tpu.parallel import mesh as mesh_lib
+
+
+def test_stage_layers_even_split():
+    assert mesh_lib.pipeline_stage_layers(8, 2) == [4, 4]
+    assert mesh_lib.pipeline_stage_layers(8, 4) == [2, 2, 2, 2]
+
+
+def test_stage_layers_pp1_degenerate():
+    # pp=1 is the single-stage identity: one chunk holding everything
+    assert mesh_lib.pipeline_stage_layers(5, 1) == [5]
+    assert mesh_lib.stage_layer_ranges(5, 1) == [(0, 5)]
+
+
+def test_stage_layers_vpp_chunks():
+    # vpp>1 splits each stage into virtual chunks: pp·vpp entries
+    assert mesh_lib.pipeline_stage_layers(8, 2, vpp=2) == [2, 2, 2, 2]
+    assert mesh_lib.pipeline_stage_layers(12, 2, vpp=3) == [2] * 6
+
+
+def test_stage_layers_indivisible_asserts():
+    with pytest.raises(AssertionError, match="must divide"):
+        mesh_lib.pipeline_stage_layers(7, 2)
+    with pytest.raises(AssertionError, match="must divide"):
+        mesh_lib.pipeline_stage_layers(8, 2, vpp=3)
+
+
+def test_stage_layer_ranges_cover_contiguously():
+    ranges = mesh_lib.stage_layer_ranges(8, 4)
+    assert ranges == [(0, 2), (2, 4), (4, 6), (6, 8)]
+    # ranges tile [0, L) exactly: no gaps, no overlap
+    flat = [i for lo, hi in ranges for i in range(lo, hi)]
+    assert flat == list(range(8))
+
+
+def test_build_mesh_axis_order_and_fsdp(devices):
+    mesh = mesh_lib.build_mesh(
+        ParallelConfig(pipeline_parallel=2, fsdp=2, data_parallel=2))
+    assert mesh.axis_names == mesh_lib.AXIS_ORDER
+    assert mesh_lib.pipeline_parallel_size(mesh) == 2
+    assert mesh_lib.fsdp_size(mesh) == 2
+    assert mesh_lib.data_parallel_size(mesh) == 2
+    # the always-size-1 named sequence axis resolves on every mesh
+    assert mesh.shape[mesh_lib.SEQ_AXIS] == 1
+    # single-device meshes carry the same 7-axis order
+    single = mesh_lib.single_device_mesh()
+    assert single.axis_names == mesh_lib.AXIS_ORDER
+    assert mesh_lib.fsdp_size(single) == 1
+
+
+def test_replica_submeshes_include_fsdp(devices):
+    meshes = mesh_lib.replica_submeshes(
+        ParallelConfig(pipeline_parallel=2, fsdp=2), 2)
+    assert len(meshes) == 2
+    ids = [sorted(d.id for d in np.asarray(m.devices).ravel())
+           for m in meshes]
+    assert len(ids[0]) == 4  # pp·fsdp devices per replica
+    assert not set(ids[0]) & set(ids[1])

@@ -18,7 +18,7 @@ from megatron_llm_tpu.parallel import mesh as mesh_lib
 
 def cp_mesh(devices, cp):
     n = len(devices)
-    devs = np.asarray(devices).reshape(n // cp, 1, cp, 1, 1)
+    devs = np.asarray(devices).reshape(n // cp, 1, 1, cp, 1, 1, 1)
     return Mesh(devs, mesh_lib.AXIS_ORDER)
 
 
